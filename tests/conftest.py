@@ -1,0 +1,15 @@
+"""Test env: force an 8-device CPU mesh BEFORE jax initializes.
+
+SURVEY.md §4: multi-device sharding/collective semantics are tested on a
+virtual CPU mesh (`--xla_force_host_platform_device_count=8`); real-TPU runs
+happen only via bench.py / the driver.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,117 @@
+"""DSL wiring + compiler golden-IR tests (SURVEY.md §4 compiler/IR row)."""
+
+import json
+
+import pytest
+
+from tpu_pipelines.dsl.compiler import Compiler, IR_SCHEMA_VERSION
+from tpu_pipelines.dsl.component import (
+    Channel,
+    Parameter,
+    RuntimeParameter,
+    component,
+)
+from tpu_pipelines.dsl.pipeline import Pipeline
+
+
+@component(outputs={"examples": "Examples"},
+           parameters={"path": Parameter(type=str, required=True)})
+def FakeGen(ctx):
+    pass
+
+
+@component(inputs={"examples": "Examples"},
+           outputs={"statistics": "ExampleStatistics"})
+def FakeStats(ctx):
+    pass
+
+
+@component(inputs={"examples": "Examples", "statistics": "ExampleStatistics"},
+           outputs={"model": "Model"},
+           parameters={"steps": Parameter(type=int, default=10)})
+def FakeTrainer(ctx):
+    pass
+
+
+def _pipeline(**kw):
+    gen = FakeGen(path="/data.csv")
+    stats = FakeStats(examples=gen.outputs["examples"])
+    trainer = FakeTrainer(
+        examples=gen.outputs["examples"],
+        statistics=stats.outputs["statistics"],
+        steps=25,
+    )
+    return Pipeline(
+        "p", [gen, stats, trainer], pipeline_root="/tmp/root", **kw
+    ), (gen, stats, trainer)
+
+
+def test_channel_type_check():
+    gen = FakeGen(path="/x")
+    with pytest.raises(TypeError, match="expects artifact type"):
+        FakeStats(examples=Channel("Model", producer=gen, output_key="examples"))
+    with pytest.raises(TypeError, match="unknown argument"):
+        FakeGen(path="/x", bogus=1)
+    with pytest.raises(TypeError, match="missing required parameter"):
+        FakeGen()
+    with pytest.raises(TypeError, match="missing required inputs"):
+        FakeStats()
+
+
+def test_topo_order_and_closure():
+    gen = FakeGen(path="/x")
+    stats = FakeStats(examples=gen.outputs["examples"])
+    # Pass only the leaf: closure must pull in gen, order must be topo.
+    p = Pipeline("p", [stats], pipeline_root="/tmp/r")
+    assert [c.id for c in p.components] == ["FakeGen", "FakeStats"]
+
+
+def test_duplicate_ids_rejected():
+    g1, g2 = FakeGen(path="/a"), FakeGen(path="/b")
+    with pytest.raises(ValueError, match="duplicate component ids"):
+        Pipeline("p", [g1, g2], pipeline_root="/tmp/r")
+    g2.with_id("FakeGen2")
+    assert len(Pipeline("p", [g1, g2], pipeline_root="/tmp/r").components) == 2
+
+
+def test_compiled_ir_structure():
+    p, (gen, stats, trainer) = _pipeline()
+    ir = Compiler().compile(p)
+    assert ir.schema_version == IR_SCHEMA_VERSION
+    assert [n.id for n in ir.nodes] == ["FakeGen", "FakeStats", "FakeTrainer"]
+
+    tnode = ir.node("FakeTrainer")
+    assert tnode.upstream == ["FakeGen", "FakeStats"]
+    assert tnode.exec_properties == {"steps": 25}
+    assert tnode.inputs["examples"][0].producer == "FakeGen"
+    assert tnode.inputs["statistics"][0].producer == "FakeStats"
+    assert tnode.outputs == {"model": "Model"}
+    assert tnode.executor_version  # non-empty hash
+
+    # Deterministic: same DSL -> byte-identical IR JSON.
+    p2, _ = _pipeline()
+    assert Compiler().compile(p2).to_json_str() == ir.to_json_str()
+    # And it is valid JSON.
+    json.loads(ir.to_json_str())
+
+
+def test_executor_version_changes_with_salt():
+    p, _ = _pipeline()
+    ir1 = Compiler().compile(p)
+    FakeTrainer.CACHE_SALT = "v2"
+    try:
+        ir2 = Compiler().compile(_pipeline()[0])
+        assert (
+            ir1.node("FakeTrainer").executor_version
+            != ir2.node("FakeTrainer").executor_version
+        )
+    finally:
+        FakeTrainer.CACHE_SALT = ""
+
+
+def test_runtime_parameter_encoding():
+    gen = FakeGen(path=RuntimeParameter("data_path", default="/default.csv"))
+    p = Pipeline("p", [gen], pipeline_root="/tmp/r")
+    ir = Compiler().compile(p)
+    enc = ir.node("FakeGen").exec_properties["path"]
+    assert enc == {"__runtime_parameter__": "data_path", "default": "/default.csv"}

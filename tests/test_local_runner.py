@@ -1,0 +1,216 @@
+"""Local runner: topo execution, caching, retry, partial run, failure.
+
+Uses stub executors that record invocation order into a shared list —
+the fake-executor orchestrator-test trick from SURVEY.md §4.
+"""
+
+import os
+
+import pytest
+
+from tpu_pipelines.dsl.component import Parameter, RuntimeParameter, component
+from tpu_pipelines.dsl.pipeline import Pipeline
+from tpu_pipelines.orchestration import LocalDagRunner, PipelineRunError
+
+CALLS = []
+
+
+@component(outputs={"examples": "Examples"},
+           parameters={"content": Parameter(type=str, default="data")})
+def Gen(ctx):
+    CALLS.append(ctx.node_id)
+    with open(os.path.join(ctx.output("examples").uri, "data.txt"), "w") as f:
+        f.write(ctx.exec_properties["content"])
+
+
+@component(inputs={"examples": "Examples"}, outputs={"statistics": "ExampleStatistics"})
+def Stats(ctx):
+    CALLS.append(ctx.node_id)
+    src = os.path.join(ctx.input("examples").uri, "data.txt")
+    n = len(open(src).read())
+    with open(os.path.join(ctx.output("statistics").uri, "stats.txt"), "w") as f:
+        f.write(str(n))
+    return {"num_bytes": n}
+
+
+@component(inputs={"statistics": "ExampleStatistics"}, outputs={"model": "Model"})
+def Train(ctx):
+    CALLS.append(ctx.node_id)
+    with open(os.path.join(ctx.output("model").uri, "model.txt"), "w") as f:
+        f.write("model")
+
+
+@pytest.fixture(autouse=True)
+def _clear_calls():
+    CALLS.clear()
+
+
+def _pipeline(tmp_path, content="data", **kw):
+    gen = Gen(content=content)
+    stats = Stats(examples=gen.outputs["examples"])
+    train = Train(statistics=stats.outputs["statistics"])
+    kw.setdefault("metadata_path", str(tmp_path / "md.sqlite"))
+    return Pipeline(
+        "test-pipe", [gen, stats, train],
+        pipeline_root=str(tmp_path / "root"), **kw,
+    )
+
+
+def test_end_to_end_order_and_artifacts(tmp_path):
+    result = LocalDagRunner().run(_pipeline(tmp_path))
+    assert CALLS == ["Gen", "Stats", "Train"]
+    assert result.succeeded
+    model = result.outputs_of("Train", "model")[0]
+    assert open(os.path.join(model.uri, "model.txt")).read() == "model"
+    assert model.fingerprint
+    stats_ex = result.nodes["Stats"]
+    assert stats_ex.status == "COMPLETE"
+
+
+def test_execution_properties_recorded(tmp_path):
+    from tpu_pipelines.metadata import MetadataStore
+
+    p = _pipeline(tmp_path)
+    result = LocalDagRunner().run(p)
+    store = MetadataStore(p.metadata_path)
+    ex = store.get_execution(result.nodes["Stats"].execution_id)
+    assert ex.properties["num_bytes"] == 4
+    assert ex.properties["wall_clock_s"] >= 0
+    store.close()
+
+
+def test_cache_skips_second_run(tmp_path):
+    p1 = _pipeline(tmp_path)
+    LocalDagRunner().run(p1)
+    assert CALLS == ["Gen", "Stats", "Train"]
+    CALLS.clear()
+    result = LocalDagRunner().run(_pipeline(tmp_path))
+    assert CALLS == []  # everything cached
+    assert all(n.status == "CACHED" for n in result.nodes.values())
+
+    # Changing an exec property invalidates Gen and everything downstream.
+    CALLS.clear()
+    LocalDagRunner().run(_pipeline(tmp_path, content="other-data"))
+    assert CALLS == ["Gen", "Stats", "Train"]
+
+
+def test_cache_disabled(tmp_path):
+    LocalDagRunner().run(_pipeline(tmp_path, enable_cache=False))
+    CALLS.clear()
+    LocalDagRunner().run(_pipeline(tmp_path, enable_cache=False))
+    assert CALLS == ["Gen", "Stats", "Train"]
+
+
+def test_failure_propagates_and_marks_downstream(tmp_path):
+    @component(inputs={"examples": "Examples"}, outputs={"statistics": "ExampleStatistics"})
+    def Boom(ctx):
+        raise RuntimeError("kaboom")
+
+    gen = Gen()
+    boom = Boom(examples=gen.outputs["examples"]).with_id("Stats")
+    train = Train(statistics=boom.outputs["statistics"])
+    p = Pipeline(
+        "f", [gen, boom, train],
+        pipeline_root=str(tmp_path / "root"),
+        metadata_path=str(tmp_path / "md.sqlite"),
+    )
+    with pytest.raises(PipelineRunError) as ei:
+        LocalDagRunner().run(p)
+    result = ei.value.result
+    assert result.nodes["Gen"].status == "COMPLETE"
+    assert result.nodes["Stats"].status == "FAILED"
+    assert "kaboom" in result.nodes["Stats"].error
+    assert result.nodes["Train"].status == "FAILED"
+    assert result.nodes["Train"].error == "upstream failure"
+
+
+def test_retry_recovers_transient_failure(tmp_path):
+    attempts = {"n": 0}
+
+    @component(outputs={"examples": "Examples"})
+    def Flaky(ctx):
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise RuntimeError("transient")
+        with open(os.path.join(ctx.output("examples").uri, "ok"), "w") as f:
+            f.write("ok")
+
+    p = Pipeline(
+        "r", [Flaky()], pipeline_root=str(tmp_path / "root"),
+        metadata_path=str(tmp_path / "md.sqlite"),
+    )
+    result = LocalDagRunner(max_retries=2).run(p)
+    assert attempts["n"] == 3
+    assert result.nodes["Flaky"].status == "COMPLETE"
+    assert result.nodes["Flaky"].retries == 2
+
+
+def test_partial_run_to_nodes(tmp_path):
+    p = _pipeline(tmp_path)
+    LocalDagRunner().run(p, to_nodes=["Stats"])
+    assert CALLS == ["Gen", "Stats"]
+
+
+def test_partial_run_from_nodes_reuses_prior_outputs(tmp_path):
+    LocalDagRunner().run(_pipeline(tmp_path))
+    CALLS.clear()
+    # from Train: Gen/Stats skipped, their outputs resolved from the store.
+    result = LocalDagRunner().run(
+        _pipeline(tmp_path, enable_cache=False), from_nodes=["Train"]
+    )
+    assert CALLS == ["Train"]
+    assert result.nodes["Gen"].status == "SKIPPED"
+    assert result.nodes["Stats"].status == "SKIPPED"
+    assert result.nodes["Train"].status == "COMPLETE"
+
+
+def test_runtime_parameters_resolved(tmp_path):
+    gen = Gen(content=RuntimeParameter("content", default="dflt"))
+    p = Pipeline(
+        "rp", [gen], pipeline_root=str(tmp_path / "root"),
+        metadata_path=str(tmp_path / "md.sqlite"),
+    )
+    result = LocalDagRunner().run(p, runtime_parameters={"content": "injected"})
+    uri = result.outputs_of("Gen", "examples")[0].uri
+    assert open(os.path.join(uri, "data.txt")).read() == "injected"
+
+    # Default applies when not provided; cache key reflects the resolved value.
+    result2 = LocalDagRunner().run(
+        Pipeline(
+            "rp", [Gen(content=RuntimeParameter("content", default="dflt"))],
+            pipeline_root=str(tmp_path / "root"),
+            metadata_path=str(tmp_path / "md.sqlite"),
+        )
+    )
+    uri2 = result2.outputs_of("Gen", "examples")[0].uri
+    assert open(os.path.join(uri2, "data.txt")).read() == "dflt"
+
+
+def test_external_input_fingerprint_invalidates_cache(tmp_path):
+    src = tmp_path / "ext.csv"
+    src.write_text("a,b\n1,2\n")
+
+    @component(outputs={"examples": "Examples"},
+               parameters={"path": Parameter(type=str, required=True)},
+               external_input_parameters=("path",))
+    def Ingest(ctx):
+        CALLS.append(ctx.node_id)
+        data = open(ctx.exec_properties["path"]).read()
+        with open(os.path.join(ctx.output("examples").uri, "rows.csv"), "w") as f:
+            f.write(data)
+
+    def run():
+        p = Pipeline(
+            "ext", [Ingest(path=str(src))],
+            pipeline_root=str(tmp_path / "root"),
+            metadata_path=str(tmp_path / "md.sqlite"),
+        )
+        return LocalDagRunner().run(p)
+
+    run()
+    assert CALLS == ["Ingest"]
+    run()
+    assert CALLS == ["Ingest"]  # same content -> cached
+    src.write_text("a,b\n9,9\n")  # edit external data, same path
+    run()
+    assert CALLS == ["Ingest", "Ingest"]  # content change re-runs

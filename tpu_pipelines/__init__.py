@@ -1,0 +1,22 @@
+"""tpu-pipelines: a TPU-native ML pipeline framework.
+
+A brand-new framework with the capabilities of the TFX-on-Kubeflow stack the
+reference workshop (`pablomendes/kubeflow-tfx-workshop`) exercises — the
+canonical ExampleGen → StatisticsGen/SchemaGen/ExampleValidator → Transform →
+Trainer → Evaluator → Pusher DAG plus Tuner, InfraValidator and BulkInferrer —
+designed idiomatically for JAX/XLA on Cloud TPU rather than ported:
+
+- the compute path is ``jax.jit`` over a ``jax.sharding.Mesh`` (collectives
+  ride ICI/DCN instead of NCCL),
+- preprocessing analyzers are jitted tree-reductions rather than Beam jobs,
+- checkpointing is Orbax, input pipelines are Grain/Arrow,
+- the cluster runner emits TPU pod specs instead of GPU TFJobs.
+
+See SURVEY.md at the repo root for the full blueprint (note its §0 evidence
+caveat: the reference tree was not available; the capability surface is built
+from BASELINE.json and the public TFX architecture).
+"""
+
+__version__ = "0.1.0"
+
+from tpu_pipelines.dsl.pipeline import Pipeline  # noqa: F401

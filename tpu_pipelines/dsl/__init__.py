@@ -1,0 +1,19 @@
+"""Pipeline DSL: artifact types, channels, components, pipeline, compiler.
+
+TPU-native equivalent of TFX's L2/L3 layers (SURVEY.md §1): a ``Component`` is
+a typed spec (inputs / outputs / exec-properties) plus an executor function; a
+``Pipeline`` wires components through ``Channel``s; the compiler lowers the DSL
+to a JSON-serializable IR that runners execute.
+"""
+
+from tpu_pipelines.dsl.artifact_types import ARTIFACT_TYPES, standard_artifacts  # noqa: F401
+from tpu_pipelines.dsl.component import (  # noqa: F401
+    Channel,
+    Component,
+    ComponentSpec,
+    ExecutorContext,
+    Parameter,
+    RuntimeParameter,
+)
+from tpu_pipelines.dsl.pipeline import Pipeline  # noqa: F401
+from tpu_pipelines.dsl.compiler import Compiler, PipelineIR  # noqa: F401

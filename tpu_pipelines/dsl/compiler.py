@@ -1,0 +1,160 @@
+"""Compiler: DSL Pipeline → JSON-serializable IR.
+
+Equivalent of TFX's DSL→pipeline-IR-proto compile step (SURVEY.md §1 L3).
+The IR is what runners consume: the local runner walks it in-process; the
+cluster runner renders one pod spec per IR node.  Golden-IR tests pin the
+format (SURVEY.md §4 "Compiler/IR tests").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List
+
+from tpu_pipelines.dsl.component import Component, RuntimeParameter
+from tpu_pipelines.dsl.pipeline import Pipeline
+from tpu_pipelines.utils.fingerprint import fingerprint_callable
+
+IR_SCHEMA_VERSION = "tpu-pipelines-ir/v1"
+
+_RUNTIME_PARAM_KEY = "__runtime_parameter__"
+
+
+def encode_property(value: Any) -> Any:
+    if isinstance(value, RuntimeParameter):
+        return {_RUNTIME_PARAM_KEY: value.name, "default": value.default}
+    return value
+
+
+def is_runtime_param(value: Any) -> bool:
+    return isinstance(value, dict) and _RUNTIME_PARAM_KEY in value
+
+
+def resolve_property(value: Any, runtime_parameters: Dict[str, Any]) -> Any:
+    if is_runtime_param(value):
+        name = value[_RUNTIME_PARAM_KEY]
+        return runtime_parameters.get(name, value.get("default"))
+    return value
+
+
+@dataclasses.dataclass
+class InputRef:
+    producer: str       # producing node id; "" for external inputs
+    output_key: str
+    type_name: str
+
+    def to_json(self) -> Dict[str, str]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class NodeIR:
+    id: str
+    component_type: str
+    inputs: Dict[str, List[InputRef]]
+    outputs: Dict[str, str]                 # key -> artifact type
+    exec_properties: Dict[str, Any]
+    executor_version: str
+    upstream: List[str]
+    # Exec-property keys holding external data paths; the driver fingerprints
+    # their content into the cache key (stale-cache guard for ingestion).
+    external_input_parameters: List[str] = dataclasses.field(default_factory=list)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "id": self.id,
+            "component_type": self.component_type,
+            "inputs": {
+                k: [r.to_json() for r in refs] for k, refs in self.inputs.items()
+            },
+            "outputs": dict(self.outputs),
+            "exec_properties": self.exec_properties,
+            "executor_version": self.executor_version,
+            "upstream": list(self.upstream),
+            "external_input_parameters": list(self.external_input_parameters),
+        }
+
+
+@dataclasses.dataclass
+class PipelineIR:
+    name: str
+    pipeline_root: str
+    metadata_path: str
+    enable_cache: bool
+    nodes: List[NodeIR]
+    schema_version: str = IR_SCHEMA_VERSION
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "schema_version": self.schema_version,
+            "name": self.name,
+            "pipeline_root": self.pipeline_root,
+            "metadata_path": self.metadata_path,
+            "enable_cache": self.enable_cache,
+            "nodes": [n.to_json() for n in self.nodes],
+        }
+
+    def to_json_str(self, indent: int = 2) -> str:
+        return json.dumps(self.to_json(), indent=indent, sort_keys=True, default=str)
+
+    def node(self, node_id: str) -> NodeIR:
+        for n in self.nodes:
+            if n.id == node_id:
+                return n
+        raise KeyError(node_id)
+
+
+class Compiler:
+    def compile(self, pipeline: Pipeline) -> PipelineIR:
+        nodes: List[NodeIR] = []
+        for comp in pipeline.components:
+            inputs: Dict[str, List[InputRef]] = {}
+            upstream: List[str] = []
+            for key, chans in comp.input_channels.items():
+                refs = []
+                for ch in chans:
+                    producer_id = ch.producer.id if ch.producer else ""
+                    refs.append(
+                        InputRef(
+                            producer=producer_id,
+                            output_key=ch.output_key,
+                            type_name=ch.type_name,
+                        )
+                    )
+                    if producer_id and producer_id not in upstream:
+                        upstream.append(producer_id)
+                inputs[key] = refs
+            executor_version = self._executor_version(comp)
+            nodes.append(
+                NodeIR(
+                    id=comp.id,
+                    component_type=type(comp).__name__,
+                    inputs=inputs,
+                    outputs=dict(comp.SPEC.outputs),
+                    exec_properties={
+                        k: encode_property(v)
+                        for k, v in sorted(comp.exec_properties.items())
+                    },
+                    executor_version=executor_version,
+                    upstream=upstream,
+                    external_input_parameters=sorted(
+                        comp.EXTERNAL_INPUT_PARAMETERS
+                    ),
+                )
+            )
+        return PipelineIR(
+            name=pipeline.name,
+            pipeline_root=pipeline.pipeline_root,
+            metadata_path=pipeline.metadata_path,
+            enable_cache=pipeline.enable_cache,
+            nodes=nodes,
+        )
+
+    @staticmethod
+    def _executor_version(comp: Component) -> str:
+        if comp.EXECUTOR is None:
+            return "no-executor"
+        base = fingerprint_callable(comp.EXECUTOR)
+        salt = comp.CACHE_SALT
+        return f"{base}:{salt}" if salt else base

@@ -1,0 +1,232 @@
+"""Component model: spec + executor, wired by typed channels.
+
+A component is (1) a declarative spec — typed input/output channels and
+exec-properties — and (2) an executor function invoked by a runner's launcher
+with resolved artifacts.  This mirrors the TFX component = spec + driver +
+executor split (SURVEY.md §2a); the driver half (input resolution, caching)
+lives in the orchestrator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Type
+
+from tpu_pipelines.dsl.artifact_types import ARTIFACT_TYPES
+from tpu_pipelines.metadata.types import Artifact
+
+
+class Channel:
+    """A typed edge: references a producer component's output key.
+
+    Channels are how the Pipeline discovers the DAG — no explicit edge list;
+    dependency = consuming another component's output channel, exactly like
+    TFX's ``Channel``/artifact-query model.
+    """
+
+    def __init__(
+        self,
+        type_name: str,
+        producer: Optional["Component"] = None,
+        output_key: str = "",
+    ):
+        if type_name not in ARTIFACT_TYPES:
+            raise ValueError(f"Unknown artifact type: {type_name!r}")
+        self.type_name = type_name
+        self.producer = producer
+        self.output_key = output_key
+
+    def __repr__(self) -> str:
+        src = (
+            f"{self.producer.id}.{self.output_key}" if self.producer else "<external>"
+        )
+        return f"Channel({self.type_name} from {src})"
+
+
+@dataclasses.dataclass
+class Parameter:
+    """Declared exec-property: type-checked, defaultable."""
+
+    type: type = object
+    default: Any = None
+    required: bool = False
+
+
+class RuntimeParameter:
+    """Deploy-time placeholder substituted by the runner at run start.
+
+    Equivalent of TFX's ``RuntimeParameter`` (SURVEY.md §5 config system):
+    the compiled IR stores the placeholder; ``Runner.run(...,
+    runtime_parameters={name: value})`` substitutes it.
+    """
+
+    def __init__(self, name: str, default: Any = None):
+        self.name = name
+        self.default = default
+
+    def __repr__(self) -> str:
+        return f"RuntimeParameter({self.name!r}, default={self.default!r})"
+
+
+@dataclasses.dataclass
+class ComponentSpec:
+    inputs: Dict[str, str] = dataclasses.field(default_factory=dict)    # key -> artifact type
+    outputs: Dict[str, str] = dataclasses.field(default_factory=dict)   # key -> artifact type
+    parameters: Dict[str, Parameter] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ExecutorContext:
+    """Everything an executor sees: resolved artifacts + properties.
+
+    ``inputs``/``outputs`` map spec keys to artifact lists; output artifact
+    uris are pre-allocated directories the executor writes into.  ``extras``
+    carries runner-provided handles (mesh config, metadata store for
+    sub-lineage, tmp dir).
+    """
+
+    node_id: str
+    inputs: Dict[str, List[Artifact]]
+    outputs: Dict[str, List[Artifact]]
+    exec_properties: Dict[str, Any]
+    tmp_dir: str = ""
+    extras: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def input(self, key: str) -> Artifact:
+        arts = self.inputs.get(key) or []
+        if not arts:
+            raise KeyError(f"{self.node_id}: no input artifact for {key!r}")
+        return arts[0]
+
+    def output(self, key: str) -> Artifact:
+        arts = self.outputs.get(key) or []
+        if not arts:
+            raise KeyError(f"{self.node_id}: no output artifact for {key!r}")
+        return arts[0]
+
+
+# Executor: a plain callable.  Returning a dict merges those entries into the
+# execution's recorded properties (e.g. examples/sec from the Trainer).
+ExecutorFn = Callable[[ExecutorContext], Optional[Dict[str, Any]]]
+
+
+class Component:
+    """Base class for pipeline nodes.
+
+    Subclasses declare ``SPEC`` and ``EXECUTOR``; instances are constructed
+    with channels for spec inputs and values for spec parameters::
+
+        stats = StatisticsGen(examples=example_gen.outputs["examples"])
+
+    Instances expose ``.outputs[key]`` channels for downstream wiring.
+    """
+
+    SPEC: ComponentSpec = ComponentSpec()
+    EXECUTOR: Optional[ExecutorFn] = None
+    # Bump or override to invalidate cached executions when semantics change
+    # in ways source-hashing can't see (e.g. data format revision).
+    CACHE_SALT: str = ""
+    # Exec-property keys whose values are *external* filesystem paths (data
+    # the pipeline ingests but no upstream node produced).  The driver
+    # fingerprints the referenced content into the cache key, so editing the
+    # file invalidates the cache even though the path string is unchanged —
+    # the equivalent of TFX ExampleGen's input-fingerprint/span mechanism.
+    EXTERNAL_INPUT_PARAMETERS: tuple = ()
+
+    def __init__(self, instance_name: str = "", **kwargs: Any):
+        cls = type(self)
+        self.id = instance_name or cls.__name__
+        self.input_channels: Dict[str, List[Channel]] = {}
+        self.exec_properties: Dict[str, Any] = {}
+
+        for key, value in kwargs.items():
+            if key in self.SPEC.inputs:
+                chans = value if isinstance(value, list) else [value]
+                for ch in chans:
+                    if not isinstance(ch, Channel):
+                        raise TypeError(
+                            f"{self.id}: input {key!r} must be a Channel, got "
+                            f"{type(ch).__name__}"
+                        )
+                    expected = self.SPEC.inputs[key]
+                    if ch.type_name != expected:
+                        raise TypeError(
+                            f"{self.id}: input {key!r} expects artifact type "
+                            f"{expected}, got {ch.type_name}"
+                        )
+                self.input_channels[key] = chans
+            elif key in self.SPEC.parameters:
+                self.exec_properties[key] = value
+            else:
+                raise TypeError(f"{self.id}: unknown argument {key!r}")
+
+        for key, param in self.SPEC.parameters.items():
+            if key not in self.exec_properties:
+                if param.required:
+                    raise TypeError(f"{self.id}: missing required parameter {key!r}")
+                self.exec_properties[key] = param.default
+
+        missing = [
+            k for k in self.SPEC.inputs if k not in self.input_channels
+        ]
+        if missing:
+            raise TypeError(f"{self.id}: missing required inputs {missing}")
+
+        self.outputs: Dict[str, Channel] = {
+            key: Channel(type_name, producer=self, output_key=key)
+            for key, type_name in self.SPEC.outputs.items()
+        }
+
+    @property
+    def upstream(self) -> List["Component"]:
+        deps = []
+        for chans in self.input_channels.values():
+            for ch in chans:
+                if ch.producer is not None:
+                    deps.append(ch.producer)
+        return deps
+
+    def with_id(self, instance_name: str) -> "Component":
+        self.id = instance_name
+        return self
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}(id={self.id!r})"
+
+
+def component(
+    inputs: Optional[Dict[str, str]] = None,
+    outputs: Optional[Dict[str, str]] = None,
+    parameters: Optional[Dict[str, Parameter]] = None,
+    name: Optional[str] = None,
+    external_input_parameters: tuple = (),
+) -> Callable[[ExecutorFn], Type[Component]]:
+    """Decorator: build a Component subclass from a bare executor function.
+
+    ::
+
+        @component(inputs={"examples": "Examples"},
+                   outputs={"statistics": "ExampleStatistics"})
+        def StatisticsGen(ctx):
+            ...
+    """
+
+    def wrap(fn: ExecutorFn) -> Type[Component]:
+        cls_name = name or fn.__name__
+        spec = ComponentSpec(
+            inputs=dict(inputs or {}),
+            outputs=dict(outputs or {}),
+            parameters=dict(parameters or {}),
+        )
+        return type(
+            cls_name,
+            (Component,),
+            {
+                "SPEC": spec,
+                "EXECUTOR": staticmethod(fn),
+                "__doc__": fn.__doc__,
+                "EXTERNAL_INPUT_PARAMETERS": tuple(external_input_parameters),
+            },
+        )
+
+    return wrap

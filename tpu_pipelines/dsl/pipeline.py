@@ -1,0 +1,71 @@
+"""Pipeline: a named DAG of components with a root artifact directory."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from tpu_pipelines.dsl.component import Component
+
+
+class Pipeline:
+    """A named collection of components; edges come from channel wiring.
+
+    ``pipeline_root`` is where artifact payloads live
+    (``<root>/<node>/<output_key>/<execution_id>/``); ``metadata_path`` is the
+    SQLite metadata store ( ``:memory:`` for tests).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        components: Sequence[Component],
+        pipeline_root: str,
+        metadata_path: str = ":memory:",
+        enable_cache: bool = True,
+    ):
+        self.name = name
+        self.pipeline_root = pipeline_root
+        self.metadata_path = metadata_path
+        self.enable_cache = enable_cache
+        self.components = self._closure_in_topo_order(components)
+        ids = [c.id for c in self.components]
+        dupes = {i for i in ids if ids.count(i) > 1}
+        if dupes:
+            raise ValueError(
+                f"Pipeline {name!r}: duplicate component ids {sorted(dupes)}; "
+                "use .with_id() to disambiguate"
+            )
+
+    @staticmethod
+    def _closure_in_topo_order(components: Sequence[Component]) -> List[Component]:
+        """Transitive closure over upstream producers, topologically sorted.
+
+        Deterministic: stable DFS post-order over the declaration order, so
+        compiling the same pipeline twice yields byte-identical IR.
+        """
+        order: List[Component] = []
+        state: Dict[int, int] = {}  # id(component) -> 0 visiting / 1 done
+
+        def visit(c: Component, chain: List[str]) -> None:
+            s = state.get(id(c))
+            if s == 1:
+                return
+            if s == 0:
+                raise ValueError(
+                    f"Pipeline has a cycle through: {' -> '.join(chain + [c.id])}"
+                )
+            state[id(c)] = 0
+            for dep in c.upstream:
+                visit(dep, chain + [c.id])
+            state[id(c)] = 1
+            order.append(c)
+
+        for c in components:
+            visit(c, [])
+        return order
+
+    def get(self, component_id: str) -> Optional[Component]:
+        for c in self.components:
+            if c.id == component_id:
+                return c
+        return None

@@ -1,0 +1,17 @@
+"""Metadata plane: typed artifacts, executions, lineage, execution cache.
+
+TPU-native equivalent of ml-metadata (MLMD) — the cross-cutting LX layer in
+SURVEY.md §1. Implements the MLMD data model (Artifact / Execution / Context /
+Event) over SQLite with a content-keyed execution cache.
+"""
+
+from tpu_pipelines.metadata.types import (  # noqa: F401
+    Artifact,
+    ArtifactState,
+    Event,
+    EventType,
+    Execution,
+    ExecutionState,
+    Context,
+)
+from tpu_pipelines.metadata.store import MetadataStore  # noqa: F401

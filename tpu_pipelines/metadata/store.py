@@ -1,0 +1,476 @@
+"""SQLite-backed metadata store with lineage and execution-cache queries.
+
+TPU-native equivalent of ml-metadata's ``MetadataStore`` (SURVEY.md §2b): same
+data model (artifacts, executions, contexts, events), embedded SQLite instead
+of a C++ gRPC service.  The store is the single writer for pipeline state; the
+orchestrator serializes access per run, so no cross-process locking beyond
+SQLite's own is needed.
+
+Concurrency discipline: one connection per store instance; WAL mode for
+file-backed stores so concurrent reader processes (lineage CLI, UI) never
+block the writer.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from tpu_pipelines.metadata.types import (
+    Artifact,
+    ArtifactState,
+    Context,
+    Event,
+    EventType,
+    Execution,
+    ExecutionState,
+    LineageNode,
+)
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS artifacts (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    type_name TEXT NOT NULL,
+    uri TEXT NOT NULL,
+    state TEXT NOT NULL,
+    properties TEXT NOT NULL,
+    fingerprint TEXT NOT NULL DEFAULT '',
+    create_time REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_artifacts_type ON artifacts(type_name);
+CREATE INDEX IF NOT EXISTS idx_artifacts_uri ON artifacts(uri);
+
+CREATE TABLE IF NOT EXISTS executions (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    type_name TEXT NOT NULL,
+    node_id TEXT NOT NULL,
+    state TEXT NOT NULL,
+    properties TEXT NOT NULL,
+    cache_key TEXT NOT NULL DEFAULT '',
+    create_time REAL NOT NULL,
+    update_time REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_exec_cache ON executions(cache_key);
+CREATE INDEX IF NOT EXISTS idx_exec_node ON executions(node_id);
+
+CREATE TABLE IF NOT EXISTS events (
+    artifact_id INTEGER NOT NULL,
+    execution_id INTEGER NOT NULL,
+    type TEXT NOT NULL,
+    path TEXT NOT NULL DEFAULT '',
+    idx INTEGER NOT NULL DEFAULT 0,
+    ts REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_events_artifact ON events(artifact_id);
+CREATE INDEX IF NOT EXISTS idx_events_execution ON events(execution_id);
+
+CREATE TABLE IF NOT EXISTS contexts (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    type_name TEXT NOT NULL,
+    name TEXT NOT NULL,
+    properties TEXT NOT NULL,
+    create_time REAL NOT NULL,
+    UNIQUE(type_name, name)
+);
+
+CREATE TABLE IF NOT EXISTS associations (      -- execution ∈ context
+    context_id INTEGER NOT NULL,
+    execution_id INTEGER NOT NULL,
+    UNIQUE(context_id, execution_id)
+);
+
+CREATE TABLE IF NOT EXISTS attributions (      -- artifact ∈ context
+    context_id INTEGER NOT NULL,
+    artifact_id INTEGER NOT NULL,
+    UNIQUE(context_id, artifact_id)
+);
+"""
+
+
+class MetadataStore:
+    """Embedded artifact/execution/lineage store.
+
+    Use ``MetadataStore(":memory:")`` for tests, a file path for real runs.
+    """
+
+    def __init__(self, db_path: str = ":memory:"):
+        self.db_path = db_path
+        self._lock = threading.RLock()
+        self._in_tx = False
+        self._conn = sqlite3.connect(db_path, check_same_thread=False)
+        with self._lock:
+            if db_path != ":memory:":
+                self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute("PRAGMA foreign_keys=ON")
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+
+    def _commit(self) -> None:
+        """Commit unless inside an explicit multi-write transaction."""
+        if not self._in_tx:
+            self._conn.commit()
+
+    def close(self) -> None:
+        self._conn.close()
+
+    # ------------------------------------------------------------- artifacts
+
+    def put_artifact(self, artifact: Artifact) -> int:
+        with self._lock:
+            if artifact.id:
+                self._conn.execute(
+                    "UPDATE artifacts SET type_name=?, uri=?, state=?, "
+                    "properties=?, fingerprint=?, create_time=? WHERE id=?",
+                    artifact.to_row() + (artifact.id,),
+                )
+            else:
+                cur = self._conn.execute(
+                    "INSERT INTO artifacts "
+                    "(type_name, uri, state, properties, fingerprint, create_time) "
+                    "VALUES (?,?,?,?,?,?)",
+                    artifact.to_row(),
+                )
+                artifact.id = cur.lastrowid
+            self._commit()
+            return artifact.id
+
+    def get_artifact(self, artifact_id: int) -> Optional[Artifact]:
+        row = self._conn.execute(
+            "SELECT * FROM artifacts WHERE id=?", (artifact_id,)
+        ).fetchone()
+        return Artifact.from_row(row) if row else None
+
+    def get_artifacts(
+        self, type_name: Optional[str] = None, state: Optional[ArtifactState] = None
+    ) -> List[Artifact]:
+        q, args = "SELECT * FROM artifacts", []
+        clauses = []
+        if type_name:
+            clauses.append("type_name=?")
+            args.append(type_name)
+        if state:
+            clauses.append("state=?")
+            args.append(state.value)
+        if clauses:
+            q += " WHERE " + " AND ".join(clauses)
+        return [Artifact.from_row(r) for r in self._conn.execute(q, args)]
+
+    def get_artifacts_by_uri(self, uri: str) -> List[Artifact]:
+        rows = self._conn.execute("SELECT * FROM artifacts WHERE uri=?", (uri,))
+        return [Artifact.from_row(r) for r in rows]
+
+    # ------------------------------------------------------------ executions
+
+    def put_execution(self, execution: Execution) -> int:
+        execution.update_time = time.time()
+        with self._lock:
+            if execution.id:
+                self._conn.execute(
+                    "UPDATE executions SET type_name=?, node_id=?, state=?, "
+                    "properties=?, cache_key=?, create_time=?, update_time=? "
+                    "WHERE id=?",
+                    execution.to_row() + (execution.id,),
+                )
+            else:
+                cur = self._conn.execute(
+                    "INSERT INTO executions (type_name, node_id, state, "
+                    "properties, cache_key, create_time, update_time) "
+                    "VALUES (?,?,?,?,?,?,?)",
+                    execution.to_row(),
+                )
+                execution.id = cur.lastrowid
+            self._commit()
+            return execution.id
+
+    def get_execution(self, execution_id: int) -> Optional[Execution]:
+        row = self._conn.execute(
+            "SELECT * FROM executions WHERE id=?", (execution_id,)
+        ).fetchone()
+        return Execution.from_row(row) if row else None
+
+    def get_executions(
+        self,
+        node_id: Optional[str] = None,
+        state: Optional[ExecutionState] = None,
+    ) -> List[Execution]:
+        q, args = "SELECT * FROM executions", []
+        clauses = []
+        if node_id:
+            clauses.append("node_id=?")
+            args.append(node_id)
+        if state:
+            clauses.append("state=?")
+            args.append(state.value)
+        if clauses:
+            q += " WHERE " + " AND ".join(clauses)
+        q += " ORDER BY id"
+        return [Execution.from_row(r) for r in self._conn.execute(q, args)]
+
+    # ---------------------------------------------------------------- events
+
+    def put_events(self, events: Iterable[Event]) -> None:
+        with self._lock:
+            self._conn.executemany(
+                "INSERT INTO events (artifact_id, execution_id, type, path, idx, ts) "
+                "VALUES (?,?,?,?,?,?)",
+                [(e.artifact_id, e.execution_id, e.type.value, e.path, e.index, e.ts)
+                 for e in events],
+            )
+            self._commit()
+
+    def get_events_by_execution(self, execution_id: int) -> List[Event]:
+        rows = self._conn.execute(
+            "SELECT artifact_id, execution_id, type, path, idx, ts FROM events "
+            "WHERE execution_id=? ORDER BY rowid",
+            (execution_id,),
+        )
+        return [
+            Event(r[0], r[1], EventType(r[2]), r[3], r[4], r[5]) for r in rows
+        ]
+
+    def get_events_by_artifact(self, artifact_id: int) -> List[Event]:
+        rows = self._conn.execute(
+            "SELECT artifact_id, execution_id, type, path, idx, ts FROM events "
+            "WHERE artifact_id=? ORDER BY rowid",
+            (artifact_id,),
+        )
+        return [
+            Event(r[0], r[1], EventType(r[2]), r[3], r[4], r[5]) for r in rows
+        ]
+
+    # -------------------------------------------------------------- contexts
+
+    def put_context(self, context: Context) -> int:
+        """Insert or fetch-by-unique-name; returns the context id."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT id FROM contexts WHERE type_name=? AND name=?",
+                (context.type_name, context.name),
+            ).fetchone()
+            if row:
+                context.id = row[0]
+                return context.id
+            cur = self._conn.execute(
+                "INSERT INTO contexts (type_name, name, properties, create_time) "
+                "VALUES (?,?,?,?)",
+                (
+                    context.type_name,
+                    context.name,
+                    json.dumps(context.properties, sort_keys=True, default=str),
+                    context.create_time,
+                ),
+            )
+            context.id = cur.lastrowid
+            self._commit()
+            return context.id
+
+    def get_context(self, type_name: str, name: str) -> Optional[Context]:
+        row = self._conn.execute(
+            "SELECT id, type_name, name, properties, create_time FROM contexts "
+            "WHERE type_name=? AND name=?",
+            (type_name, name),
+        ).fetchone()
+        if not row:
+            return None
+        ctx = Context(
+            type_name=row[1], name=row[2], properties=json.loads(row[3]),
+            create_time=row[4],
+        )
+        ctx.id = row[0]
+        return ctx
+
+    def associate(self, context_id: int, execution_id: int) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR IGNORE INTO associations (context_id, execution_id) "
+                "VALUES (?,?)",
+                (context_id, execution_id),
+            )
+            self._commit()
+
+    def attribute(self, context_id: int, artifact_id: int) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR IGNORE INTO attributions (context_id, artifact_id) "
+                "VALUES (?,?)",
+                (context_id, artifact_id),
+            )
+            self._commit()
+
+    def get_executions_by_context(self, context_id: int) -> List[Execution]:
+        rows = self._conn.execute(
+            "SELECT e.* FROM executions e "
+            "JOIN associations a ON a.execution_id = e.id "
+            "WHERE a.context_id=? ORDER BY e.id",
+            (context_id,),
+        )
+        return [Execution.from_row(r) for r in rows]
+
+    def get_artifacts_by_context(self, context_id: int) -> List[Artifact]:
+        rows = self._conn.execute(
+            "SELECT ar.* FROM artifacts ar "
+            "JOIN attributions at ON at.artifact_id = ar.id "
+            "WHERE at.context_id=? ORDER BY ar.id",
+            (context_id,),
+        )
+        return [Artifact.from_row(r) for r in rows]
+
+    # ---------------------------------------------------- composite publish
+
+    def publish_execution(
+        self,
+        execution: Execution,
+        input_artifacts: Dict[str, Sequence[Artifact]],
+        output_artifacts: Dict[str, Sequence[Artifact]],
+        contexts: Sequence[Context] = (),
+    ) -> Execution:
+        """Atomically record an execution with its I/O events and contexts.
+
+        Output artifacts are persisted (assigned ids) and marked LIVE when the
+        execution completed, ABANDONED when it failed.  The whole publish is a
+        single SQLite transaction: a crash mid-publish leaves no COMPLETE
+        execution without its output events (which would poison the cache).
+        """
+        with self._lock:
+            self._in_tx = True
+            try:
+                self._publish_locked(
+                    execution, input_artifacts, output_artifacts, contexts
+                )
+                self._conn.commit()
+            except BaseException:
+                self._conn.rollback()
+                raise
+            finally:
+                self._in_tx = False
+            return execution
+
+    def _publish_locked(
+        self,
+        execution: Execution,
+        input_artifacts: Dict[str, Sequence[Artifact]],
+        output_artifacts: Dict[str, Sequence[Artifact]],
+        contexts: Sequence[Context] = (),
+    ) -> Execution:
+        with self._lock:
+            self.put_execution(execution)
+            events: List[Event] = []
+            for path, arts in input_artifacts.items():
+                for i, art in enumerate(arts):
+                    assert art.id, f"input artifact {path}[{i}] not persisted"
+                    events.append(
+                        Event(art.id, execution.id, EventType.INPUT, path, i)
+                    )
+            ok = execution.state in (ExecutionState.COMPLETE, ExecutionState.CACHED)
+            for path, arts in output_artifacts.items():
+                for i, art in enumerate(arts):
+                    art.state = (
+                        ArtifactState.LIVE if ok else ArtifactState.ABANDONED
+                    )
+                    self.put_artifact(art)
+                    events.append(
+                        Event(art.id, execution.id, EventType.OUTPUT, path, i)
+                    )
+            self.put_events(events)
+            for ctx in contexts:
+                self.put_context(ctx)
+                self.associate(ctx.id, execution.id)
+                for arts in output_artifacts.values():
+                    for art in arts:
+                        self.attribute(ctx.id, art.id)
+            return execution
+
+    # -------------------------------------------------------- cache queries
+
+    def get_cached_outputs(
+        self, cache_key: str
+    ) -> Optional[Dict[str, List[Artifact]]]:
+        """Outputs of the latest COMPLETE execution with this cache key.
+
+        Returns None on cache miss, or if any cached output artifact is no
+        longer LIVE (e.g. garbage-collected payload).
+        """
+        if not cache_key:
+            return None
+        row = self._conn.execute(
+            "SELECT id FROM executions WHERE cache_key=? AND state=? "
+            "ORDER BY id DESC LIMIT 1",
+            (cache_key, ExecutionState.COMPLETE.value),
+        ).fetchone()
+        if not row:
+            return None
+        outputs: Dict[str, List[Artifact]] = {}
+        for ev in self.get_events_by_execution(row[0]):
+            if ev.type != EventType.OUTPUT:
+                continue
+            art = self.get_artifact(ev.artifact_id)
+            if art is None or art.state != ArtifactState.LIVE:
+                return None
+            outputs.setdefault(ev.path, []).append((ev.index, art))
+        if not outputs:
+            # A COMPLETE execution with no recorded outputs is corrupt state
+            # (e.g. interrupted legacy publish), never a usable cache hit.
+            return None
+        return {
+            path: [a for _, a in sorted(pairs, key=lambda p: p[0])]
+            for path, pairs in outputs.items()
+        }
+
+    # ------------------------------------------------------ lineage queries
+
+    def get_lineage(self, artifact_id: int, max_depth: int = 20) -> Optional[LineageNode]:
+        """Provenance tree: artifact ← producing execution ← its inputs ← ..."""
+        art = self.get_artifact(artifact_id)
+        if art is None:
+            return None
+        return self._lineage_node(art, max_depth, seen=set())
+
+    def _lineage_node(self, art: Artifact, depth: int, seen: set) -> LineageNode:
+        if depth <= 0 or art.id in seen:
+            return LineageNode(artifact=art, producer=None, parents=[])
+        seen = seen | {art.id}
+        producer: Optional[Execution] = None
+        parents: List[LineageNode] = []
+        for ev in self.get_events_by_artifact(art.id):
+            if ev.type != EventType.OUTPUT:
+                continue
+            producer = self.get_execution(ev.execution_id)
+            if producer is None:
+                continue
+            for pev in self.get_events_by_execution(producer.id):
+                if pev.type != EventType.INPUT:
+                    continue
+                parent_art = self.get_artifact(pev.artifact_id)
+                if parent_art is not None:
+                    parents.append(
+                        self._lineage_node(parent_art, depth - 1, seen)
+                    )
+            break  # one producer per artifact
+        return LineageNode(artifact=art, producer=producer, parents=parents)
+
+    def format_lineage(self, artifact_id: int) -> str:
+        """Human-readable provenance chain for the lineage CLI."""
+        root = self.get_lineage(artifact_id)
+        if root is None:
+            return f"<no artifact {artifact_id}>"
+        lines: List[str] = []
+
+        def walk(node: LineageNode, indent: int) -> None:
+            a = node.artifact
+            prod = (
+                f"  <- {node.producer.type_name}#{node.producer.id}"
+                f" [{node.producer.state.value}]"
+                if node.producer
+                else ""
+            )
+            lines.append(
+                "  " * indent + f"{a.type_name}#{a.id} @ {a.uri}{prod}"
+            )
+            for p in node.parents:
+                walk(p, indent + 1)
+
+        walk(root, 0)
+        return "\n".join(lines)

@@ -1,0 +1,12 @@
+"""Orchestration: local DAG runner, cluster spec emitter, multi-host bootstrap.
+
+TPU-native equivalent of TFX's L4 orchestration layer plus the Kubeflow/Argo
+substrate interface (SURVEY.md §1, §3.1, §3.2).
+"""
+
+from tpu_pipelines.orchestration.local_runner import (  # noqa: F401
+    LocalDagRunner,
+    NodeResult,
+    PipelineRunError,
+    RunResult,
+)

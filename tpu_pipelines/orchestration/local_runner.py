@@ -1,0 +1,417 @@
+"""Local DAG runner: topological execution with caching, retry, partial runs.
+
+Equivalent of TFX's ``LocalDagRunner`` + launcher stack (SURVEY.md §3.1):
+
+    run(pipeline)
+    └─ compile DSL → IR
+    └─ for node in topo order:
+       ├─ DRIVER: resolve input artifacts; compute content cache key;
+       │          cache hit ⇒ publish CACHED execution reusing outputs
+       ├─ LAUNCHER: allocate output artifact dirs; invoke executor
+       │            (with per-node retry — the Argo retryStrategy equivalent)
+       └─ PUBLISHER: fingerprint outputs, mark LIVE, record execution +
+                     lineage events + contexts in the metadata store
+
+The orchestrator is cold control plane; all hot work happens inside executors
+(jitted train/transform steps).  Single-writer metadata discipline: only this
+loop writes to the store during a run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import shutil
+import tempfile
+import time
+import traceback
+from typing import Any, Dict, List, Optional, Sequence
+
+from tpu_pipelines.dsl.compiler import (
+    Compiler,
+    NodeIR,
+    PipelineIR,
+    resolve_property,
+)
+from tpu_pipelines.dsl.component import ExecutorContext
+from tpu_pipelines.dsl.pipeline import Pipeline
+from tpu_pipelines.metadata.store import MetadataStore
+from tpu_pipelines.metadata.types import (
+    Artifact,
+    ArtifactState,
+    Context,
+    Execution,
+    ExecutionState,
+)
+from tpu_pipelines.utils.fingerprint import execution_cache_key, fingerprint_dir
+
+log = logging.getLogger("tpu_pipelines.runner")
+
+
+class PipelineRunError(RuntimeError):
+    def __init__(self, message: str, result: "RunResult"):
+        super().__init__(message)
+        self.result = result
+
+
+@dataclasses.dataclass
+class NodeResult:
+    node_id: str
+    status: str                      # COMPLETE | CACHED | FAILED | SKIPPED
+    execution_id: int = 0
+    outputs: Dict[str, List[Artifact]] = dataclasses.field(default_factory=dict)
+    error: str = ""
+    wall_clock_s: float = 0.0
+    retries: int = 0
+
+
+@dataclasses.dataclass
+class RunResult:
+    pipeline_name: str
+    run_id: str
+    nodes: Dict[str, NodeResult] = dataclasses.field(default_factory=dict)
+
+    @property
+    def succeeded(self) -> bool:
+        return all(
+            n.status in ("COMPLETE", "CACHED", "SKIPPED")
+            for n in self.nodes.values()
+        )
+
+    def outputs_of(self, node_id: str, key: str) -> List[Artifact]:
+        return self.nodes[node_id].outputs.get(key, [])
+
+
+class LocalDagRunner:
+    """In-process topological pipeline runner.
+
+    ``max_retries`` applies per node (transient-failure tolerance — the
+    substrate-level retry the reference delegates to Argo/TFJob, SURVEY.md §5
+    failure detection).  Idempotence contract: executors write only under
+    their output artifact uris and tmp dir, so a retry starts clean.
+    """
+
+    def __init__(self, max_retries: int = 0):
+        self.max_retries = max_retries
+
+    def run(
+        self,
+        pipeline: Pipeline,
+        runtime_parameters: Optional[Dict[str, Any]] = None,
+        run_id: Optional[str] = None,
+        from_nodes: Optional[Sequence[str]] = None,
+        to_nodes: Optional[Sequence[str]] = None,
+        raise_on_failure: bool = True,
+        extras: Optional[Dict[str, Any]] = None,
+    ) -> RunResult:
+        """Execute the pipeline.
+
+        ``from_nodes``/``to_nodes`` bound a partial run (TFX partial-run
+        semantics): nodes outside the range are not executed; their outputs are
+        resolved from the latest LIVE artifacts already in the metadata store.
+        """
+        ir = Compiler().compile(pipeline)
+        executors = {c.id: c for c in pipeline.components}
+        store = MetadataStore(pipeline.metadata_path)
+        run_id = run_id or f"{pipeline.name}-{int(time.time() * 1000)}"
+        runtime_parameters = dict(runtime_parameters or {})
+
+        pipeline_ctx = Context("pipeline", pipeline.name)
+        run_ctx = Context(
+            "pipeline_run", f"{pipeline.name}.{run_id}",
+            properties={"run_id": run_id},
+        )
+        store.put_context(pipeline_ctx)
+        store.put_context(run_ctx)
+
+        selected = self._select_nodes(ir, from_nodes, to_nodes)
+        result = RunResult(pipeline_name=pipeline.name, run_id=run_id)
+        # node_id -> {output_key: [Artifact]} for this run's input resolution.
+        produced: Dict[str, Dict[str, List[Artifact]]] = {}
+        failed_upstream: set = set()
+
+        for node in ir.nodes:
+            if node.id not in selected:
+                outputs = self._resolve_prior_outputs(store, node)
+                produced[node.id] = outputs
+                result.nodes[node.id] = NodeResult(
+                    node_id=node.id, status="SKIPPED", outputs=outputs
+                )
+                continue
+            if any(u in failed_upstream for u in node.upstream):
+                failed_upstream.add(node.id)
+                result.nodes[node.id] = NodeResult(
+                    node_id=node.id,
+                    status="FAILED",
+                    error="upstream failure",
+                )
+                continue
+
+            node_result = self._run_node(
+                store, ir, node, executors[node.id], produced,
+                runtime_parameters, [pipeline_ctx, run_ctx],
+                extras=dict(extras or {}),
+                enable_cache=pipeline.enable_cache,
+            )
+            result.nodes[node.id] = node_result
+            if node_result.status in ("COMPLETE", "CACHED"):
+                produced[node.id] = node_result.outputs
+            else:
+                failed_upstream.add(node.id)
+
+        store.close()
+        if raise_on_failure and not result.succeeded:
+            bad = [n for n in result.nodes.values() if n.status == "FAILED"]
+            raise PipelineRunError(
+                f"Pipeline {pipeline.name!r} run {run_id} failed at: "
+                + ", ".join(f"{n.node_id} ({n.error.splitlines()[-1] if n.error else ''})" for n in bad),
+                result,
+            )
+        return result
+
+    # ------------------------------------------------------------ internals
+
+    @staticmethod
+    def _select_nodes(
+        ir: PipelineIR,
+        from_nodes: Optional[Sequence[str]],
+        to_nodes: Optional[Sequence[str]],
+    ) -> set:
+        all_ids = {n.id for n in ir.nodes}
+        for nid in list(from_nodes or []) + list(to_nodes or []):
+            if nid not in all_ids:
+                raise ValueError(f"Unknown node in partial-run bounds: {nid!r}")
+        selected = set(all_ids)
+        if from_nodes:
+            # keep only nodes downstream-of-or-equal-to any from_node
+            keep = set(from_nodes)
+            changed = True
+            while changed:
+                changed = False
+                for n in ir.nodes:
+                    if n.id not in keep and any(u in keep for u in n.upstream):
+                        keep.add(n.id)
+                        changed = True
+            selected &= keep
+        if to_nodes:
+            # keep only nodes upstream-of-or-equal-to any to_node
+            by_id = {n.id: n for n in ir.nodes}
+            keep = set()
+            stack = list(to_nodes)
+            while stack:
+                nid = stack.pop()
+                if nid in keep:
+                    continue
+                keep.add(nid)
+                stack.extend(by_id[nid].upstream)
+            selected &= keep
+        return selected
+
+    @staticmethod
+    def _resolve_prior_outputs(
+        store: MetadataStore, node: NodeIR
+    ) -> Dict[str, List[Artifact]]:
+        """Latest LIVE outputs of a node from prior runs (partial-run reuse)."""
+        outputs: Dict[str, List[Artifact]] = {}
+        for ex in reversed(store.get_executions(node_id=node.id)):
+            if ex.state not in (ExecutionState.COMPLETE, ExecutionState.CACHED):
+                continue
+            from tpu_pipelines.metadata.types import EventType
+
+            candidate: Dict[str, List[tuple]] = {}
+            live = True
+            for ev in store.get_events_by_execution(ex.id):
+                if ev.type != EventType.OUTPUT:
+                    continue
+                art = store.get_artifact(ev.artifact_id)
+                if art is None or art.state != ArtifactState.LIVE:
+                    live = False
+                    break
+                candidate.setdefault(ev.path, []).append((ev.index, art))
+            if live and candidate:
+                # Same event-index ordering as the cache path, so a SKIPPED
+                # node hands downstream the identical artifact order.
+                outputs = {
+                    path: [a for _, a in sorted(pairs, key=lambda p: p[0])]
+                    for path, pairs in candidate.items()
+                }
+                break
+        return outputs
+
+    def _run_node(
+        self,
+        store: MetadataStore,
+        ir: PipelineIR,
+        node: NodeIR,
+        component,
+        produced: Dict[str, Dict[str, List[Artifact]]],
+        runtime_parameters: Dict[str, Any],
+        contexts: List[Context],
+        extras: Dict[str, Any],
+        enable_cache: bool,
+    ) -> NodeResult:
+        t0 = time.time()
+        node_ctx = Context("node", f"{ir.name}.{node.id}")
+        store.put_context(node_ctx)
+        all_ctx = contexts + [node_ctx]
+
+        # ---- DRIVER: resolve inputs + cache check
+        try:
+            inputs = self._resolve_inputs(node, produced)
+        except KeyError as e:
+            return NodeResult(
+                node_id=node.id, status="FAILED",
+                error=f"input resolution failed: {e}",
+            )
+        props = {
+            k: resolve_property(v, runtime_parameters)
+            for k, v in node.exec_properties.items()
+        }
+        input_fps = {
+            key: [a.fingerprint or f"artifact:{a.id}" for a in arts]
+            for key, arts in inputs.items()
+        }
+        # External data named by path-valued exec-properties participates by
+        # content, so editing a source file invalidates the cache even though
+        # the path string is unchanged.
+        for param in node.external_input_parameters:
+            path = props.get(param)
+            if isinstance(path, str) and os.path.exists(path):
+                input_fps[f"__external__:{param}"] = [fingerprint_dir(path)]
+        cache_key = execution_cache_key(
+            node.id, node.executor_version, props, input_fps
+        )
+
+        if enable_cache:
+            cached = store.get_cached_outputs(cache_key)
+            if cached is not None:
+                ex = Execution(
+                    type_name=node.component_type,
+                    node_id=node.id,
+                    state=ExecutionState.CACHED,
+                    properties={"cache_hit": True},
+                    cache_key=cache_key,
+                )
+                store.publish_execution(ex, inputs, cached, all_ctx)
+                log.info("node %s: cache hit (execution %d)", node.id, ex.id)
+                return NodeResult(
+                    node_id=node.id,
+                    status="CACHED",
+                    execution_id=ex.id,
+                    outputs=cached,
+                    wall_clock_s=time.time() - t0,
+                )
+
+        # ---- LAUNCHER: register execution, allocate outputs, run executor
+        ex = Execution(
+            type_name=node.component_type,
+            node_id=node.id,
+            state=ExecutionState.RUNNING,
+            properties={},
+            cache_key=cache_key,
+        )
+        store.put_execution(ex)
+
+        outputs: Dict[str, List[Artifact]] = {}
+        for key, type_name in node.outputs.items():
+            uri = os.path.join(ir.pipeline_root, node.id, key, str(ex.id))
+            outputs[key] = [Artifact(type_name=type_name, uri=uri)]
+
+        error = ""
+        extra_props: Dict[str, Any] = {}
+        attempts = 1
+        executor = component.EXECUTOR
+        if executor is None:
+            error = f"component {node.id} has no executor"
+        else:
+            for attempt in range(self.max_retries + 1):
+                attempts = attempt + 1
+                tmp = tempfile.mkdtemp(prefix=f"tpp-{node.id}-")
+                try:
+                    for arts in outputs.values():
+                        for a in arts:
+                            if os.path.isdir(a.uri):
+                                shutil.rmtree(a.uri)  # clean slate on retry
+                            os.makedirs(a.uri, exist_ok=True)
+                    ctx = ExecutorContext(
+                        node_id=node.id,
+                        inputs=inputs,
+                        outputs=outputs,
+                        exec_properties=props,
+                        tmp_dir=tmp,
+                        extras=extras,
+                    )
+                    ret = executor(ctx)
+                    extra_props = dict(ret or {})
+                    error = ""
+                    break
+                except Exception:
+                    error = traceback.format_exc()
+                    log.warning(
+                        "node %s attempt %d/%d failed:\n%s",
+                        node.id, attempts, self.max_retries + 1, error,
+                    )
+                finally:
+                    shutil.rmtree(tmp, ignore_errors=True)
+
+        # ---- PUBLISHER
+        wall = time.time() - t0
+        ex.properties.update(extra_props)
+        ex.properties.update(
+            {"wall_clock_s": round(wall, 4), "retries": attempts - 1}
+        )
+        if error:
+            ex.state = ExecutionState.FAILED
+            ex.properties["error"] = error.splitlines()[-1] if error else ""
+            store.publish_execution(ex, inputs, outputs, all_ctx)
+            return NodeResult(
+                node_id=node.id, status="FAILED", execution_id=ex.id,
+                error=error, wall_clock_s=wall, retries=attempts - 1,
+            )
+        for arts in outputs.values():
+            for a in arts:
+                a.fingerprint = fingerprint_dir(a.uri)
+        ex.state = ExecutionState.COMPLETE
+        store.publish_execution(ex, inputs, outputs, all_ctx)
+        log.info(
+            "node %s: COMPLETE in %.2fs (execution %d)", node.id, wall, ex.id
+        )
+        return NodeResult(
+            node_id=node.id, status="COMPLETE", execution_id=ex.id,
+            outputs=outputs, wall_clock_s=wall, retries=attempts - 1,
+        )
+
+    @staticmethod
+    def _resolve_inputs(
+        node: NodeIR, produced: Dict[str, Dict[str, List[Artifact]]]
+    ) -> Dict[str, List[Artifact]]:
+        inputs: Dict[str, List[Artifact]] = {}
+        for key, refs in node.inputs.items():
+            arts: List[Artifact] = []
+            for ref in refs:
+                if not ref.producer:
+                    # Producer-less channels have no resolution mechanism yet;
+                    # ingestion goes through EXTERNAL_INPUT_PARAMETERS or an
+                    # Importer-style component.  Fail at driver time instead
+                    # of letting the executor crash (and retry) on a
+                    # configuration error.
+                    raise KeyError(
+                        f"{node.id}: input {key!r} is wired to a channel with "
+                        "no producer component; external data must enter via "
+                        "an ingestion component (e.g. ExampleGen path param)"
+                    )
+                up = produced.get(ref.producer)
+                if up is None:
+                    raise KeyError(
+                        f"{node.id}: upstream {ref.producer} produced nothing"
+                    )
+                got = up.get(ref.output_key)
+                if not got:
+                    raise KeyError(
+                        f"{node.id}: upstream {ref.producer} has no output "
+                        f"{ref.output_key!r}"
+                    )
+                arts.extend(got)
+            inputs[key] = arts
+        return inputs

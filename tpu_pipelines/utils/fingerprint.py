@@ -1,0 +1,89 @@
+"""Content fingerprinting for artifacts, executors and property bags.
+
+Cache correctness (SURVEY.md §7 "hard parts" #4) hinges on these keys: a
+cache key must change whenever (a) any input artifact's *payload* changes,
+(b) the node's exec-properties change, or (c) the executor code changes.
+Silent staleness poisons every downstream result, so fingerprints hash real
+file content — not mtimes — and executor versions hash the function's
+bytecode, not its name.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import inspect
+import json
+import os
+from typing import Any, Callable, Dict
+
+
+def sha256_hex(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def fingerprint_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def fingerprint_dir(root: str) -> str:
+    """Deterministic content hash of a directory tree (names + bytes)."""
+    h = hashlib.sha256()
+    if os.path.isfile(root):
+        return fingerprint_file(root)
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for name in sorted(filenames):
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, root)
+            h.update(rel.encode())
+            h.update(fingerprint_file(full).encode())
+    return h.hexdigest()
+
+
+def fingerprint_json(obj: Any) -> str:
+    """Hash of a JSON-serializable object (sorted keys, stable encoding)."""
+    return sha256_hex(
+        json.dumps(obj, sort_keys=True, default=str).encode("utf-8")
+    )
+
+
+def fingerprint_callable(fn: Callable) -> str:
+    """Version hash of an executor: source if available, else qualname.
+
+    Hashing source (rather than module version strings) means editing an
+    executor invalidates its cache entries automatically.
+    """
+    try:
+        src = inspect.getsource(fn)
+    except (OSError, TypeError):
+        src = f"{getattr(fn, '__module__', '?')}.{getattr(fn, '__qualname__', repr(fn))}"
+    return sha256_hex(src.encode("utf-8"))
+
+
+def execution_cache_key(
+    node_id: str,
+    executor_version: str,
+    exec_properties: Dict[str, Any],
+    input_fingerprints: Dict[str, list],
+) -> str:
+    """Content key for the execution cache.
+
+    ``input_fingerprints`` maps input key -> ordered list of artifact payload
+    fingerprints.  Node identity participates so a different node that happens
+    to share code and inputs does not alias this node's cache entries.
+    """
+    return fingerprint_json(
+        {
+            "node": node_id,
+            "executor": executor_version,
+            "props": exec_properties,
+            "inputs": input_fingerprints,
+        }
+    )
