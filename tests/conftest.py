@@ -1,8 +1,13 @@
-"""Test env: force an 8-device CPU mesh BEFORE jax initializes.
+"""Test env: force an 8-device CPU mesh BEFORE any jax computation runs.
 
 SURVEY.md §4: multi-device sharding/collective semantics are tested on a
 virtual CPU mesh (`--xla_force_host_platform_device_count=8`); real-TPU runs
 happen only via bench.py / the driver.
+
+Note: this machine's sitecustomize registers a TPU ("axon") backend at
+interpreter startup, so setting JAX_PLATFORMS in the environment here is too
+late — jax is already imported.  ``jax.config.update`` still wins as long as
+no devices have been touched yet.
 """
 
 import os
@@ -13,3 +18,7 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

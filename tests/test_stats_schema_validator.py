@@ -1,0 +1,138 @@
+"""StatisticsGen → SchemaGen → ExampleValidator chain."""
+
+import os
+
+import pytest
+
+from tpu_pipelines.components import (
+    CsvExampleGen,
+    ExampleValidator,
+    SchemaGen,
+    StatisticsGen,
+)
+from tpu_pipelines.components.example_validator import (
+    load_anomalies,
+    linf_categorical_distance,
+    validate_split,
+)
+from tpu_pipelines.data.schema import Feature, FeatureType, Schema
+from tpu_pipelines.data.statistics import load_statistics
+from tpu_pipelines.dsl.pipeline import Pipeline
+from tpu_pipelines.orchestration import LocalDagRunner, PipelineRunError
+
+TAXI_CSV = os.path.join(os.path.dirname(__file__), "testdata", "taxi_sample.csv")
+
+
+def _chain(tmp_path, **validator_params):
+    gen = CsvExampleGen(input_path=TAXI_CSV)
+    stats = StatisticsGen(examples=gen.outputs["examples"])
+    schema = SchemaGen(statistics=stats.outputs["statistics"])
+    validator = ExampleValidator(
+        statistics=stats.outputs["statistics"],
+        schema=schema.outputs["schema"],
+        **validator_params,
+    )
+    return Pipeline(
+        "dv", [validator], pipeline_root=str(tmp_path / "root"),
+        metadata_path=str(tmp_path / "md.sqlite"),
+    )
+
+
+def test_stats_values(tmp_path):
+    result = LocalDagRunner().run(_chain(tmp_path))
+    stats_uri = result.outputs_of("StatisticsGen", "statistics")[0].uri
+    stats = load_statistics(stats_uri)
+    assert set(stats) == {"train", "eval"}
+    train = stats["train"]
+    fare = train.features["fare"]
+    assert fare.type == "FLOAT"
+    assert fare.numeric.min <= fare.numeric.mean <= fare.numeric.max
+    assert sum(fare.numeric.histogram_counts) == train.num_examples
+    pay = train.features["payment_type"]
+    assert pay.type == "BYTES"
+    assert pay.string.unique == 2
+    assert {v for v, _ in pay.string.top_values} == {"Cash", "Credit Card"}
+
+
+def test_schema_inference(tmp_path):
+    result = LocalDagRunner().run(_chain(tmp_path))
+    schema = Schema.load(result.outputs_of("SchemaGen", "schema")[0].uri)
+    assert schema.features["fare"].type == FeatureType.FLOAT
+    assert schema.features["trip_start_hour"].type == FeatureType.INT
+    assert schema.features["payment_type"].type == FeatureType.BYTES
+    assert schema.features["payment_type"].domain == ["Cash", "Credit Card"]
+    assert schema.features["fare"].min_presence == 1.0
+
+
+def test_validator_clean_on_own_data(tmp_path):
+    result = LocalDagRunner().run(_chain(tmp_path))
+    anomalies_art = result.outputs_of("ExampleValidator", "anomalies")[0]
+    assert anomalies_art.properties["error_count"] == 0
+    assert load_anomalies(anomalies_art.uri) == []
+
+
+def test_validator_detects_anomalies():
+    # Validate taxi stats against a hostile schema, unit-level.
+    import pyarrow.csv as pacsv
+
+    from tpu_pipelines.data.statistics import compute_split_statistics
+
+    table = pacsv.read_csv(TAXI_CSV)
+    stats = compute_split_statistics("train", table)
+
+    schema = Schema(features={
+        "fare": Feature(name="fare", type=FeatureType.BYTES),           # wrong type
+        "gone": Feature(name="gone", type=FeatureType.INT),             # missing
+        "payment_type": Feature(                                        # narrow domain
+            name="payment_type", type=FeatureType.BYTES, domain=["Cash"]
+        ),
+        "trip_miles": Feature(                                          # narrow range
+            name="trip_miles", type=FeatureType.FLOAT,
+            min_value=1.0, max_value=2.0,
+        ),
+    })
+    kinds = {(a.feature, a.kind) for a in validate_split(stats, schema)}
+    assert ("fare", "TYPE_MISMATCH") in kinds
+    assert ("gone", "MISSING_FEATURE") in kinds
+    assert ("payment_type", "OUT_OF_DOMAIN") in kinds
+    assert ("trip_miles", "OUT_OF_RANGE") in kinds
+    assert ("company", "NEW_FEATURE") in kinds  # not in schema
+
+
+def test_validator_fails_pipeline_on_errors(tmp_path, monkeypatch):
+    # Force an anomaly by shrinking the domain cardinality threshold so
+    # 'company' becomes a closed domain, then validating eval against it is
+    # still clean — instead inject via baseline drift with impossible threshold.
+    p = _chain(tmp_path, drift_threshold=-1.0)
+    result = LocalDagRunner().run(p)  # no baseline -> no drift check; clean
+    assert result.succeeded
+
+    # Now re-validate with the eval stats as "baseline" of itself but a
+    # negative threshold — any nonzero distance flags drift.
+    stats_uri = result.outputs_of("StatisticsGen", "statistics")[0].uri
+    gen = CsvExampleGen(input_path=TAXI_CSV)
+    stats = StatisticsGen(examples=gen.outputs["examples"])
+    schema = SchemaGen(statistics=stats.outputs["statistics"])
+    validator = ExampleValidator(
+        statistics=stats.outputs["statistics"],
+        schema=schema.outputs["schema"],
+        baseline_statistics_uri=stats_uri,
+        drift_threshold=-1.0,
+    )
+    p2 = Pipeline(
+        "dv2", [validator], pipeline_root=str(tmp_path / "root2"),
+        metadata_path=str(tmp_path / "md2.sqlite"),
+    )
+    with pytest.raises(PipelineRunError, match="DRIFT"):
+        LocalDagRunner().run(p2)
+
+
+def test_linf_distance():
+    import pyarrow.csv as pacsv
+
+    from tpu_pipelines.data.statistics import compute_split_statistics
+
+    table = pacsv.read_csv(TAXI_CSV)
+    s = compute_split_statistics("train", table)
+    assert linf_categorical_distance(s, s, "payment_type") == 0.0
+    assert linf_categorical_distance(s, s, "fare") is None  # numeric

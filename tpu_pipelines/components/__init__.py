@@ -1,0 +1,13 @@
+"""Built-in pipeline components — the capability surface of SURVEY.md §2a.
+
+ExampleGen → StatisticsGen → SchemaGen → ExampleValidator → Transform →
+Trainer (+Tuner) → Evaluator → InfraValidator → Pusher, plus BulkInferrer.
+"""
+
+from tpu_pipelines.components.example_gen import (  # noqa: F401
+    CsvExampleGen,
+    ImportExampleGen,
+)
+from tpu_pipelines.components.statistics_gen import StatisticsGen  # noqa: F401
+from tpu_pipelines.components.schema_gen import SchemaGen  # noqa: F401
+from tpu_pipelines.components.example_validator import ExampleValidator  # noqa: F401

@@ -1,0 +1,164 @@
+"""ExampleValidator: anomalies from validating statistics against a schema.
+
+Capability match for TFX ExampleValidator / TFDV ``validate_statistics``
+(SURVEY.md §2a row 4): schema-conformance checks per split, plus optional
+drift detection against a previous statistics artifact (L-infinity distance
+over categorical distributions — the TFDV drift comparator).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional
+
+from tpu_pipelines.data.schema import FeatureType, Schema
+from tpu_pipelines.data.statistics import (
+    SplitStatistics,
+    load_statistics,
+)
+from tpu_pipelines.dsl.component import Parameter, component
+
+
+@dataclasses.dataclass
+class Anomaly:
+    split: str
+    feature: str
+    kind: str          # MISSING_FEATURE | NEW_FEATURE | TYPE_MISMATCH |
+                       # PRESENCE | OUT_OF_DOMAIN | OUT_OF_RANGE | DRIFT
+    severity: str      # ERROR | WARNING
+    description: str
+
+
+ANOMALIES_FILE = "anomalies.json"
+
+
+def validate_split(
+    split_stats: SplitStatistics, schema: Schema
+) -> List[Anomaly]:
+    anomalies: List[Anomaly] = []
+    split = split_stats.split
+    seen = set(split_stats.features)
+    for name, feat in schema.features.items():
+        fs = split_stats.features.get(name)
+        if fs is None or fs.presence == 0.0:
+            anomalies.append(
+                Anomaly(split, name, "MISSING_FEATURE", "ERROR",
+                        f"schema feature {name!r} absent from split")
+            )
+            continue
+        if fs.type != feat.type.value:
+            anomalies.append(
+                Anomaly(split, name, "TYPE_MISMATCH", "ERROR",
+                        f"expected {feat.type.value}, found {fs.type}")
+            )
+            continue
+        if fs.presence < feat.min_presence:
+            anomalies.append(
+                Anomaly(split, name, "PRESENCE", "ERROR",
+                        f"present in {fs.presence:.4f} < required "
+                        f"{feat.min_presence:.4f} of examples")
+            )
+        if feat.domain is not None and fs.string is not None:
+            domain = set(feat.domain)
+            total = sum(c for _, c in fs.string.top_values)
+            bad = sum(c for v, c in fs.string.top_values if v not in domain)
+            # top_values may truncate; unseen tail counts as out-of-domain
+            # only when the domain was closed over full cardinality.
+            frac = bad / max(1, total)
+            if frac > feat.distribution_constraint:
+                examples = [v for v, _ in fs.string.top_values if v not in domain][:5]
+                anomalies.append(
+                    Anomaly(split, name, "OUT_OF_DOMAIN", "ERROR",
+                            f"{frac:.4f} of values outside domain "
+                            f"(e.g. {examples})")
+                )
+        if feat.type in (FeatureType.INT, FeatureType.FLOAT) and fs.numeric:
+            if feat.min_value is not None and fs.numeric.min < feat.min_value:
+                anomalies.append(
+                    Anomaly(split, name, "OUT_OF_RANGE", "ERROR",
+                            f"min {fs.numeric.min} < schema min {feat.min_value}")
+                )
+            if feat.max_value is not None and fs.numeric.max > feat.max_value:
+                anomalies.append(
+                    Anomaly(split, name, "OUT_OF_RANGE", "ERROR",
+                            f"max {fs.numeric.max} > schema max {feat.max_value}")
+                )
+    for name in seen - set(schema.features):
+        anomalies.append(
+            Anomaly(split, name, "NEW_FEATURE", "WARNING",
+                    f"feature {name!r} not in schema")
+        )
+    return anomalies
+
+
+def linf_categorical_distance(
+    a: SplitStatistics, b: SplitStatistics, feature: str
+) -> Optional[float]:
+    """L-infinity distance between normalized top-value distributions."""
+    fa, fb = a.features.get(feature), b.features.get(feature)
+    if not (fa and fb and fa.string and fb.string):
+        return None
+    da = {v: c for v, c in fa.string.top_values}
+    db = {v: c for v, c in fb.string.top_values}
+    ta, tb = sum(da.values()) or 1, sum(db.values()) or 1
+    keys = set(da) | set(db)
+    return max(abs(da.get(k, 0) / ta - db.get(k, 0) / tb) for k in keys)
+
+
+@component(
+    inputs={"statistics": "ExampleStatistics", "schema": "Schema"},
+    outputs={"anomalies": "ExampleAnomalies"},
+    parameters={
+        # Optional uri of a previous ExampleStatistics payload for drift.
+        "baseline_statistics_uri": Parameter(type=str, default=""),
+        "drift_threshold": Parameter(type=float, default=0.3),
+        # Fail the pipeline on ERROR-severity anomalies.
+        "fail_on_anomalies": Parameter(type=bool, default=True),
+    },
+)
+def ExampleValidator(ctx):
+    stats = load_statistics(ctx.input("statistics").uri)
+    schema = Schema.load(ctx.input("schema").uri)
+    anomalies: List[Anomaly] = []
+    for split_stats in stats.values():
+        anomalies.extend(validate_split(split_stats, schema))
+
+    baseline_uri = ctx.exec_properties["baseline_statistics_uri"]
+    if baseline_uri:
+        baseline = load_statistics(baseline_uri)
+        thresh = ctx.exec_properties["drift_threshold"]
+        for split, s in stats.items():
+            prev = baseline.get(split)
+            if prev is None:
+                continue
+            for name in s.features:
+                d = linf_categorical_distance(s, prev, name)
+                if d is not None and d > thresh:
+                    anomalies.append(
+                        Anomaly(split, name, "DRIFT", "ERROR",
+                                f"L-inf distance {d:.4f} > {thresh} vs baseline")
+                    )
+
+    out = ctx.output("anomalies")
+    os.makedirs(out.uri, exist_ok=True)
+    with open(os.path.join(out.uri, ANOMALIES_FILE), "w") as f:
+        json.dump([dataclasses.asdict(a) for a in anomalies], f, indent=2)
+    n_errors = sum(1 for a in anomalies if a.severity == "ERROR")
+    out.properties["anomaly_count"] = len(anomalies)
+    out.properties["error_count"] = n_errors
+    if n_errors and ctx.exec_properties["fail_on_anomalies"]:
+        raise ValueError(
+            f"{n_errors} ERROR anomalies: "
+            + "; ".join(
+                f"{a.split}/{a.feature}:{a.kind}" for a in anomalies
+                if a.severity == "ERROR"
+            )
+        )
+    return {"anomaly_count": len(anomalies), "error_count": n_errors}
+
+
+def load_anomalies(uri: str) -> List[Anomaly]:
+    with open(os.path.join(uri, ANOMALIES_FILE)) as f:
+        return [Anomaly(**d) for d in json.load(f)]

@@ -1,0 +1,35 @@
+"""StatisticsGen: full-pass per-split statistics over an Examples artifact.
+
+Capability match for TFX StatisticsGen / TFDV GenerateStatistics (SURVEY.md
+§2a row 2), as vectorized Arrow/numpy reductions instead of Beam.
+"""
+
+from __future__ import annotations
+
+from tpu_pipelines.data import examples_io
+from tpu_pipelines.data.statistics import (
+    compute_split_statistics,
+    save_statistics,
+)
+from tpu_pipelines.dsl.component import component
+
+
+@component(
+    inputs={"examples": "Examples"},
+    outputs={"statistics": "ExampleStatistics"},
+)
+def StatisticsGen(ctx):
+    examples = ctx.input("examples")
+    splits = examples_io.split_names(examples.uri)
+    if not splits:
+        raise ValueError(f"Examples artifact at {examples.uri} has no splits")
+    stats = {}
+    for split in splits:
+        table = examples_io.read_split_table(examples.uri, split)
+        stats[split] = compute_split_statistics(split, table)
+    out = ctx.output("statistics")
+    save_statistics(out.uri, stats)
+    out.properties["split_names"] = splits
+    return {
+        f"num_examples_{s}": stats[s].num_examples for s in splits
+    }

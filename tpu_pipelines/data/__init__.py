@@ -1,0 +1,14 @@
+"""Data layer: on-disk Examples format, splits, schema, input pipelines.
+
+Replaces the reference stack's TFRecord+Beam data plane (SURVEY.md §2a
+ExampleGen, §2b Apache Beam/Arrow rows) with Arrow/Parquet columnar storage
+and host-side batch iterators that feed mesh-sharded ``jax.Array`` batches.
+"""
+
+from tpu_pipelines.data.examples_io import (  # noqa: F401
+    read_split,
+    read_split_table,
+    split_names,
+    write_split,
+)
+from tpu_pipelines.data.schema import Feature, FeatureType, Schema  # noqa: F401

@@ -1,0 +1,77 @@
+"""On-disk ``Examples`` artifact format: one Parquet file per split.
+
+Layout under an Examples artifact uri::
+
+    <uri>/Split-<name>/data.parquet
+
+Columnar Parquet (via pyarrow) is the TPU-native stand-in for the reference's
+TFRecord-of-tf.Example rows: column reads feed vectorized stats/transform
+directly, and row groups give cheap sharded reads for data-parallel hosts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+SPLIT_PREFIX = "Split-"
+DATA_FILE = "data.parquet"
+
+
+def split_dir(uri: str, split: str) -> str:
+    return os.path.join(uri, f"{SPLIT_PREFIX}{split}")
+
+
+def split_names(uri: str) -> List[str]:
+    if not os.path.isdir(uri):
+        return []
+    return sorted(
+        d[len(SPLIT_PREFIX):]
+        for d in os.listdir(uri)
+        if d.startswith(SPLIT_PREFIX)
+        and os.path.isfile(os.path.join(uri, d, DATA_FILE))
+    )
+
+
+def write_split(uri: str, split: str, table: pa.Table) -> str:
+    d = split_dir(uri, split)
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, DATA_FILE)
+    pq.write_table(table, path)
+    return path
+
+
+def read_split_table(
+    uri: str, split: str, columns: Optional[List[str]] = None
+) -> pa.Table:
+    path = os.path.join(split_dir(uri, split), DATA_FILE)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"Examples artifact at {uri!r} has no split {split!r} "
+            f"(available: {split_names(uri)})"
+        )
+    return pq.read_table(path, columns=columns)
+
+
+def read_split(
+    uri: str, split: str, columns: Optional[List[str]] = None
+) -> Dict[str, np.ndarray]:
+    """Split as a dict of numpy columns (strings come back as object arrays)."""
+    table = read_split_table(uri, split, columns)
+    out: Dict[str, np.ndarray] = {}
+    for name in table.column_names:
+        col = table.column(name)
+        if pa.types.is_string(col.type) or pa.types.is_large_string(col.type):
+            out[name] = np.asarray(col.to_pylist(), dtype=object)
+        else:
+            out[name] = col.to_numpy(zero_copy_only=False)
+    return out
+
+
+def num_rows(uri: str, split: str) -> int:
+    path = os.path.join(split_dir(uri, split), DATA_FILE)
+    return pq.read_metadata(path).num_rows

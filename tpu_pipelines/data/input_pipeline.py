@@ -1,0 +1,105 @@
+"""Host-side input pipeline: Examples splits → mesh-sharded jax.Array batches.
+
+The TPU-native stand-in for the reference's tf.data feeding loop (SURVEY.md
+§3.3): static batch shapes (XLA compiles once), per-epoch permutation
+shuffling, per-host sharding for multi-host data parallelism (each process
+reads rows ``i % num_shards == shard_index``, the Grain convention), and a
+``shard_batch`` device_put at the infeed boundary.
+
+Datasets at workshop scale fit in host RAM as numpy columns; larger data can
+stream Parquet row groups through the same iterator contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+from tpu_pipelines.data import examples_io
+from tpu_pipelines.parallel.mesh import shard_batch
+
+Batch = Dict[str, np.ndarray]
+
+
+@dataclasses.dataclass
+class InputConfig:
+    batch_size: int = 128
+    shuffle: bool = True
+    seed: int = 0
+    drop_remainder: bool = True      # static shapes for XLA
+    num_epochs: Optional[int] = None  # None = loop forever
+    shard_index: int = 0             # this host's shard (multi-host DP)
+    num_shards: int = 1
+
+
+class BatchIterator:
+    """Iterates dict-of-numpy batches over one split of an Examples artifact.
+
+    ``transform`` (if given) is the materialized Transform apply-fn, run
+    host-side here only when the trainer opts out of on-chip transform.
+    """
+
+    def __init__(
+        self,
+        uri: str,
+        split: str,
+        config: InputConfig,
+        columns: Optional[list] = None,
+        transform: Optional[Callable[[Batch], Batch]] = None,
+    ):
+        self.config = config
+        self.transform = transform
+        data = examples_io.read_split(uri, split, columns)
+        if not data:
+            raise ValueError(f"empty split {split!r} at {uri}")
+        n = len(next(iter(data.values())))
+        # Per-host shard: strided rows, the Grain sharding convention.
+        idx = np.arange(config.shard_index, n, config.num_shards)
+        self._data = data
+        self._indices = idx
+        self._n = len(idx)
+        if self._n < config.batch_size and config.drop_remainder:
+            raise ValueError(
+                f"split {split!r}: shard has {self._n} rows < batch_size "
+                f"{config.batch_size} with drop_remainder"
+            )
+
+    @property
+    def num_examples(self) -> int:
+        return self._n
+
+    def steps_per_epoch(self) -> int:
+        if self.config.drop_remainder:
+            return self._n // self.config.batch_size
+        return -(-self._n // self.config.batch_size)
+
+    def __iter__(self) -> Iterator[Batch]:
+        cfg = self.config
+        epoch = 0
+        while cfg.num_epochs is None or epoch < cfg.num_epochs:
+            order = self._indices
+            if cfg.shuffle:
+                rng = np.random.default_rng((cfg.seed, epoch))
+                order = rng.permutation(order)
+            limit = (
+                (self._n // cfg.batch_size) * cfg.batch_size
+                if cfg.drop_remainder
+                else self._n
+            )
+            for start in range(0, limit, cfg.batch_size):
+                rows = order[start : start + cfg.batch_size]
+                batch = {k: v[rows] for k, v in self._data.items()}
+                if self.transform is not None:
+                    batch = self.transform(batch)
+                yield batch
+            epoch += 1
+
+
+def sharded_batches(
+    iterator: BatchIterator, mesh: Any
+) -> Iterator[Any]:
+    """Wrap a BatchIterator: device_put each batch, batch dim over 'data'."""
+    for batch in iterator:
+        yield shard_batch(batch, mesh)

@@ -1,0 +1,83 @@
+"""Dataset schema: feature types, presence, domains, ranges.
+
+TPU-native equivalent of the TFDV/TF-Metadata ``Schema`` proto (SURVEY.md §2a
+SchemaGen): a JSON-serializable dataclass consumed by ExampleValidator (drift/
+anomaly checks) and Transform (feature typing).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import os
+from typing import Dict, List, Optional
+
+
+class FeatureType(str, enum.Enum):
+    INT = "INT"
+    FLOAT = "FLOAT"
+    BYTES = "BYTES"   # strings / opaque bytes
+
+
+@dataclasses.dataclass
+class Feature:
+    name: str
+    type: FeatureType
+    # Fraction of examples in which the feature must be present (non-null).
+    min_presence: float = 1.0
+    # Categorical domain (BYTES/INT features with bounded vocabulary).
+    domain: Optional[List[str]] = None
+    # Numeric range observed at inference time (None = unbounded).
+    min_value: Optional[float] = None
+    max_value: Optional[float] = None
+    # Fraction of out-of-domain values tolerated before flagging an anomaly.
+    distribution_constraint: float = 0.0
+
+    def to_json(self) -> Dict:
+        d = dataclasses.asdict(self)
+        d["type"] = self.type.value
+        return d
+
+    @classmethod
+    def from_json(cls, d: Dict) -> "Feature":
+        d = dict(d)
+        d["type"] = FeatureType(d["type"])
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class Schema:
+    features: Dict[str, Feature] = dataclasses.field(default_factory=dict)
+    # Features a model is allowed to not see at serving time (e.g. label).
+    optional_at_serving: List[str] = dataclasses.field(default_factory=list)
+
+    def to_json(self) -> Dict:
+        return {
+            "features": {n: f.to_json() for n, f in self.features.items()},
+            "optional_at_serving": list(self.optional_at_serving),
+        }
+
+    @classmethod
+    def from_json(cls, d: Dict) -> "Schema":
+        return cls(
+            features={
+                n: Feature.from_json(f) for n, f in d.get("features", {}).items()
+            },
+            optional_at_serving=list(d.get("optional_at_serving", [])),
+        )
+
+    FILE_NAME = "schema.json"
+
+    def save(self, uri: str) -> str:
+        os.makedirs(uri, exist_ok=True)
+        path = os.path.join(uri, self.FILE_NAME)
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f, indent=2, sort_keys=True)
+        return path
+
+    @classmethod
+    def load(cls, uri: str) -> "Schema":
+        path = uri if uri.endswith(".json") else os.path.join(uri, cls.FILE_NAME)
+        with open(path) as f:
+            return cls.from_json(json.load(f))

@@ -1,0 +1,167 @@
+"""Full-pass dataset statistics: vectorized columnar computation.
+
+TPU-native equivalent of TFDV's ``GenerateStatistics`` (SURVEY.md §2a
+StatisticsGen): instead of Beam CombinePerKey over row batches, statistics are
+single-pass vectorized reductions over Arrow/numpy columns.  At workshop data
+scale this runs on host; the moments/histogram reductions are expressible as
+``jax.jit`` segment reductions if a dataset ever warrants on-chip stats.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from tpu_pipelines.data.schema import FeatureType
+
+_TOP_K = 20
+_HIST_BUCKETS = 10
+
+
+@dataclasses.dataclass
+class NumericStats:
+    mean: float
+    std_dev: float
+    min: float
+    max: float
+    median: float
+    num_zeros: int
+    histogram_edges: List[float]
+    histogram_counts: List[int]
+
+
+@dataclasses.dataclass
+class StringStats:
+    unique: int
+    avg_length: float
+    top_values: List[List]      # [value, count] pairs, descending
+
+
+@dataclasses.dataclass
+class FeatureStats:
+    name: str
+    type: str                   # FeatureType value
+    num_examples: int
+    num_missing: int
+    numeric: Optional[NumericStats] = None
+    string: Optional[StringStats] = None
+
+    @property
+    def presence(self) -> float:
+        if self.num_examples == 0:
+            return 0.0
+        return 1.0 - self.num_missing / self.num_examples
+
+
+@dataclasses.dataclass
+class SplitStatistics:
+    split: str
+    num_examples: int
+    features: Dict[str, FeatureStats]
+
+    def to_json(self) -> Dict:
+        return {
+            "split": self.split,
+            "num_examples": self.num_examples,
+            "features": {
+                n: _feature_to_json(f) for n, f in self.features.items()
+            },
+        }
+
+    @classmethod
+    def from_json(cls, d: Dict) -> "SplitStatistics":
+        return cls(
+            split=d["split"],
+            num_examples=d["num_examples"],
+            features={
+                n: _feature_from_json(f) for n, f in d["features"].items()
+            },
+        )
+
+
+def _feature_to_json(f: FeatureStats) -> Dict:
+    d = dataclasses.asdict(f)
+    return d
+
+
+def _feature_from_json(d: Dict) -> FeatureStats:
+    d = dict(d)
+    if d.get("numeric"):
+        d["numeric"] = NumericStats(**d["numeric"])
+    if d.get("string"):
+        d["string"] = StringStats(**d["string"])
+    return FeatureStats(**d)
+
+
+STATS_FILE = "stats.json"
+
+
+def save_statistics(uri: str, stats: Dict[str, SplitStatistics]) -> str:
+    os.makedirs(uri, exist_ok=True)
+    path = os.path.join(uri, STATS_FILE)
+    with open(path, "w") as f:
+        json.dump(
+            {split: s.to_json() for split, s in stats.items()},
+            f, indent=2, sort_keys=True,
+        )
+    return path
+
+
+def load_statistics(uri: str) -> Dict[str, SplitStatistics]:
+    with open(os.path.join(uri, STATS_FILE)) as f:
+        raw = json.load(f)
+    return {split: SplitStatistics.from_json(d) for split, d in raw.items()}
+
+
+def infer_feature_type(arr_type: pa.DataType) -> FeatureType:
+    if pa.types.is_integer(arr_type):
+        return FeatureType.INT
+    if pa.types.is_floating(arr_type):
+        return FeatureType.FLOAT
+    return FeatureType.BYTES
+
+
+def compute_split_statistics(split: str, table: pa.Table) -> SplitStatistics:
+    n = table.num_rows
+    features: Dict[str, FeatureStats] = {}
+    for name in table.column_names:
+        col = table.column(name).combine_chunks()
+        ftype = infer_feature_type(col.type)
+        num_missing = col.null_count
+        fs = FeatureStats(
+            name=name, type=ftype.value, num_examples=n, num_missing=num_missing
+        )
+        if ftype in (FeatureType.INT, FeatureType.FLOAT):
+            vals = col.drop_null().to_numpy(zero_copy_only=False).astype(np.float64)
+            if len(vals):
+                counts, edges = np.histogram(vals, bins=_HIST_BUCKETS)
+                fs.numeric = NumericStats(
+                    mean=float(np.mean(vals)),
+                    std_dev=float(np.std(vals)),
+                    min=float(np.min(vals)),
+                    max=float(np.max(vals)),
+                    median=float(np.median(vals)),
+                    num_zeros=int(np.count_nonzero(vals == 0)),
+                    histogram_edges=[float(e) for e in edges],
+                    histogram_counts=[int(c) for c in counts],
+                )
+        else:
+            vals = np.asarray(col.drop_null().to_pylist(), dtype=object)
+            if len(vals):
+                uniq, counts = np.unique(vals.astype(str), return_counts=True)
+                order = np.argsort(-counts)
+                top = [
+                    [str(uniq[i]), int(counts[i])] for i in order[:_TOP_K]
+                ]
+                fs.string = StringStats(
+                    unique=int(len(uniq)),
+                    avg_length=float(np.mean([len(v) for v in vals.astype(str)])),
+                    top_values=top,
+                )
+        features[name] = fs
+    return SplitStatistics(split=split, num_examples=n, features=features)

@@ -14,6 +14,7 @@ block the writer.
 from __future__ import annotations
 
 import json
+import os
 import sqlite3
 import threading
 import time
@@ -100,6 +101,9 @@ class MetadataStore:
         self.db_path = db_path
         self._lock = threading.RLock()
         self._in_tx = False
+        if db_path != ":memory:":
+            parent = os.path.dirname(os.path.abspath(db_path))
+            os.makedirs(parent, exist_ok=True)
         self._conn = sqlite3.connect(db_path, check_same_thread=False)
         with self._lock:
             if db_path != ":memory:":

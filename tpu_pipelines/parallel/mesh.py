@@ -1,0 +1,99 @@
+"""Mesh construction and standard shardings.
+
+The reference's only scaling axis is synchronous data parallelism
+(``MultiWorkerMirroredStrategy`` — SURVEY.md §2c); here that is batch-dim
+sharding over the mesh's ``data`` axis, with gradient ``psum`` emitted by XLA.
+The mesh keeps extra named axes (``model``, ``seq``) so tensor/sequence
+parallelism for the BERT/T5 configs slots in without reshaping the design.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis names, in fixed order.  data = batch/DP, model = tensor
+# parallelism, seq = sequence/context parallelism.
+AXES = ("data", "model", "seq")
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Declarative mesh shape; unspecified axes default to 1."""
+
+    data: int = -1      # -1 = all remaining devices
+    model: int = 1
+    seq: int = 1
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        sizes = {"data": self.data, "model": self.model, "seq": self.seq}
+        fixed = math.prod(v for v in sizes.values() if v > 0)
+        free = [k for k, v in sizes.items() if v == -1]
+        if len(free) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        if free:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {sizes}"
+                )
+            sizes[free[0]] = n_devices // fixed
+        if math.prod(sizes.values()) != n_devices:
+            raise ValueError(
+                f"mesh {sizes} does not cover {n_devices} devices"
+            )
+        return sizes
+
+
+def make_mesh(
+    config: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh over the given (default: all) devices.
+
+    Uses ``jax.experimental.mesh_utils`` on real TPU so the axis order maps
+    onto the physical ICI torus; on CPU/virtual devices a plain reshape.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    config = config or MeshConfig()
+    sizes = config.resolve(len(devices))
+    shape = tuple(sizes[a] for a in AXES)
+    if devices[0].platform == "tpu":
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    else:
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXES)
+
+
+def data_parallel_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Batch-dim sharding: dim 0 over 'data', rest replicated."""
+    return NamedSharding(mesh, P("data", *([None] * (ndim - 1))))
+
+
+def replicate(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch: Any, mesh: Mesh) -> Any:
+    """Place a host pytree of arrays on the mesh, batch dim over 'data'.
+
+    This is the host→device infeed boundary (SURVEY.md §3.3): one
+    ``device_put`` per step; everything after is on-chip.
+    """
+
+    def put(x):
+        arr = np.asarray(x)
+        return jax.device_put(arr, data_parallel_sharding(mesh, arr.ndim))
+
+    return jax.tree_util.tree_map(put, batch)
+
+
+def pad_to_multiple(n: int, k: int) -> int:
+    """Smallest multiple of k that is >= n (static batch padding helper)."""
+    return ((n + k - 1) // k) * k
