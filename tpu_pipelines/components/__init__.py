@@ -11,3 +11,4 @@ from tpu_pipelines.components.example_gen import (  # noqa: F401
 from tpu_pipelines.components.statistics_gen import StatisticsGen  # noqa: F401
 from tpu_pipelines.components.schema_gen import SchemaGen  # noqa: F401
 from tpu_pipelines.components.example_validator import ExampleValidator  # noqa: F401
+from tpu_pipelines.components.transform import Transform  # noqa: F401

@@ -1,0 +1,88 @@
+"""Transform component: analyze once, materialize skew-free features.
+
+Capability match for TFX Transform (SURVEY.md §2a row 5, §3.4): the user's
+``preprocessing_fn(inputs, tft)`` (from ``module_file``) builds a column DAG;
+a single full pass over the train split resolves analyzers (vocabularies,
+moments, quantile boundaries); every split is then materialized through the
+resolved graph, and the graph itself is emitted as the ``transform_graph``
+artifact that Trainer/Evaluator/serving reuse — identical preprocessing in
+training and serving, by construction.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+from tpu_pipelines.data import examples_io
+from tpu_pipelines.data.schema import Schema
+from tpu_pipelines.dsl.component import Parameter, component
+from tpu_pipelines.transform.graph import TransformGraph
+from tpu_pipelines.utils.module_loader import load_fn
+
+MODULE_COPY = "module_file.py"
+
+
+@component(
+    inputs={"examples": "Examples", "schema": "Schema"},
+    outputs={
+        "transform_graph": "TransformGraph",
+        "transformed_examples": "Examples",
+    },
+    parameters={
+        "module_file": Parameter(type=str, required=True),
+        # Split used for the analysis full pass (TFX analyzes train).
+        "analyze_split": Parameter(type=str, default="train"),
+        # Pass through untransformed columns (e.g. raw label) verbatim.
+        "passthrough_columns": Parameter(type=list, default=None),
+    },
+    external_input_parameters=("module_file",),
+)
+def Transform(ctx):
+    module_file = ctx.exec_properties["module_file"]
+    preprocessing_fn = load_fn(module_file, "preprocessing_fn")
+    schema = Schema.load(ctx.input("schema").uri)
+    examples_uri = ctx.input("examples").uri
+
+    graph = TransformGraph.build(preprocessing_fn, schema)
+
+    analyze_split = ctx.exec_properties["analyze_split"]
+    splits = examples_io.split_names(examples_uri)
+    if analyze_split not in splits:
+        raise ValueError(
+            f"analyze_split {analyze_split!r} not in {splits}"
+        )
+    graph.analyze(examples_io.read_split(examples_uri, analyze_split))
+
+    graph_out = ctx.output("transform_graph")
+    graph.save(graph_out.uri)
+    # Record the user's module source next to the graph for lineage/debugging
+    # (the graph is self-contained; this copy is informational).
+    shutil.copyfile(module_file, os.path.join(graph_out.uri, MODULE_COPY))
+    graph_out.properties["output_features"] = graph.output_feature_names()
+
+    passthrough = ctx.exec_properties["passthrough_columns"] or []
+    transformed_out = ctx.output("transformed_examples")
+    counts = {}
+    for split in splits:
+        raw = examples_io.read_split(examples_uri, split)
+        cols = graph.apply_host(raw)
+        for name in passthrough:
+            if name in cols:
+                raise ValueError(
+                    f"passthrough column {name!r} collides with a transform output"
+                )
+            cols[name] = raw[name]
+        examples_io.write_split(
+            transformed_out.uri, split, examples_io.table_from_columns(cols)
+        )
+        counts[split] = len(next(iter(cols.values())))
+    transformed_out.properties["split_names"] = sorted(counts)
+    transformed_out.properties["split_counts"] = counts
+    return {
+        "num_analyzers": sum(
+            1 for n in graph.nodes if n.op in
+        ("z_score", "scale_to_0_1", "vocab_apply", "bucketize")
+        ),
+        "output_features": graph.output_feature_names(),
+    }

@@ -60,16 +60,43 @@ def read_split_table(
 def read_split(
     uri: str, split: str, columns: Optional[List[str]] = None
 ) -> Dict[str, np.ndarray]:
-    """Split as a dict of numpy columns (strings come back as object arrays)."""
+    """Split as a dict of numpy columns.
+
+    Strings come back as object arrays; fixed-length list columns (images,
+    one-hot vectors) come back stacked as 2-D numeric arrays.
+    """
     table = read_split_table(uri, split, columns)
+    return columns_from_table(table)
+
+
+def columns_from_table(table: pa.Table) -> Dict[str, np.ndarray]:
     out: Dict[str, np.ndarray] = {}
     for name in table.column_names:
         col = table.column(name)
         if pa.types.is_string(col.type) or pa.types.is_large_string(col.type):
             out[name] = np.asarray(col.to_pylist(), dtype=object)
+        elif pa.types.is_nested(col.type):
+            out[name] = np.asarray(col.to_pylist())
         else:
             out[name] = col.to_numpy(zero_copy_only=False)
     return out
+
+
+def table_from_columns(columns: Dict[str, np.ndarray]) -> pa.Table:
+    """Build an Arrow table; 2-D arrays become fixed-length list columns."""
+    arrays = {}
+    for name, arr in columns.items():
+        arr = np.asarray(arr)
+        if arr.ndim == 1:
+            arrays[name] = pa.array(arr)
+        elif arr.ndim == 2:
+            arrays[name] = pa.array(list(arr))
+        else:
+            raise ValueError(
+                f"column {name!r}: rank-{arr.ndim} arrays not supported; "
+                "flatten trailing dims first"
+            )
+    return pa.table(arrays)
 
 
 def num_rows(uri: str, split: str) -> int:

@@ -1,0 +1,19 @@
+"""Transform: full-pass analyzers + skew-free preprocessing graphs.
+
+TPU-native equivalent of tf.Transform (SURVEY.md §2a Transform, §3.4, and
+"hard parts" #1): the user's ``preprocessing_fn(inputs, tft)`` builds a small
+column-expression DAG through the ``tft`` namespace instead of being traced as
+arbitrary Python.  One topological evaluation over the dataset resolves every
+analyzer (vocabularies, moments, quantiles — nested analyzers included); the
+resolved DAG plus analyzer state is the serialized TransformGraph artifact.
+
+The same DAG is interpreted in three places, which is the skew guarantee:
+  - materialization of transformed examples (host, vectorized numpy),
+  - the training input path (already-materialized numeric columns),
+  - serving/bulk-inference, where ``split_host_device`` partitions the DAG at
+    the string→integer frontier so the numeric subgraph runs ``jax.jit``-
+    compiled on-chip, fused with the model forward pass.
+"""
+
+from tpu_pipelines.transform.expr import ColumnRef, TftNamespace  # noqa: F401
+from tpu_pipelines.transform.graph import TransformGraph  # noqa: F401

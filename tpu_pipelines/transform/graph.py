@@ -1,0 +1,490 @@
+"""TransformGraph: analysis, host/device evaluation, serialization.
+
+The one-graph-two-places skew guarantee (SURVEY.md §7 hard part #1): the DAG
+serialized here is the only definition of preprocessing.  It is evaluated by
+`apply_host` when materializing transformed examples, and by
+`split_host_device` at serving/inference time, where the numeric subgraph
+becomes a pure jax-traceable function compiled on-chip together with the model
+(the `jit_compile=True` co-location from BASELINE).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tpu_pipelines.data.schema import FeatureType, Schema
+from tpu_pipelines.transform.expr import (
+    NUMERIC,
+    OPS,
+    STRING,
+    ColumnRef,
+    GraphBuilder,
+    Node,
+    TftNamespace,
+)
+
+GRAPH_FILE = "transform_graph.json"
+STATE_FILE = "analyzer_state.npz"
+VOCAB_DIR = "vocabularies"
+
+
+class _LazyInputs:
+    """Dict-like view handed to preprocessing_fn; creates inputs on access."""
+
+    def __init__(self, builder: GraphBuilder, dtypes: Dict[str, str]):
+        self._b = builder
+        self._dtypes = dtypes
+
+    def __getitem__(self, name: str) -> ColumnRef:
+        if name not in self._dtypes:
+            raise KeyError(
+                f"preprocessing_fn requested unknown feature {name!r}; "
+                f"schema has {sorted(self._dtypes)}"
+            )
+        return self._b.input(name, self._dtypes[name])
+
+    def keys(self):
+        return self._dtypes.keys()
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._dtypes
+
+
+def _schema_dtypes(schema: Schema) -> Dict[str, str]:
+    return {
+        name: STRING if f.type == FeatureType.BYTES else NUMERIC
+        for name, f in schema.features.items()
+    }
+
+
+def _stable_hash_strings(values: np.ndarray, buckets: int) -> np.ndarray:
+    out = np.empty(len(values), dtype=np.int32)
+    for i, v in enumerate(values):
+        h = hashlib.blake2b(str(v).encode("utf-8"), digest_size=8).digest()
+        out[i] = int.from_bytes(h, "little") % buckets
+    return out
+
+
+class TransformGraph:
+    """A resolved (or being-resolved) preprocessing DAG."""
+
+    def __init__(
+        self,
+        nodes: List[Node],
+        outputs: Dict[str, int],
+        state: Optional[Dict[int, Dict[str, Any]]] = None,
+    ):
+        self.nodes = nodes
+        self.outputs = outputs
+        self.state: Dict[int, Dict[str, Any]] = state or {}
+
+    # ------------------------------------------------------------ building
+
+    @classmethod
+    def build(
+        cls,
+        preprocessing_fn: Callable,
+        schema: Schema,
+    ) -> "TransformGraph":
+        builder = GraphBuilder()
+        tft = TftNamespace(builder)
+        inputs = _LazyInputs(builder, _schema_dtypes(schema))
+        out = preprocessing_fn(inputs, tft)
+        if not isinstance(out, dict) or not out:
+            raise ValueError(
+                "preprocessing_fn must return a non-empty dict of ColumnRefs"
+            )
+        outputs: Dict[str, int] = {}
+        for name, ref in out.items():
+            if not isinstance(ref, ColumnRef):
+                raise TypeError(
+                    f"preprocessing_fn output {name!r} is "
+                    f"{type(ref).__name__}, expected ColumnRef"
+                )
+            outputs[name] = ref.id
+        return cls(builder.nodes, outputs)
+
+    # ------------------------------------------------------------ analysis
+
+    def analyze(self, data: Dict[str, np.ndarray]) -> None:
+        """One topological full pass; resolves every analyzer's state.
+
+        Nested analyzers (z-score of a bucketized column, ...) resolve in the
+        same pass because evaluation is node-by-node over full columns —
+        the tf.Transform multi-phase problem disappears.
+        """
+        self._eval(data, np, analyzing=True)
+
+    # ---------------------------------------------------------- evaluation
+
+    def apply_host(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Vectorized numpy evaluation (materialization / host fallback)."""
+        vals = self._eval(batch, np, analyzing=False)
+        return {name: vals[nid] for name, nid in self.outputs.items()}
+
+    def _eval(
+        self,
+        data: Dict[str, Any],
+        xp,
+        analyzing: bool,
+        subset: Optional[List[int]] = None,
+        preset: Optional[Dict[int, Any]] = None,
+    ) -> Dict[int, Any]:
+        vals: Dict[int, Any] = dict(preset or {})
+        nodes = (
+            self.nodes if subset is None
+            else [self.nodes[i] for i in subset]
+        )
+        for node in nodes:
+            if node.id in vals:
+                continue
+            if node.op == "input":
+                if node.name not in data:
+                    raise KeyError(
+                        f"transform input feature {node.name!r} missing from batch"
+                    )
+                vals[node.id] = data[node.name]
+                continue
+            args = [
+                vals[a] if isinstance(a, int) and not isinstance(a, bool) else a
+                for a in node.inputs
+            ]
+            opdef = OPS[node.op]
+            if opdef.is_analyzer:
+                if node.id not in self.state:
+                    if not analyzing:
+                        raise RuntimeError(
+                            f"analyzer node #{node.id} ({node.op}) has no "
+                            "state; run analyze() first"
+                        )
+                    self.state[node.id] = _compute_state(node, args[0])
+                vals[node.id] = _apply_analyzer(
+                    node, self.state[node.id], args[0], xp
+                )
+            else:
+                vals[node.id] = _apply_stateless(node, args, xp)
+        return vals
+
+    # ------------------------------------------------- host/device split
+
+    def split_host_device(
+        self,
+    ) -> Tuple[Callable, Callable, List[str]]:
+        """Partition at the string→numeric frontier.
+
+        Returns ``(host_fn, device_fn, interface_names)``:
+          - ``host_fn(batch) -> {iface_name: np.ndarray}`` runs string ops
+            (vocab lookup, hashing) plus passthrough of numeric inputs;
+          - ``device_fn(iface) -> outputs`` is pure numeric, jax-traceable —
+            embed it inside a jitted serving/training step;
+          - the interface is the list of array names crossing host→device.
+
+        Skew safety: both functions are interpretations of the same DAG.
+        """
+        host_nodes: set = set()
+        for node in self.nodes:
+            if node.op == "input":
+                if node.dtype == STRING:
+                    host_nodes.add(node.id)
+                continue
+            arg_ids = [a for a in node.inputs if isinstance(a, int) and not isinstance(a, bool)]
+            consumes_string = any(
+                self.nodes[a].dtype == STRING for a in arg_ids
+            )
+            if consumes_string or node.dtype == STRING:
+                host_nodes.add(node.id)
+
+        # Interface: numeric-valued nodes that device-side nodes consume but
+        # are produced on host (string-derived ids), plus numeric inputs.
+        iface_ids: List[int] = []
+        for node in self.nodes:
+            if node.id in host_nodes:
+                continue
+            if node.op == "input":
+                if node.id not in iface_ids:
+                    iface_ids.append(node.id)
+                continue
+            for a in node.inputs:
+                if isinstance(a, int) and not isinstance(a, bool) and a in host_nodes:
+                    if a not in iface_ids:
+                        iface_ids.append(a)
+        # Outputs computed entirely on host also cross the boundary.
+        for name, nid in self.outputs.items():
+            if nid in host_nodes and nid not in iface_ids:
+                iface_ids.append(nid)
+
+        iface_names = [f"c{nid}" for nid in iface_ids]
+        device_subset = [
+            n.id for n in self.nodes if n.id not in host_nodes
+        ]
+
+        def host_fn(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+            vals = self._eval_host_side(batch, host_nodes, iface_ids)
+            return {f"c{nid}": vals[nid] for nid in iface_ids}
+
+        def device_fn(iface: Dict[str, Any]) -> Dict[str, Any]:
+            import jax.numpy as jnp
+
+            preset = {nid: iface[f"c{nid}"] for nid in iface_ids}
+            vals = self._eval(
+                {}, jnp, analyzing=False, subset=device_subset, preset=preset
+            )
+            return {name: vals[nid] for name, nid in self.outputs.items()}
+
+        return host_fn, device_fn, iface_names
+
+    def _eval_host_side(
+        self, batch: Dict[str, np.ndarray], host_nodes: set, iface_ids: List[int]
+    ) -> Dict[int, Any]:
+        """Evaluate host nodes + numeric inputs needed at the interface."""
+        vals: Dict[int, Any] = {}
+        needed = set(iface_ids)
+        for node in self.nodes:
+            if node.op == "input":
+                if node.id in host_nodes or node.id in needed:
+                    if node.name not in batch:
+                        raise KeyError(
+                            f"feature {node.name!r} missing from batch"
+                        )
+                    vals[node.id] = batch[node.name]
+                continue
+            if node.id not in host_nodes:
+                continue
+            args = [
+                vals[a] if isinstance(a, int) and not isinstance(a, bool) else a
+                for a in node.inputs
+            ]
+            opdef = OPS[node.op]
+            if opdef.is_analyzer:
+                if node.id not in self.state:
+                    raise RuntimeError(
+                        f"analyzer node #{node.id} unresolved; run analyze()"
+                    )
+                vals[node.id] = _apply_analyzer(
+                    node, self.state[node.id], args[0], np
+                )
+            else:
+                vals[node.id] = _apply_stateless(node, args, np)
+        return vals
+
+    # -------------------------------------------------------- persistence
+
+    def save(self, uri: str) -> None:
+        os.makedirs(uri, exist_ok=True)
+        graph_json = {
+            "nodes": [n.to_json() for n in self.nodes],
+            "outputs": self.outputs,
+        }
+        with open(os.path.join(uri, GRAPH_FILE), "w") as f:
+            json.dump(graph_json, f, indent=2, sort_keys=True)
+        arrays: Dict[str, np.ndarray] = {}
+        vocab_meta: Dict[str, Dict] = {}
+        for nid, st in self.state.items():
+            for key, val in st.items():
+                if key == "vocab":
+                    # Human-inspectable vocabulary files, one term per line —
+                    # the tf.Transform vocab-file convention.
+                    vdir = os.path.join(uri, VOCAB_DIR)
+                    os.makedirs(vdir, exist_ok=True)
+                    vpath = os.path.join(vdir, f"vocab_{nid}.txt")
+                    with open(vpath, "w") as f:
+                        for term in val:
+                            f.write(f"{term}\n")
+                    vocab_meta[str(nid)] = {"size": len(val)}
+                else:
+                    arrays[f"{nid}:{key}"] = np.asarray(val)
+        np.savez(os.path.join(uri, STATE_FILE), **arrays)
+        with open(os.path.join(uri, "vocab_meta.json"), "w") as f:
+            json.dump(vocab_meta, f)
+
+    @classmethod
+    def load(cls, uri: str) -> "TransformGraph":
+        with open(os.path.join(uri, GRAPH_FILE)) as f:
+            graph_json = json.load(f)
+        nodes = [Node.from_json(d) for d in graph_json["nodes"]]
+        outputs = {k: int(v) for k, v in graph_json["outputs"].items()}
+        state: Dict[int, Dict[str, Any]] = {}
+        npz_path = os.path.join(uri, STATE_FILE)
+        if os.path.exists(npz_path):
+            data = np.load(npz_path)
+            for key in data.files:
+                nid_s, skey = key.split(":", 1)
+                state.setdefault(int(nid_s), {})[skey] = data[key]
+        meta_path = os.path.join(uri, "vocab_meta.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                vocab_meta = json.load(f)
+            for nid_s in vocab_meta:
+                vpath = os.path.join(uri, VOCAB_DIR, f"vocab_{nid_s}.txt")
+                with open(vpath) as f:
+                    vocab = [line.rstrip("\n") for line in f]
+                state.setdefault(int(nid_s), {})["vocab"] = vocab
+        return cls(nodes, outputs, state)
+
+    # --------------------------------------------------------------- misc
+
+    def output_feature_names(self) -> List[str]:
+        return sorted(self.outputs)
+
+
+# ---------------------------------------------------------------- operators
+
+
+def _compute_state(node: Node, col: np.ndarray) -> Dict[str, Any]:
+    """Full-pass analyzer state from a materialized column."""
+    if node.op == "z_score":
+        vals = np.asarray(col, dtype=np.float64)
+        vals = vals[~np.isnan(vals)]
+        std = float(np.std(vals)) if len(vals) else 1.0
+        return {
+            "mean": float(np.mean(vals)) if len(vals) else 0.0,
+            "std": std if std > 0 else 1.0,
+        }
+    if node.op == "scale_to_0_1":
+        vals = np.asarray(col, dtype=np.float64)
+        vals = vals[~np.isnan(vals)]
+        lo = float(np.min(vals)) if len(vals) else 0.0
+        hi = float(np.max(vals)) if len(vals) else 1.0
+        return {"min": lo, "max": hi if hi > lo else lo + 1.0}
+    if node.op == "vocab_apply":
+        p = node.params
+        if col.dtype == object or col.dtype.kind in ("U", "S"):
+            strs = np.asarray([str(v) for v in col])
+        else:
+            strs = np.asarray([str(int(v)) for v in np.asarray(col).ravel()])
+        uniq, counts = np.unique(strs, return_counts=True)
+        if p.get("frequency_threshold", 0):
+            keep = counts >= p["frequency_threshold"]
+            uniq, counts = uniq[keep], counts[keep]
+        # Order: descending frequency, then lexical — deterministic.
+        order = np.lexsort((uniq, -counts))
+        vocab = [str(uniq[i]) for i in order]
+        if p.get("top_k"):
+            vocab = vocab[: p["top_k"]]
+        return {"vocab": vocab}
+    if node.op == "bucketize":
+        num_buckets = node.params["num_buckets"]
+        vals = np.asarray(col, dtype=np.float64)
+        vals = vals[~np.isnan(vals)]
+        qs = np.linspace(0, 1, num_buckets + 1)[1:-1]
+        boundaries = np.quantile(vals, qs) if len(vals) else np.zeros(0)
+        return {"boundaries": np.unique(boundaries)}
+    raise ValueError(f"unknown analyzer {node.op!r}")
+
+
+def _apply_analyzer(node: Node, state: Dict[str, Any], col, xp):
+    if node.op == "z_score":
+        x = xp.asarray(col, dtype=xp.float32)
+        return (x - float(state["mean"])) / float(state["std"])
+    if node.op == "scale_to_0_1":
+        x = xp.asarray(col, dtype=xp.float32)
+        lo, hi = float(state["min"]), float(state["max"])
+        return (x - lo) / (hi - lo)
+    if node.op == "vocab_apply":
+        # Host-only (consumes strings / stringified ints).
+        assert xp is np, "vocab_apply must run host-side"
+        vocab = state["vocab"]
+        table = {v: i for i, v in enumerate(vocab)}
+        num_oov = node.params.get("num_oov_buckets", 1) or 0
+        col = np.asarray(col)
+        if col.dtype == object or col.dtype.kind in ("U", "S"):
+            strs = [str(v) for v in col]
+        else:
+            strs = [str(int(v)) for v in col.ravel()]
+        out = np.empty(len(strs), dtype=np.int32)
+        for i, s in enumerate(strs):
+            idx = table.get(s)
+            if idx is None:
+                if num_oov > 0:
+                    h = hashlib.blake2b(s.encode(), digest_size=8).digest()
+                    idx = len(vocab) + int.from_bytes(h, "little") % num_oov
+                else:
+                    idx = -1
+            out[i] = idx
+        return out
+    if node.op == "bucketize":
+        boundaries = xp.asarray(state["boundaries"], dtype=xp.float32)
+        x = xp.asarray(col, dtype=xp.float32)
+        return xp.searchsorted(boundaries, x).astype(xp.int32)
+    raise ValueError(f"unknown analyzer {node.op!r}")
+
+
+def _is_string_array(x) -> bool:
+    return isinstance(x, np.ndarray) and (
+        x.dtype == object or x.dtype.kind in ("U", "S")
+    )
+
+
+def _apply_stateless(node: Node, args: List[Any], xp):
+    op = node.op
+    p = node.params
+    if op == "identity":
+        return args[0]
+    if op == "fill_missing":
+        x = args[0]
+        default = p.get("default", 0)
+        if _is_string_array(x):
+            out = np.asarray(
+                [default if v is None else v for v in x], dtype=object
+            )
+            return out
+        x = xp.asarray(x, dtype=xp.float32)
+        return xp.nan_to_num(x, nan=float(default))
+    if op == "hash_strings":
+        assert xp is np, "hash_strings must run host-side"
+        return _stable_hash_strings(np.asarray(args[0]), p["hash_buckets"])
+    if op == "equal" and "value" in p:
+        assert xp is np, "string equality must run host-side"
+        x = np.asarray(args[0])
+        return (x.astype(str) == p["value"]).astype(np.float32)
+    if op == "one_hot":
+        x = xp.asarray(args[0]).astype(xp.int32)
+        depth = p["depth"]
+        eye = xp.eye(depth, dtype=xp.float32)
+        clipped = xp.clip(x, 0, depth - 1)
+        out = eye[clipped]
+        # Out-of-range (e.g. OOV -1) rows become all-zero.
+        mask = ((x >= 0) & (x < depth)).astype(xp.float32)
+        return out * mask[..., None]
+    if op == "cast":
+        return xp.asarray(args[0]).astype(p.get("dtype", "float32"))
+    if op == "clip":
+        x = xp.asarray(args[0], dtype=xp.float32)
+        return xp.clip(x, p["min_value"], p["max_value"])
+
+    fa = [
+        xp.asarray(a, dtype=xp.float32)
+        if not isinstance(a, (int, float)) else a
+        for a in args
+    ]
+    if op == "add":
+        return fa[0] + fa[1]
+    if op == "sub":
+        return fa[0] - fa[1]
+    if op == "mul":
+        return fa[0] * fa[1]
+    if op == "div":
+        return fa[0] / fa[1]
+    if op == "log1p":
+        return xp.log1p(fa[0])
+    if op == "log":
+        return xp.log(fa[0])
+    if op == "sqrt":
+        return xp.sqrt(fa[0])
+    if op == "abs":
+        return xp.abs(fa[0])
+    if op == "equal":
+        return (fa[0] == fa[1]).astype(xp.float32)
+    if op == "greater":
+        return (fa[0] > fa[1]).astype(xp.float32)
+    if op == "less":
+        return (fa[0] < fa[1]).astype(xp.float32)
+    if op == "where":
+        return xp.where(fa[0] != 0, fa[1], fa[2])
+    raise ValueError(f"unknown op {op!r}")
