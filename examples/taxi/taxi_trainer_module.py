@@ -1,0 +1,78 @@
+"""Taxi trainer module file: the run_fn / build_model user contract.
+
+This is the module a pipeline references by path (Trainer ``module_file=``) —
+the same indirection the reference workshop uses for its taxi template
+``run_fn``.  It trains the wide-and-deep model on transformed examples with
+the framework's jitted mesh-sharded train loop, then exports a self-contained
+serving payload (params + module + transform graph).
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tpu_pipelines.data.input_pipeline import BatchIterator, InputConfig
+from tpu_pipelines.models.taxi import DEFAULT_HPARAMS, build_taxi_model
+from tpu_pipelines.trainer import TrainLoopConfig, export_model, train_loop
+from tpu_pipelines.parallel.mesh import MeshConfig
+
+
+def build_model(hyperparameters):
+    return build_taxi_model(hyperparameters)
+
+
+def run_fn(fn_args):
+    hp = {**DEFAULT_HPARAMS, **fn_args.hyperparameters}
+    model = build_model(hp)
+    label = hp["label"]
+    batch_size = int(hp["batch_size"])
+
+    train_iter = BatchIterator(
+        fn_args.train_examples_uri, "train",
+        InputConfig(batch_size=batch_size, shuffle=True, seed=0),
+    )
+
+    def eval_iter_fn():
+        return BatchIterator(
+            fn_args.eval_examples_uri, "eval",
+            InputConfig(batch_size=batch_size, shuffle=False, num_epochs=1,
+                        drop_remainder=True),
+        )
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply({"params": params}, batch)
+        labels = jnp.asarray(batch[label], jnp.float32)
+        loss = optax.sigmoid_binary_cross_entropy(logits, labels).mean()
+        accuracy = jnp.mean((logits > 0) == (labels > 0.5))
+        return loss, {"accuracy": accuracy}
+
+    def init_params_fn(rng, sample_batch):
+        return model.init(rng, sample_batch)["params"]
+
+    mesh_cfg = MeshConfig(**fn_args.mesh_config) if fn_args.mesh_config else None
+    params, result = train_loop(
+        loss_fn=loss_fn,
+        init_params_fn=init_params_fn,
+        optimizer=optax.adam(hp["learning_rate"]),
+        train_iter=train_iter,
+        eval_iter_fn=eval_iter_fn,
+        config=TrainLoopConfig(
+            train_steps=fn_args.train_steps,
+            batch_size=batch_size,
+            eval_steps=fn_args.eval_steps,
+            checkpoint_every=max(1, fn_args.train_steps // 4),
+            log_every=max(1, fn_args.train_steps // 10),
+            mesh_config=mesh_cfg,
+        ),
+        checkpoint_dir=fn_args.model_run_dir,
+    )
+
+    export_model(
+        serving_model_dir=fn_args.serving_model_dir,
+        params=params,
+        module_file=__file__,
+        hyperparameters=hp,
+        transform_graph_uri=fn_args.transform_graph_uri,
+        extra_spec={"label": label},
+    )
+    return result

@@ -15,7 +15,9 @@ from tpu_pipelines.utils.module_loader import load_fn
 
 HERE = os.path.dirname(__file__)
 TAXI_CSV = os.path.join(HERE, "testdata", "taxi_sample.csv")
-TAXI_MODULE = os.path.join(HERE, "testdata", "taxi_preprocessing.py")
+TAXI_MODULE = os.path.join(
+    os.path.dirname(HERE), "examples", "taxi", "taxi_preprocessing.py"
+)
 
 
 def _taxi_schema():

@@ -12,3 +12,4 @@ from tpu_pipelines.components.statistics_gen import StatisticsGen  # noqa: F401
 from tpu_pipelines.components.schema_gen import SchemaGen  # noqa: F401
 from tpu_pipelines.components.example_validator import ExampleValidator  # noqa: F401
 from tpu_pipelines.components.transform import Transform  # noqa: F401
+from tpu_pipelines.components.trainer import Trainer  # noqa: F401

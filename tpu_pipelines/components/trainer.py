@@ -1,0 +1,100 @@
+"""Trainer component: runs user run_fn(FnArgs) and records throughput.
+
+Capability match for TFX Trainer's GenericExecutor (SURVEY.md §2a row 6,
+§3.3): imports ``module_file``, builds ``FnArgs`` from resolved artifacts,
+invokes ``run_fn``, and records the measurement-harness numbers
+(examples/sec, examples/sec/chip — the BASELINE headline metric) as execution
+properties in the metadata store.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict
+
+from tpu_pipelines.dsl.component import Parameter, component
+from tpu_pipelines.trainer.fn_args import FnArgs, TrainResult
+from tpu_pipelines.utils.module_loader import load_fn
+
+
+@component(
+    inputs={
+        "examples": "Examples",
+        "transform_graph": "TransformGraph",
+        "schema": "Schema",
+        "hyperparameters": "HyperParameters",
+        # Warm-start base model (TFX base_model input).
+        "base_model": "Model",
+    },
+    optional_inputs=("transform_graph", "schema", "hyperparameters", "base_model"),
+    outputs={"model": "Model", "model_run": "ModelRun"},
+    parameters={
+        "module_file": Parameter(type=str, required=True),
+        "train_steps": Parameter(type=int, default=1000),
+        "eval_steps": Parameter(type=int, default=0),
+        "hyperparameters": Parameter(type=dict, default=None),
+        "mesh": Parameter(type=dict, default=None),
+        "custom_config": Parameter(type=dict, default=None),
+    },
+    external_input_parameters=("module_file",),
+)
+def Trainer(ctx):
+    run_fn = load_fn(ctx.exec_properties["module_file"], "run_fn")
+
+    examples_uri = ctx.input("examples").uri
+    hyperparameters: Dict[str, Any] = dict(
+        ctx.exec_properties["hyperparameters"] or {}
+    )
+    if ctx.inputs.get("hyperparameters"):
+        # Tuner-produced artifact overrides literal hyperparameters.
+        hp_uri = ctx.input("hyperparameters").uri
+        with open(os.path.join(hp_uri, "best_hyperparameters.json")) as f:
+            hyperparameters.update(json.load(f))
+
+    custom_config = dict(ctx.exec_properties["custom_config"] or {})
+    if ctx.inputs.get("base_model"):
+        custom_config["base_model_uri"] = ctx.input("base_model").uri
+
+    fn_args = FnArgs(
+        train_examples_uri=examples_uri,
+        eval_examples_uri=examples_uri,
+        transform_graph_uri=(
+            ctx.input("transform_graph").uri
+            if ctx.inputs.get("transform_graph") else ""
+        ),
+        schema_uri=(
+            ctx.input("schema").uri if ctx.inputs.get("schema") else ""
+        ),
+        serving_model_dir=ctx.output("model").uri,
+        model_run_dir=ctx.output("model_run").uri,
+        train_steps=ctx.exec_properties["train_steps"],
+        eval_steps=ctx.exec_properties["eval_steps"],
+        hyperparameters=hyperparameters,
+        mesh_config=dict(ctx.exec_properties["mesh"] or {}),
+        custom_config=custom_config,
+    )
+
+    result = run_fn(fn_args)
+    if result is None:
+        result = TrainResult()
+    if not isinstance(result, TrainResult):
+        raise TypeError(
+            f"run_fn must return TrainResult or None, got {type(result).__name__}"
+        )
+
+    model_art = ctx.output("model")
+    model_art.properties["examples_per_sec_per_chip"] = (
+        result.examples_per_sec_per_chip
+    )
+    props = {
+        "examples_per_sec": result.examples_per_sec,
+        "examples_per_sec_per_chip": result.examples_per_sec_per_chip,
+        "steps_completed": result.steps_completed,
+        "resumed_from_step": result.resumed_from_step,
+    }
+    props.update(
+        {f"final_{k}": v for k, v in result.final_metrics.items()}
+    )
+    return props

@@ -73,6 +73,8 @@ class ComponentSpec:
     inputs: Dict[str, str] = dataclasses.field(default_factory=dict)    # key -> artifact type
     outputs: Dict[str, str] = dataclasses.field(default_factory=dict)   # key -> artifact type
     parameters: Dict[str, Parameter] = dataclasses.field(default_factory=dict)
+    # Input keys that may be left unwired (e.g. Trainer without a Transform).
+    optional_inputs: tuple = ()
 
 
 @dataclasses.dataclass
@@ -140,7 +142,17 @@ class Component:
         self.exec_properties: Dict[str, Any] = {}
 
         for key, value in kwargs.items():
-            if key in self.SPEC.inputs:
+            # A key may name both an input and a parameter (e.g. Trainer's
+            # `hyperparameters`: Tuner artifact OR literal dict); the value
+            # type disambiguates.
+            looks_like_channel = isinstance(value, Channel) or (
+                isinstance(value, list)
+                and value
+                and all(isinstance(v, Channel) for v in value)
+            )
+            if key in self.SPEC.inputs and (
+                looks_like_channel or key not in self.SPEC.parameters
+            ):
                 chans = value if isinstance(value, list) else [value]
                 for ch in chans:
                     if not isinstance(ch, Channel):
@@ -167,7 +179,8 @@ class Component:
                 self.exec_properties[key] = param.default
 
         missing = [
-            k for k in self.SPEC.inputs if k not in self.input_channels
+            k for k in self.SPEC.inputs
+            if k not in self.input_channels and k not in self.SPEC.optional_inputs
         ]
         if missing:
             raise TypeError(f"{self.id}: missing required inputs {missing}")
@@ -200,6 +213,7 @@ def component(
     parameters: Optional[Dict[str, Parameter]] = None,
     name: Optional[str] = None,
     external_input_parameters: tuple = (),
+    optional_inputs: tuple = (),
 ) -> Callable[[ExecutorFn], Type[Component]]:
     """Decorator: build a Component subclass from a bare executor function.
 
@@ -217,6 +231,7 @@ def component(
             inputs=dict(inputs or {}),
             outputs=dict(outputs or {}),
             parameters=dict(parameters or {}),
+            optional_inputs=tuple(optional_inputs),
         )
         return type(
             cls_name,

@@ -1,0 +1,10 @@
+"""Model zoo: flax models for the reference workloads (BASELINE configs).
+
+Present:
+  - taxi: Chicago-Taxi wide-and-deep DNN (config 0)
+
+Planned (BASELINE configs 1-4): mnist convnet, ResNet-50, BERT-base, T5-small.
+
+All models take a dict of (transformed) feature arrays, so the same batch
+flows from the input pipeline or the TransformGraph device stage.
+"""

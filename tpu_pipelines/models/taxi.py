@@ -1,0 +1,77 @@
+"""Wide-and-deep model for the Chicago-Taxi workload (BASELINE config 0).
+
+The reference's taxi template trains a wide-and-deep Keras DNN; this is the
+same architecture in flax: embeddings + MLP for the deep path, sparse/one-hot
+linear for the wide path, summed into a single logit.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class WideAndDeep(nn.Module):
+    """Dict-of-features in, (batch,) logit out."""
+
+    numeric_features: Sequence[str]
+    # name -> (cardinality, embed_dim); features must be int id columns.
+    categorical_features: Dict[str, Tuple[int, int]]
+    # names of already-encoded vector features (one-hot / multi-hot).
+    wide_features: Sequence[str] = ()
+    hidden_dims: Sequence[int] = (64, 32)
+
+    @nn.compact
+    def __call__(self, batch: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        deep = [
+            jnp.stack(
+                [jnp.asarray(batch[f], jnp.float32) for f in self.numeric_features],
+                axis=-1,
+            )
+        ]
+        for name, (card, dim) in sorted(self.categorical_features.items()):
+            ids = jnp.asarray(batch[name], jnp.int32)
+            deep.append(nn.Embed(card, dim, name=f"embed_{name}")(ids))
+        x = jnp.concatenate(deep, axis=-1)
+        for i, h in enumerate(self.hidden_dims):
+            x = nn.relu(nn.Dense(h, name=f"dense_{i}")(x))
+        deep_logit = nn.Dense(1, name="deep_head")(x)[..., 0]
+
+        if self.wide_features:
+            wide = jnp.concatenate(
+                [jnp.asarray(batch[f], jnp.float32).reshape(len(deep_logit), -1)
+                 for f in self.wide_features],
+                axis=-1,
+            )
+            wide_logit = nn.Dense(1, name="wide_head")(wide)[..., 0]
+        else:
+            wide_logit = 0.0
+        return deep_logit + wide_logit
+
+
+DEFAULT_HPARAMS = {
+    "numeric_features": ["miles_z", "fare_01", "log_fare_z", "tip_ratio"],
+    "categorical_features": {
+        "company_id": [8, 4],
+        "hour_bucket": [8, 2],
+    },
+    "wide_features": ["payment_onehot", "is_cash"],
+    "hidden_dims": [64, 32],
+    "label": "label_big_tip",
+    "learning_rate": 1e-3,
+    "batch_size": 64,
+}
+
+
+def build_taxi_model(hparams: Dict) -> WideAndDeep:
+    hp = {**DEFAULT_HPARAMS, **(hparams or {})}
+    return WideAndDeep(
+        numeric_features=tuple(hp["numeric_features"]),
+        categorical_features={
+            k: tuple(v) for k, v in hp["categorical_features"].items()
+        },
+        wide_features=tuple(hp["wide_features"]),
+        hidden_dims=tuple(hp["hidden_dims"]),
+    )

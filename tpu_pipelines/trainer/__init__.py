@@ -1,0 +1,16 @@
+"""Trainer runtime: FnArgs contract, jitted train loop, checkpointing, export.
+
+TPU-native equivalent of the TFX Trainer + tf.distribute strategy stack
+(SURVEY.md §2a Trainer, §3.3): the user's ``run_fn(fn_args)`` keeps the TFX
+contract; the distribution strategy is a ``jax.sharding.Mesh`` — the hot loop
+is one jitted train step with the batch sharded over the ``data`` axis and
+gradient all-reduce emitted by XLA over ICI/DCN.
+"""
+
+from tpu_pipelines.trainer.fn_args import FnArgs, TrainResult  # noqa: F401
+from tpu_pipelines.trainer.train_loop import (  # noqa: F401
+    TrainLoopConfig,
+    TrainState,
+    train_loop,
+)
+from tpu_pipelines.trainer.export import export_model, load_exported_model  # noqa: F401

@@ -1,0 +1,136 @@
+"""Model export/load: self-contained serving payloads.
+
+The Model artifact payload (what Pusher ships, what InfraValidator/
+BulkInferrer/serving load) is fully self-contained:
+
+    <uri>/checkpoint/        orbax params checkpoint
+    <uri>/module_copy.py     user module (defines build_model)
+    <uri>/transform_graph/   copy of the resolved TransformGraph (optional)
+    <uri>/model_spec.json    hyperparameters, feature names, format version
+
+Loading reconstructs ``predict(raw_batch)`` = transform host stage (numpy
+string ops) → one jitted on-chip function (numeric transform fused with the
+model forward pass) — preprocessing and model co-located on TPU, the
+``jit_compile=True`` serving/bulk-inference story from BASELINE, with zero
+training/serving skew because the TransformGraph is the same artifact the
+Trainer's input data was materialized through.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from tpu_pipelines.transform.graph import TransformGraph
+from tpu_pipelines.utils.module_loader import load_fn
+
+SPEC_FILE = "model_spec.json"
+MODULE_COPY = "module_copy.py"
+CHECKPOINT_DIR = "checkpoint"
+TRANSFORM_DIR = "transform_graph"
+FORMAT_VERSION = "tpu-pipelines-model/v1"
+
+
+def export_model(
+    *,
+    serving_model_dir: str,
+    params: Any,
+    module_file: str,
+    hyperparameters: Optional[Dict[str, Any]] = None,
+    transform_graph_uri: str = "",
+    extra_spec: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Write a self-contained model payload; returns the dir."""
+    os.makedirs(serving_model_dir, exist_ok=True)
+    import orbax.checkpoint as ocp
+
+    ckpt_path = os.path.abspath(os.path.join(serving_model_dir, CHECKPOINT_DIR))
+    if os.path.exists(ckpt_path):
+        shutil.rmtree(ckpt_path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(ckpt_path, params)
+
+    shutil.copyfile(
+        module_file, os.path.join(serving_model_dir, MODULE_COPY)
+    )
+    if transform_graph_uri:
+        dst = os.path.join(serving_model_dir, TRANSFORM_DIR)
+        if os.path.exists(dst):
+            shutil.rmtree(dst)
+        shutil.copytree(transform_graph_uri, dst)
+    spec = {
+        "format": FORMAT_VERSION,
+        "hyperparameters": hyperparameters or {},
+        "has_transform": bool(transform_graph_uri),
+        **(extra_spec or {}),
+    }
+    with open(os.path.join(serving_model_dir, SPEC_FILE), "w") as f:
+        json.dump(spec, f, indent=2, sort_keys=True, default=str)
+    return serving_model_dir
+
+
+@dataclasses.dataclass
+class LoadedModel:
+    params: Any
+    model: Any                       # flax Module from build_model
+    spec: Dict[str, Any]
+    transform: Optional[TransformGraph]
+    predict: Callable[[Dict[str, np.ndarray]], Any]
+    predict_transformed: Callable[[Dict[str, np.ndarray]], Any]
+
+
+def load_exported_model(uri: str) -> LoadedModel:
+    """Reload an exported payload into a ready predict function."""
+    with open(os.path.join(uri, SPEC_FILE)) as f:
+        spec = json.load(f)
+    if spec.get("format") != FORMAT_VERSION:
+        raise ValueError(
+            f"model at {uri!r} has format {spec.get('format')!r}, "
+            f"expected {FORMAT_VERSION}"
+        )
+    build_model = load_fn(os.path.join(uri, MODULE_COPY), "build_model")
+    model = build_model(spec.get("hyperparameters", {}))
+
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        params = ckptr.restore(
+            os.path.abspath(os.path.join(uri, CHECKPOINT_DIR))
+        )
+
+    transform = None
+    if spec.get("has_transform"):
+        transform = TransformGraph.load(os.path.join(uri, TRANSFORM_DIR))
+
+    @jax.jit
+    def _forward(transformed: Dict[str, Any]):
+        return model.apply({"params": params}, transformed)
+
+    if transform is not None:
+        host_fn, device_fn, _ = transform.split_host_device()
+
+        @jax.jit
+        def _transform_and_forward(iface: Dict[str, Any]):
+            # Numeric transform + model forward in ONE compiled computation.
+            return model.apply({"params": params}, device_fn(iface))
+
+        def predict(raw_batch: Dict[str, np.ndarray]):
+            return _transform_and_forward(host_fn(raw_batch))
+    else:
+        def predict(raw_batch: Dict[str, np.ndarray]):
+            return _forward(raw_batch)
+
+    return LoadedModel(
+        params=params,
+        model=model,
+        spec=spec,
+        transform=transform,
+        predict=predict,
+        predict_transformed=_forward,
+    )

@@ -1,0 +1,44 @@
+"""FnArgs: everything the Trainer hands to user training code.
+
+Mirrors TFX's ``tfx.components.trainer.fn_args_utils.FnArgs`` so workshop
+``run_fn``s port directly: data uris in, model dirs out, plus the mesh and
+transform handles that replace the tf.distribute strategy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class FnArgs:
+    # Data (Examples artifact uris; transformed when a Transform ran).
+    train_examples_uri: str = ""
+    eval_examples_uri: str = ""
+    # Resolved TransformGraph artifact uri ("" when no Transform in the DAG).
+    transform_graph_uri: str = ""
+    schema_uri: str = ""
+    # Output locations.
+    serving_model_dir: str = ""      # final export (Model artifact payload)
+    model_run_dir: str = ""          # checkpoints, logs, profiles
+    # Budgets.
+    train_steps: int = 1000
+    eval_steps: int = 0
+    # Hyperparameters from the Tuner (or user-set); free-form.
+    hyperparameters: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Mesh requested by the component (data/model/seq sizes).
+    mesh_config: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # Anything else the pipeline author wants to thread through.
+    custom_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class TrainResult:
+    """What run_fn reports back; recorded as execution properties."""
+
+    final_metrics: Dict[str, float] = dataclasses.field(default_factory=dict)
+    examples_per_sec: float = 0.0
+    examples_per_sec_per_chip: float = 0.0
+    steps_completed: int = 0
+    resumed_from_step: int = 0

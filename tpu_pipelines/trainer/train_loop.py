@@ -1,0 +1,341 @@
+"""Generic jitted training loop: the framework-owned hot path.
+
+SURVEY.md §3.3 maps the reference's per-step path (tf.function graph →
+CollectiveAllReduce over NCCL) to: one ``jax.jit``-compiled train step with
+params replicated and the batch sharded over the mesh ``data`` axis; XLA
+emits the gradient all-reduce over ICI.  The host loop only feeds batches
+(``device_put`` at the infeed boundary) and drains metrics every
+``log_every`` steps — no per-step host sync.
+
+Also here: the measurement harness (examples/sec/chip — the BASELINE metric),
+orbax checkpoint/resume (the BackupAndRestore equivalent), and optional
+per-parameter sharding rules for model parallelism.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import time
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_pipelines.parallel.mesh import (
+    MeshConfig,
+    data_parallel_sharding,
+    make_mesh,
+    replicate,
+)
+from tpu_pipelines.trainer.fn_args import TrainResult
+
+log = logging.getLogger("tpu_pipelines.trainer")
+
+
+class TrainState(struct.PyTreeNode):
+    """Step counter + params + optimizer state + rng, all on device."""
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    rng: jax.Array
+
+    @classmethod
+    def create(cls, params, optimizer, rng) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=optimizer.init(params),
+            rng=rng,
+        )
+
+
+@dataclasses.dataclass
+class TrainLoopConfig:
+    train_steps: int
+    batch_size: int = 128
+    eval_every: int = 0            # 0 = eval only at the end
+    eval_steps: int = 0            # 0 = full eval split pass per eval
+    checkpoint_every: int = 0      # 0 = no mid-training checkpoints
+    keep_checkpoints: int = 3
+    log_every: int = 100
+    seed: int = 0
+    mesh_config: Optional[MeshConfig] = None
+    # Optional pytree-of-PartitionSpec matching params, for model parallelism;
+    # None = fully replicated params (pure DP, the reference's strategy).
+    param_partition: Optional[Any] = None
+    donate_state: bool = True
+
+
+LossFn = Callable[[Any, Dict[str, jax.Array], jax.Array], Tuple[jax.Array, Dict[str, jax.Array]]]
+
+
+def _param_sharding(mesh: Mesh, config: TrainLoopConfig, params):
+    if config.param_partition is None:
+        return jax.tree_util.tree_map(lambda _: replicate(mesh), params)
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), config.param_partition,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def _key_name(entry) -> str:
+    for attr in ("key", "idx", "name"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
+def _opt_state_sharding(opt_state, params, p_shard, mesh: Mesh):
+    """Shard optimizer state like its matching params, replicate the rest.
+
+    Optax states (e.g. Adam's mu/nu) embed copies of the params pytree, so an
+    opt_state leaf whose tree-path *suffix* and shape match a param leaf gets
+    that param's sharding — Adam moments stay sharded alongside
+    model-parallel params instead of being replicated onto every chip.
+    """
+    param_entries = [
+        (tuple(_key_name(k) for k in path), leaf.shape, shard)
+        for (path, leaf), (_, shard) in zip(
+            jax.tree_util.tree_flatten_with_path(params)[0],
+            jax.tree_util.tree_flatten_with_path(p_shard)[0],
+        )
+    ]
+
+    def match(path, leaf):
+        tail = tuple(_key_name(k) for k in path)
+        for ptail, pshape, pshard in param_entries:
+            if (
+                len(tail) >= len(ptail)
+                and tail[-len(ptail):] == ptail
+                and getattr(leaf, "shape", None) == pshape
+            ):
+                return pshard
+        return replicate(mesh)
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(opt_state)
+    return jax.tree_util.tree_unflatten(
+        treedef, [match(path, leaf) for path, leaf in flat]
+    )
+
+
+def train_loop(
+    *,
+    loss_fn: LossFn,
+    init_params_fn: Callable[[jax.Array, Dict[str, np.ndarray]], Any],
+    optimizer: optax.GradientTransformation,
+    train_iter: Iterable[Dict[str, np.ndarray]],
+    config: TrainLoopConfig,
+    eval_iter_fn: Optional[Callable[[], Iterable[Dict[str, np.ndarray]]]] = None,
+    checkpoint_dir: str = "",
+    mesh: Optional[Mesh] = None,
+    metrics_cb: Optional[Callable[[int, Dict[str, float]], None]] = None,
+) -> Tuple[Any, TrainResult]:
+    """Run the jitted train loop; returns (final_params, TrainResult).
+
+    ``loss_fn(params, batch, rng) -> (loss, metrics)`` must be jax-traceable.
+    ``init_params_fn(rng, sample_batch)`` builds the params pytree.
+    ``train_iter`` yields host batches (dict of numpy, fixed shapes).
+    """
+    if mesh is None:
+        mesh = make_mesh(config.mesh_config)
+    n_devices = mesh.devices.size
+
+    train_it = iter(train_iter)
+    first_batch = next(train_it)
+
+    rng = jax.random.key(config.seed)
+    rng, init_rng = jax.random.split(rng)
+    params = init_params_fn(init_rng, first_batch)
+    p_shard = _param_sharding(mesh, config, params)
+    params = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s), params, p_shard
+    )
+    state = TrainState.create(params, optimizer, rng)
+    # Pin the whole state's sharding explicitly (TrainState.create built
+    # opt_state/step on the default device) so jit's donation is stable.
+    state_shard = TrainState(
+        step=replicate(mesh),
+        params=p_shard,
+        opt_state=_opt_state_sharding(state.opt_state, params, p_shard, mesh),
+        rng=replicate(mesh),
+    )
+    state = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s), state, state_shard
+    )
+    batch_shard = jax.tree_util.tree_map(
+        lambda x: data_parallel_sharding(mesh, np.asarray(x).ndim), first_batch
+    )
+
+    def step_fn(state: TrainState, batch) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        step_rng = jax.random.fold_in(state.rng, state.step)
+        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, batch, step_rng
+        )
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss, **metrics}
+        return (
+            TrainState(
+                step=state.step + 1,
+                params=new_params,
+                opt_state=new_opt,
+                rng=state.rng,
+            ),
+            metrics,
+        )
+
+    train_step = jax.jit(
+        step_fn,
+        in_shardings=(state_shard, batch_shard),
+        out_shardings=(state_shard, None),
+        donate_argnums=(0,) if config.donate_state else (),
+    )
+
+    eval_step = None
+    if eval_iter_fn is not None:
+        def eval_fn(params, batch):
+            loss, metrics = loss_fn(
+                params, batch, jax.random.key(0)
+            )
+            return {"loss": loss, **metrics}
+
+        eval_step = jax.jit(eval_fn)
+
+    # ---- checkpoint manager (resume support)
+    mngr = None
+    start_step = 0
+    if checkpoint_dir:
+        import orbax.checkpoint as ocp
+
+        mngr = ocp.CheckpointManager(
+            os.path.abspath(checkpoint_dir),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=config.keep_checkpoints,
+                save_interval_steps=max(1, config.checkpoint_every),
+            ),
+        )
+        latest = mngr.latest_step()
+        if latest is not None:
+            # rng (a typed PRNG key) is rebuilt from the seed, not restored.
+            saveable = {"step": state.step, "params": state.params,
+                        "opt_state": state.opt_state}
+            abstract = jax.tree_util.tree_map(
+                ocp.utils.to_shape_dtype_struct, saveable
+            )
+            restored = mngr.restore(
+                latest, args=ocp.args.StandardRestore(abstract)
+            )
+            state = TrainState(
+                step=restored["step"],
+                params=restored["params"],
+                opt_state=restored["opt_state"],
+                rng=state.rng,
+            )
+            state = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, s), state, state_shard
+            )
+            start_step = int(latest)
+            log.info("resumed from checkpoint step %d", start_step)
+
+    # ---- the loop
+    def put_batch(b):
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(np.asarray(x), s), b, batch_shard
+        )
+
+    metrics_hist: list = []
+    metrics = None   # stays None when resume starts at/past train_steps
+    t_start = None
+    examples_after_t0 = 0
+    batch = first_batch
+    step = start_step
+    while step < config.train_steps:
+        state, metrics = train_step(state, put_batch(batch))
+        step += 1
+        if t_start is None:
+            # Start timing after step 1 retires (excludes compile time).
+            jax.block_until_ready(metrics["loss"])
+            t_start = time.perf_counter()
+        else:
+            examples_after_t0 += config.batch_size
+        if config.log_every and step % config.log_every == 0:
+            host_metrics = {
+                k: float(v) for k, v in metrics.items()
+            }
+            metrics_hist.append((step, host_metrics))
+            if metrics_cb:
+                metrics_cb(step, host_metrics)
+            log.info("step %d: %s", step, host_metrics)
+        if mngr is not None and config.checkpoint_every:
+            mngr.save(step, args=_ocp_save_args(state))
+        if (
+            eval_step is not None
+            and config.eval_every
+            and step % config.eval_every == 0
+        ):
+            ev = _run_eval(eval_step, state.params, eval_iter_fn, config, put_batch)
+            if metrics_cb:
+                metrics_cb(step, {f"eval_{k}": v for k, v in ev.items()})
+            log.info("step %d eval: %s", step, ev)
+        if step >= config.train_steps:
+            break
+        try:
+            batch = next(train_it)
+        except StopIteration:
+            log.info("train iterator exhausted at step %d", step)
+            break
+
+    jax.block_until_ready(state.params)
+    elapsed = max(1e-9, time.perf_counter() - (t_start or time.perf_counter()))
+    eps = examples_after_t0 / elapsed if examples_after_t0 else 0.0
+
+    # Report the actual final-step metrics (not the last logged snapshot).
+    final_metrics: Dict[str, float] = (
+        {k: float(v) for k, v in metrics.items()} if metrics is not None else {}
+    )
+    if eval_step is not None:
+        ev = _run_eval(eval_step, state.params, eval_iter_fn, config, put_batch)
+        final_metrics.update({f"eval_{k}": v for k, v in ev.items()})
+
+    if mngr is not None:
+        if mngr.latest_step() != step:
+            mngr.save(step, args=_ocp_save_args(state), force=True)
+        mngr.wait_until_finished()
+
+    result = TrainResult(
+        final_metrics=final_metrics,
+        examples_per_sec=round(eps, 2),
+        examples_per_sec_per_chip=round(eps / n_devices, 2),
+        steps_completed=step,
+        resumed_from_step=start_step,
+    )
+    return state.params, result
+
+
+def _ocp_save_args(state):
+    import orbax.checkpoint as ocp
+
+    return ocp.args.StandardSave(
+        {"step": state.step, "params": state.params,
+         "opt_state": state.opt_state}
+    )
+
+
+def _run_eval(eval_step, params, eval_iter_fn, config, put_batch) -> Dict[str, float]:
+    totals: Dict[str, float] = {}
+    n = 0
+    for i, batch in enumerate(eval_iter_fn()):
+        if config.eval_steps and i >= config.eval_steps:
+            break
+        m = eval_step(params, put_batch(batch))
+        for k, v in m.items():
+            totals[k] = totals.get(k, 0.0) + float(v)
+        n += 1
+    return {k: v / max(1, n) for k, v in totals.items()}
