@@ -1,0 +1,240 @@
+"""Evaluator + Pusher + BulkInferrer + InfraValidator over the taxi DAG."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tpu_pipelines.components import (
+    BulkInferrer,
+    CsvExampleGen,
+    Evaluator,
+    InfraValidator,
+    Pusher,
+    SchemaGen,
+    StatisticsGen,
+    Trainer,
+    Transform,
+)
+from tpu_pipelines.data import examples_io
+from tpu_pipelines.dsl.pipeline import Pipeline
+from tpu_pipelines.evaluation.metrics import (
+    EvalOutcome,
+    check_thresholds,
+    compute_metrics,
+)
+from tpu_pipelines.orchestration import LocalDagRunner
+
+HERE = os.path.dirname(__file__)
+TAXI_CSV = os.path.join(HERE, "testdata", "taxi_sample.csv")
+EXAMPLES_DIR = os.path.join(os.path.dirname(HERE), "examples", "taxi")
+PREPROCESS_MODULE = os.path.join(EXAMPLES_DIR, "taxi_preprocessing.py")
+TRAINER_MODULE = os.path.join(EXAMPLES_DIR, "taxi_trainer_module.py")
+
+
+def _full_dag(tmp, push_dest, value_thresholds=None):
+    gen = CsvExampleGen(input_path=TAXI_CSV)
+    stats = StatisticsGen(examples=gen.outputs["examples"])
+    schema = SchemaGen(statistics=stats.outputs["statistics"])
+    transform = Transform(
+        examples=gen.outputs["examples"],
+        schema=schema.outputs["schema"],
+        module_file=PREPROCESS_MODULE,
+    )
+    trainer = Trainer(
+        examples=transform.outputs["transformed_examples"],
+        transform_graph=transform.outputs["transform_graph"],
+        module_file=TRAINER_MODULE,
+        train_steps=30,
+        hyperparameters={"batch_size": 32, "hidden_dims": [16, 8]},
+    )
+    evaluator = Evaluator(
+        examples=transform.outputs["transformed_examples"],
+        model=trainer.outputs["model"],
+        label_key="label_big_tip",
+        slice_columns=["hour_bucket"],
+        batch_size=16,
+        value_thresholds=value_thresholds,
+    )
+    infra = InfraValidator(
+        model=trainer.outputs["model"],
+        examples=gen.outputs["examples"],
+    )
+    pusher = Pusher(
+        model=trainer.outputs["model"],
+        blessing=evaluator.outputs["blessing"],
+        infra_blessing=infra.outputs["blessing"],
+        push_destination=push_dest,
+    )
+    inferrer = BulkInferrer(
+        examples=gen.outputs["examples"],
+        model=trainer.outputs["model"],
+        model_blessing=evaluator.outputs["blessing"],
+        data_splits=["eval"],
+        batch_size=16,
+        passthrough_columns=["company"],
+    )
+    return Pipeline(
+        "taxi-full", [pusher, inferrer],
+        pipeline_root=str(tmp / "root"),
+        metadata_path=str(tmp / "md.sqlite"),
+    )
+
+
+@pytest.fixture(scope="module")
+def dag_result(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("taxi_full")
+    push_dest = str(tmp / "serving")
+    result = LocalDagRunner().run(_full_dag(tmp, push_dest))
+    return result, tmp, push_dest
+
+
+def test_evaluator_metrics_and_blessing(dag_result):
+    result, tmp, _ = dag_result
+    eval_art = result.outputs_of("Evaluator", "evaluation")[0]
+    outcome = EvalOutcome.load(eval_art.uri)
+    overall = outcome.overall()
+    assert 0.0 <= overall.metrics["accuracy"] <= 1.0
+    assert np.isfinite(overall.metrics["loss"])
+    assert "auc" in overall.metrics
+    # Sliced by hour_bucket: overall + up to 4 slices, counts sum to overall.
+    hour_slices = [s for s in outcome.slices if s.slice_key.startswith("hour_bucket=")]
+    assert len(hour_slices) >= 2
+    assert sum(s.num_examples for s in hour_slices) == overall.num_examples
+
+    blessing = result.outputs_of("Evaluator", "blessing")[0]
+    assert os.path.exists(os.path.join(blessing.uri, "BLESSED"))
+
+
+def test_pusher_versioned_push(dag_result):
+    result, tmp, push_dest = dag_result
+    pushed = result.outputs_of("Pusher", "pushed_model")[0]
+    assert pushed.properties["pushed"] is True
+    version = pushed.properties["pushed_version"]
+    vdir = os.path.join(push_dest, str(version))
+    assert os.path.isfile(os.path.join(vdir, "model_spec.json"))
+    assert os.path.isdir(os.path.join(vdir, "checkpoint"))
+    # Pushed payload serves: load it from the push destination.
+    from tpu_pipelines.trainer.export import load_exported_model
+
+    loaded = load_exported_model(vdir)
+    raw = examples_io.read_split(
+        result.outputs_of("CsvExampleGen", "examples")[0].uri, "eval"
+    )
+    preds = np.asarray(loaded.predict({k: v[:4] for k, v in raw.items()}))
+    assert preds.shape == (4,)
+
+
+def test_bulk_inferrer_output(dag_result):
+    result, tmp, _ = dag_result
+    inf = result.outputs_of("BulkInferrer", "inference_result")[0]
+    n_eval = examples_io.num_rows(
+        result.outputs_of("CsvExampleGen", "examples")[0].uri, "eval"
+    )
+    preds = examples_io.read_split(inf.uri, "eval")
+    assert len(preds["prediction"]) == n_eval
+    assert preds["company"].dtype == object  # passthrough survived
+    assert inf.properties["num_predictions"] == n_eval
+
+
+def test_infra_validator_blessed(dag_result):
+    result, _, _ = dag_result
+    blessing = result.outputs_of("InfraValidator", "blessing")[0]
+    assert blessing.properties["blessed"] is True
+
+
+def test_failed_thresholds_block_push(tmp_path):
+    push_dest = str(tmp_path / "serving")
+    result = LocalDagRunner().run(
+        _full_dag(
+            tmp_path, push_dest,
+            value_thresholds={"accuracy": {"lower_bound": 2.0}},  # impossible
+        )
+    )
+    blessing = result.outputs_of("Evaluator", "blessing")[0]
+    assert os.path.exists(os.path.join(blessing.uri, "NOT_BLESSED"))
+    assert blessing.properties["blessed"] is False
+
+    pushed = result.outputs_of("Pusher", "pushed_model")[0]
+    assert pushed.properties["pushed"] is False
+    assert not os.path.isdir(push_dest) or not os.listdir(push_dest)
+    # BulkInferrer also respects the gate.
+    inf = result.outputs_of("BulkInferrer", "inference_result")[0]
+    assert inf.properties.get("skipped") is True
+
+
+def test_infra_validator_catches_corrupt_model(tmp_path):
+    # Break the model payload; canary must NOT bless, not crash.
+    from tpu_pipelines.dsl.pipeline import Pipeline as P2
+
+    gen = CsvExampleGen(input_path=TAXI_CSV)
+    p = P2("gen-only", [gen], pipeline_root=str(tmp_path / "r"),
+           metadata_path=str(tmp_path / "md.sqlite"))
+    r = LocalDagRunner().run(p)
+    examples_art = r.outputs_of("CsvExampleGen", "examples")[0]
+
+    bad_model = tmp_path / "bad_model"
+    bad_model.mkdir()
+    (bad_model / "model_spec.json").write_text(json.dumps({"format": "bogus"}))
+
+    from tpu_pipelines.dsl.component import ExecutorContext
+    from tpu_pipelines.metadata.types import Artifact
+    from tpu_pipelines.components.infra_validator import InfraValidator as IV
+
+    blessing_dir = tmp_path / "blessing"
+    ctx = ExecutorContext(
+        node_id="InfraValidator",
+        inputs={
+            "model": [Artifact(type_name="Model", uri=str(bad_model))],
+            "examples": [examples_art],
+        },
+        outputs={"blessing": [Artifact(type_name="InfraBlessing", uri=str(blessing_dir))]},
+        exec_properties={"split": "eval", "num_examples": 4, "raw_examples": True},
+    )
+    out = IV.EXECUTOR(ctx)
+    assert out["blessed"] is False
+    assert "error" in out
+    assert os.path.exists(blessing_dir / "NOT_BLESSED")
+
+
+def test_metric_computations():
+    scores = np.array([-2.0, -1.0, 1.0, 2.0])
+    labels = np.array([0, 0, 1, 1])
+    m = compute_metrics("binary_classification", scores, labels)
+    assert m["accuracy"] == 1.0
+    assert m["auc"] == 1.0
+    assert m["precision"] == 1.0 and m["recall"] == 1.0
+
+    m2 = compute_metrics(
+        "binary_classification",
+        np.array([2.0, 1.0, -1.0, -2.0]), labels,
+    )
+    assert m2["auc"] == 0.0
+    assert m2["accuracy"] == 0.0
+
+    logits = np.array([[2.0, 0.0], [0.0, 2.0], [2.0, 0.0]])
+    m3 = compute_metrics("multiclass", logits, np.array([0, 1, 1]))
+    assert m3["accuracy"] == pytest.approx(2 / 3)
+
+    m4 = compute_metrics(
+        "regression", np.array([1.0, 2.0]), np.array([1.0, 4.0])
+    )
+    assert m4["mae"] == 1.0 and m4["mse"] == 2.0
+
+
+def test_check_thresholds():
+    ok, fails = check_thresholds({"accuracy": 0.9}, {"accuracy": {"lower_bound": 0.8}})
+    assert ok and not fails
+    ok, fails = check_thresholds({"accuracy": 0.7}, {"accuracy": {"lower_bound": 0.8}})
+    assert not ok and "accuracy" in fails[0]
+    ok, fails = check_thresholds(
+        {"loss": 0.5}, {}, baseline={"loss": 0.4},
+        change_thresholds={"loss": {"higher_is_better": False}},
+    )
+    assert not ok  # loss regressed vs baseline
+    ok, fails = check_thresholds(
+        {"loss": 0.3}, {}, baseline={"loss": 0.4},
+        change_thresholds={"loss": {"higher_is_better": False}},
+    )
+    assert ok

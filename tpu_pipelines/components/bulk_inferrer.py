@@ -1,0 +1,91 @@
+"""BulkInferrer: jit-compiled batch inference over an Examples artifact.
+
+Capability match for TFX BulkInferrer (SURVEY.md §2a row 11), with the
+BASELINE on-chip story: raw examples stream host-side through the embedded
+TransformGraph string stage, and one jitted computation (numeric transform
+fused with model forward) runs per batch on the accelerator.  Predictions are
+written as an InferenceResult artifact (Parquet), joined with any requested
+passthrough columns.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpu_pipelines.data import examples_io
+from tpu_pipelines.data.input_pipeline import BatchIterator, InputConfig
+from tpu_pipelines.dsl.component import Parameter, component
+from tpu_pipelines.trainer.export import load_exported_model
+
+PREDICTIONS_FILE = "predictions"
+
+
+@component(
+    inputs={
+        "examples": "Examples",
+        "model": "Model",
+        "model_blessing": "ModelBlessing",
+    },
+    optional_inputs=("model_blessing",),
+    outputs={"inference_result": "InferenceResult"},
+    parameters={
+        "data_splits": Parameter(type=list, default=None),  # None = all
+        "batch_size": Parameter(type=int, default=1024),
+        # Raw columns copied next to predictions (join keys, ids).
+        "passthrough_columns": Parameter(type=list, default=None),
+        # Examples are raw (apply embedded transform) vs pre-transformed.
+        "raw_examples": Parameter(type=bool, default=True),
+    },
+)
+def BulkInferrer(ctx):
+    from tpu_pipelines.components.evaluator import is_blessed
+
+    out = ctx.output("inference_result")
+    if ctx.inputs.get("model_blessing") and not is_blessed(
+        ctx.input("model_blessing").uri
+    ):
+        out.properties["skipped"] = True
+        return {"skipped": True, "reason": "model not blessed"}
+
+    loaded = load_exported_model(ctx.input("model").uri)
+    predict = (
+        loaded.predict if ctx.exec_properties["raw_examples"]
+        else loaded.predict_transformed
+    )
+    examples_uri = ctx.input("examples").uri
+    splits = ctx.exec_properties["data_splits"] or examples_io.split_names(
+        examples_uri
+    )
+    passthrough = ctx.exec_properties["passthrough_columns"] or []
+    batch_size = ctx.exec_properties["batch_size"]
+
+    total = 0
+    for split in splits:
+        it = BatchIterator(
+            examples_uri, split,
+            InputConfig(batch_size=batch_size, shuffle=False, num_epochs=1,
+                        drop_remainder=False),
+        )
+        preds_parts = []
+        keep = {c: [] for c in passthrough}
+        for batch in it:
+            preds_parts.append(np.asarray(predict(batch)))
+            for c in passthrough:
+                if c not in batch:
+                    raise KeyError(
+                        f"passthrough column {c!r} not in split {split!r}"
+                    )
+                keep[c].append(batch[c])
+        preds = np.concatenate(preds_parts)
+        cols = {c: np.concatenate(v) for c, v in keep.items()}
+        if preds.ndim == 1:
+            cols["prediction"] = preds
+        else:
+            cols["prediction"] = preds.reshape(len(preds), -1)
+        examples_io.write_split(
+            out.uri, split, examples_io.table_from_columns(cols)
+        )
+        total += len(preds)
+    out.properties["num_predictions"] = total
+    out.properties["split_names"] = sorted(splits)
+    return {"num_predictions": total}

@@ -1,0 +1,107 @@
+"""Evaluator: jitted sliced evaluation + blessing gate.
+
+Capability match for TFX Evaluator / TFMA (SURVEY.md §2a row 8): evaluates
+the candidate model on the eval split (jit-compiled forward pass), writes a
+sliced ModelEvaluation artifact, optionally compares against a baseline
+model on the same data, and emits the ModelBlessing gate that Pusher honors.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict
+
+from tpu_pipelines.data.input_pipeline import BatchIterator, InputConfig
+from tpu_pipelines.dsl.component import Parameter, component
+from tpu_pipelines.evaluation.metrics import (
+    EvalOutcome,
+    check_thresholds,
+    evaluate_model,
+)
+from tpu_pipelines.trainer.export import load_exported_model
+
+BLESSING_FILE = "BLESSED"
+NOT_BLESSED_FILE = "NOT_BLESSED"
+
+
+def _evaluate(model_uri: str, examples_uri: str, props: Dict) -> EvalOutcome:
+    loaded = load_exported_model(model_uri)
+    batches = BatchIterator(
+        examples_uri,
+        props["eval_split"],
+        InputConfig(
+            batch_size=props["batch_size"], shuffle=False, num_epochs=1,
+            drop_remainder=False,
+        ),
+    )
+    return evaluate_model(
+        # Eval data is transformed examples; the payload's transform was
+        # already applied at materialization, so use the direct forward pass.
+        loaded.predict_transformed,
+        batches,
+        label_key=props["label_key"],
+        problem=props["problem"],
+        slice_columns=tuple(props["slice_columns"] or ()),
+    )
+
+
+@component(
+    inputs={
+        "examples": "Examples",
+        "model": "Model",
+        "baseline_model": "Model",
+    },
+    optional_inputs=("baseline_model",),
+    outputs={"evaluation": "ModelEvaluation", "blessing": "ModelBlessing"},
+    parameters={
+        "label_key": Parameter(type=str, required=True),
+        "problem": Parameter(type=str, default="binary_classification"),
+        "eval_split": Parameter(type=str, default="eval"),
+        "batch_size": Parameter(type=int, default=512),
+        "slice_columns": Parameter(type=list, default=None),
+        # {"accuracy": {"lower_bound": 0.7}, "loss": {"upper_bound": 1.0}}
+        "value_thresholds": Parameter(type=dict, default=None),
+        # {"accuracy": {"min_improvement": 0.0, "higher_is_better": True}}
+        "change_thresholds": Parameter(type=dict, default=None),
+    },
+)
+def Evaluator(ctx):
+    props = ctx.exec_properties
+    examples_uri = ctx.input("examples").uri
+    outcome = _evaluate(ctx.input("model").uri, examples_uri, props)
+
+    baseline_overall = None
+    if ctx.inputs.get("baseline_model"):
+        baseline_outcome = _evaluate(
+            ctx.input("baseline_model").uri, examples_uri, props
+        )
+        baseline_overall = baseline_outcome.overall().metrics
+
+    eval_art = ctx.output("evaluation")
+    outcome.save(eval_art.uri)
+    overall = outcome.overall()
+    eval_art.properties["overall_metrics"] = overall.metrics
+
+    blessed, reasons = check_thresholds(
+        overall.metrics,
+        props["value_thresholds"] or {},
+        baseline=baseline_overall,
+        change_thresholds=props["change_thresholds"] or {},
+    )
+    blessing_art = ctx.output("blessing")
+    os.makedirs(blessing_art.uri, exist_ok=True)
+    marker = BLESSING_FILE if blessed else NOT_BLESSED_FILE
+    with open(os.path.join(blessing_art.uri, marker), "w") as f:
+        json.dump({"reasons": reasons}, f)
+    blessing_art.properties["blessed"] = blessed
+    return {
+        "blessed": blessed,
+        "not_blessed_reasons": reasons,
+        **{f"overall_{k}": v for k, v in overall.metrics.items()},
+        "num_slices": len(outcome.slices),
+    }
+
+
+def is_blessed(blessing_uri: str) -> bool:
+    return os.path.exists(os.path.join(blessing_uri, BLESSING_FILE))

@@ -1,0 +1,64 @@
+"""InfraValidator: canary-load the model and smoke-infer before pushing.
+
+Capability match for TFX InfraValidator (SURVEY.md §2a row 9): loads the
+exported payload exactly the way serving does (``load_exported_model``), runs
+a smoke inference on a few real examples, and emits an InfraBlessing that
+Pusher can require.  The reference spins a serving container for this; here
+the serving runtime *is* the in-process loader, so loading in-process is the
+faithful canary.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from tpu_pipelines.data import examples_io
+from tpu_pipelines.dsl.component import Parameter, component
+from tpu_pipelines.trainer.export import load_exported_model
+
+BLESSING_FILE = "BLESSED"
+NOT_BLESSED_FILE = "NOT_BLESSED"
+
+
+@component(
+    inputs={"model": "Model", "examples": "Examples"},
+    outputs={"blessing": "InfraBlessing"},
+    parameters={
+        "split": Parameter(type=str, default="eval"),
+        "num_examples": Parameter(type=int, default=8),
+        # Raw examples (apply embedded transform) vs pre-transformed.
+        "raw_examples": Parameter(type=bool, default=True),
+    },
+)
+def InfraValidator(ctx):
+    blessing = ctx.output("blessing")
+    os.makedirs(blessing.uri, exist_ok=True)
+    n = ctx.exec_properties["num_examples"]
+    split = ctx.exec_properties["split"]
+    error = ""
+    try:
+        loaded = load_exported_model(ctx.input("model").uri)
+        data = examples_io.read_split(ctx.input("examples").uri, split)
+        batch = {k: v[:n] for k, v in data.items()}
+        predict = (
+            loaded.predict if ctx.exec_properties["raw_examples"]
+            else loaded.predict_transformed
+        )
+        preds = np.asarray(predict(batch))
+        if len(preds) != len(next(iter(batch.values()))):
+            error = f"prediction count {len(preds)} != batch size"
+        elif not np.isfinite(np.asarray(preds, dtype=np.float64)).all():
+            error = "non-finite predictions"
+    except Exception as e:  # the canary's entire job is catching these
+        error = f"{type(e).__name__}: {e}"
+
+    marker = NOT_BLESSED_FILE if error else BLESSING_FILE
+    with open(os.path.join(blessing.uri, marker), "w") as f:
+        json.dump({"error": error}, f)
+    blessing.properties["blessed"] = not error
+    if error:
+        return {"blessed": False, "error": error}
+    return {"blessed": True}

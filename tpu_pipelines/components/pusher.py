@@ -1,0 +1,61 @@
+"""Pusher: atomically publish a blessed model to the serving destination.
+
+Capability match for TFX Pusher (SURVEY.md §2a row 10): checks the
+Evaluator's (and optionally InfraValidator's) blessing, then copies the model
+payload into a monotonically-versioned directory under ``push_destination``
+— staged to a temp dir and renamed, so a serving binary watching the
+directory never sees a partial version (the TF Serving version-dir
+convention).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+
+from tpu_pipelines.dsl.component import Parameter, component
+
+
+@component(
+    inputs={
+        "model": "Model",
+        "blessing": "ModelBlessing",
+        "infra_blessing": "InfraBlessing",
+    },
+    optional_inputs=("blessing", "infra_blessing"),
+    outputs={"pushed_model": "PushedModel"},
+    parameters={
+        "push_destination": Parameter(type=str, required=True),
+    },
+)
+def Pusher(ctx):
+    from tpu_pipelines.components.evaluator import is_blessed
+
+    pushed_art = ctx.output("pushed_model")
+    os.makedirs(pushed_art.uri, exist_ok=True)
+
+    for key in ("blessing", "infra_blessing"):
+        if ctx.inputs.get(key) and not is_blessed(ctx.input(key).uri):
+            pushed_art.properties["pushed"] = False
+            pushed_art.properties["skip_reason"] = f"{key} = NOT_BLESSED"
+            return {"pushed": False, "skip_reason": f"{key} = NOT_BLESSED"}
+
+    dest = ctx.exec_properties["push_destination"]
+    os.makedirs(dest, exist_ok=True)
+    existing = [int(d) for d in os.listdir(dest) if d.isdigit()]
+    version = max(existing, default=int(time.time()) - 1) + 1
+
+    staging = os.path.join(dest, f".staging-{version}")
+    if os.path.exists(staging):
+        shutil.rmtree(staging)
+    shutil.copytree(ctx.input("model").uri, staging)
+    final = os.path.join(dest, str(version))
+    os.rename(staging, final)  # atomic within a filesystem
+
+    with open(os.path.join(pushed_art.uri, "pushed_version.txt"), "w") as f:
+        f.write(f"{final}\n")
+    pushed_art.properties.update(
+        {"pushed": True, "pushed_version": version, "pushed_destination": final}
+    )
+    return {"pushed": True, "pushed_version": version, "destination": final}

@@ -1,0 +1,13 @@
+"""Evaluation: jitted batch eval, sliced metrics, model comparison.
+
+TPU-native equivalent of TFMA (SURVEY.md §2a Evaluator): predictions come
+from the exported model's jitted forward pass; metric aggregation is exact
+numpy over collected (prediction, label) arrays, grouped by slice.
+"""
+
+from tpu_pipelines.evaluation.metrics import (  # noqa: F401
+    EvalOutcome,
+    SliceMetrics,
+    compute_metrics,
+    evaluate_model,
+)

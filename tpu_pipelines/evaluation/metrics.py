@@ -1,0 +1,228 @@
+"""Sliced metric computation over model predictions.
+
+Problem types: ``binary_classification`` (logits → loss/accuracy/AUC/
+precision/recall), ``multiclass`` (logits → loss/accuracy), ``regression``
+(predictions → mse/mae).  Slicing follows TFMA: the overall slice plus one
+slice per distinct value of each configured slice column.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+BINARY = "binary_classification"
+MULTICLASS = "multiclass"
+REGRESSION = "regression"
+
+METRICS_FILE = "metrics.json"
+
+
+@dataclasses.dataclass
+class SliceMetrics:
+    slice_key: str              # "" for overall, else "column=value"
+    num_examples: int
+    metrics: Dict[str, float]
+
+
+@dataclasses.dataclass
+class EvalOutcome:
+    problem: str
+    slices: List[SliceMetrics]
+
+    def overall(self) -> SliceMetrics:
+        for s in self.slices:
+            if s.slice_key == "":
+                return s
+        raise ValueError("no overall slice")
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "problem": self.problem,
+            "slices": [dataclasses.asdict(s) for s in self.slices],
+        }
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> "EvalOutcome":
+        return cls(
+            problem=d["problem"],
+            slices=[SliceMetrics(**s) for s in d["slices"]],
+        )
+
+    def save(self, uri: str) -> str:
+        os.makedirs(uri, exist_ok=True)
+        path = os.path.join(uri, METRICS_FILE)
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f, indent=2, sort_keys=True)
+        return path
+
+    @classmethod
+    def load(cls, uri: str) -> "EvalOutcome":
+        with open(os.path.join(uri, METRICS_FILE)) as f:
+            return cls.from_json(json.load(f))
+
+
+def _binary_metrics(scores: np.ndarray, labels: np.ndarray) -> Dict[str, float]:
+    labels = labels.astype(np.float64)
+    probs = 1.0 / (1.0 + np.exp(-scores.astype(np.float64)))
+    eps = 1e-7
+    loss = float(
+        -np.mean(labels * np.log(probs + eps) + (1 - labels) * np.log(1 - probs + eps))
+    )
+    pred = (probs >= 0.5).astype(np.float64)
+    tp = float(np.sum((pred == 1) & (labels == 1)))
+    fp = float(np.sum((pred == 1) & (labels == 0)))
+    fn = float(np.sum((pred == 0) & (labels == 1)))
+    out = {
+        "loss": loss,
+        "accuracy": float(np.mean(pred == labels)),
+        "precision": tp / (tp + fp) if tp + fp else 0.0,
+        "recall": tp / (tp + fn) if tp + fn else 0.0,
+    }
+    n_pos, n_neg = int(labels.sum()), int(len(labels) - labels.sum())
+    if n_pos and n_neg:
+        # Exact AUC via the rank-sum (Mann-Whitney) statistic.
+        order = np.argsort(scores, kind="mergesort")
+        ranks = np.empty(len(scores), dtype=np.float64)
+        ranks[order] = np.arange(1, len(scores) + 1)
+        # average ties
+        sorted_scores = scores[order]
+        i = 0
+        while i < len(sorted_scores):
+            j = i
+            while j + 1 < len(sorted_scores) and sorted_scores[j + 1] == sorted_scores[i]:
+                j += 1
+            if j > i:
+                ranks[order[i : j + 1]] = (i + 1 + j + 1) / 2.0
+            i = j + 1
+        auc = (ranks[labels == 1].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+        out["auc"] = float(auc)
+    return out
+
+
+def _multiclass_metrics(logits: np.ndarray, labels: np.ndarray) -> Dict[str, float]:
+    labels = labels.astype(np.int64)
+    z = logits - logits.max(axis=-1, keepdims=True)
+    logp = z - np.log(np.exp(z).sum(axis=-1, keepdims=True))
+    loss = float(-np.mean(logp[np.arange(len(labels)), labels]))
+    pred = logits.argmax(axis=-1)
+    return {"loss": loss, "accuracy": float(np.mean(pred == labels))}
+
+
+def _regression_metrics(preds: np.ndarray, labels: np.ndarray) -> Dict[str, float]:
+    preds = preds.astype(np.float64)
+    labels = labels.astype(np.float64)
+    err = preds - labels
+    return {
+        "mse": float(np.mean(err ** 2)),
+        "mae": float(np.mean(np.abs(err))),
+    }
+
+
+def compute_metrics(
+    problem: str, predictions: np.ndarray, labels: np.ndarray
+) -> Dict[str, float]:
+    if problem == BINARY:
+        return _binary_metrics(predictions, labels)
+    if problem == MULTICLASS:
+        return _multiclass_metrics(predictions, labels)
+    if problem == REGRESSION:
+        return _regression_metrics(predictions, labels)
+    raise ValueError(f"unknown problem type {problem!r}")
+
+
+def evaluate_model(
+    predict_fn: Callable[[Dict[str, np.ndarray]], Any],
+    batches: Iterable[Dict[str, np.ndarray]],
+    label_key: str,
+    problem: str = BINARY,
+    slice_columns: Tuple[str, ...] = (),
+) -> EvalOutcome:
+    """Run jitted predictions over batches, aggregate sliced metrics exactly."""
+    all_preds: List[np.ndarray] = []
+    all_labels: List[np.ndarray] = []
+    slice_vals: Dict[str, List[np.ndarray]] = {c: [] for c in slice_columns}
+    for batch in batches:
+        if label_key not in batch:
+            raise KeyError(
+                f"label column {label_key!r} missing from eval batch "
+                f"(have {sorted(batch)})"
+            )
+        preds = np.asarray(predict_fn(batch))
+        all_preds.append(preds)
+        all_labels.append(np.asarray(batch[label_key]))
+        for c in slice_columns:
+            if c not in batch:
+                raise KeyError(f"slice column {c!r} missing from eval batch")
+            slice_vals[c].append(np.asarray(batch[c]))
+    if not all_preds:
+        raise ValueError("evaluate_model received no batches")
+    preds = np.concatenate(all_preds)
+    labels = np.concatenate(all_labels)
+
+    slices = [
+        SliceMetrics("", len(labels), compute_metrics(problem, preds, labels))
+    ]
+    for c in slice_columns:
+        vals = np.concatenate(slice_vals[c])
+        for v in np.unique(vals):
+            mask = vals == v
+            if not mask.any():
+                continue
+            slices.append(
+                SliceMetrics(
+                    f"{c}={v}",
+                    int(mask.sum()),
+                    compute_metrics(problem, preds[mask], labels[mask]),
+                )
+            )
+    return EvalOutcome(problem=problem, slices=slices)
+
+
+def check_thresholds(
+    current: Dict[str, float],
+    value_thresholds: Dict[str, Dict[str, float]],
+    baseline: Optional[Dict[str, float]] = None,
+    change_thresholds: Optional[Dict[str, Dict[str, float]]] = None,
+) -> Tuple[bool, List[str]]:
+    """Blessing gate.  Returns (blessed, reasons-for-failure)."""
+    failures: List[str] = []
+    for metric, bounds in (value_thresholds or {}).items():
+        if metric not in current:
+            failures.append(f"metric {metric!r} not computed")
+            continue
+        v = current[metric]
+        if "lower_bound" in bounds and v < bounds["lower_bound"]:
+            failures.append(
+                f"{metric}={v:.6f} < lower_bound {bounds['lower_bound']}"
+            )
+        if "upper_bound" in bounds and v > bounds["upper_bound"]:
+            failures.append(
+                f"{metric}={v:.6f} > upper_bound {bounds['upper_bound']}"
+            )
+    for metric, bounds in (change_thresholds or {}).items():
+        if baseline is None:
+            failures.append(
+                f"change threshold on {metric!r} but no baseline model"
+            )
+            continue
+        if metric not in current or metric not in baseline:
+            failures.append(f"metric {metric!r} missing for comparison")
+            continue
+        # higher_is_better defaults True; loss-like metrics set it False.
+        hib = bounds.get("higher_is_better", True)
+        delta = (
+            current[metric] - baseline[metric]
+            if hib else baseline[metric] - current[metric]
+        )
+        min_impr = bounds.get("min_improvement", 0.0)
+        if delta < min_impr:
+            failures.append(
+                f"{metric} improvement {delta:.6f} < required {min_impr}"
+                f" (current {current[metric]:.6f}, baseline {baseline[metric]:.6f})"
+            )
+    return (not failures, failures)
