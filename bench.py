@@ -1,0 +1,108 @@
+"""Benchmark: Trainer examples/sec/chip on the flagship pipeline model.
+
+Run by the driver on real TPU hardware at the end of each round; prints ONE
+JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+The metric is BASELINE.json's headline ("TFX Trainer examples/sec/chip") —
+the framework train loop's steady-state throughput on the taxi wide-and-deep
+workload, timed after compile.  The reference publishes no numbers
+(BASELINE.json "published": {}), so vs_baseline is measured against the
+first recorded run of this benchmark (BENCH_SELF_BASELINE.json, committed in
+round 1) — i.e. it tracks speedups of this framework over its own round-1
+state; 1.0 on the round that creates the baseline.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+SELF_BASELINE_FILE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_SELF_BASELINE.json"
+)
+
+BATCH_SIZE = 8192
+TRAIN_STEPS = 40
+N_ROWS = 65536
+
+
+def synthetic_transformed_batchset(n: int):
+    """Synthetic taxi-like transformed features (what Transform materializes)."""
+    rng = np.random.default_rng(0)
+    return {
+        "miles_z": rng.normal(size=n).astype(np.float32),
+        "fare_01": rng.random(size=n).astype(np.float32),
+        "log_fare_z": rng.normal(size=n).astype(np.float32),
+        "tip_ratio": rng.random(size=n).astype(np.float32),
+        "hour_bucket": rng.integers(0, 4, size=n).astype(np.int32),
+        "company_id": rng.integers(0, 6, size=n).astype(np.int32),
+        "payment_onehot": np.eye(2, dtype=np.float32)[
+            rng.integers(0, 2, size=n)
+        ],
+        "is_cash": rng.integers(0, 2, size=n).astype(np.float32),
+        "label_big_tip": rng.integers(0, 2, size=n).astype(np.float32),
+    }
+
+
+def batches(data, batch_size):
+    n = len(data["miles_z"])
+    i = 0
+    while True:
+        rows = np.arange(i, i + batch_size) % n
+        yield {k: v[rows] for k, v in data.items()}
+        i = (i + batch_size) % n
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_pipelines.models.taxi import DEFAULT_HPARAMS, build_taxi_model
+    from tpu_pipelines.trainer import TrainLoopConfig, train_loop
+
+    n_devices = len(jax.devices())
+    hp = {**DEFAULT_HPARAMS, "hidden_dims": [256, 128, 64]}
+    model = build_taxi_model(hp)
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply({"params": params}, batch)
+        labels = jnp.asarray(batch["label_big_tip"], jnp.float32)
+        loss = optax.sigmoid_binary_cross_entropy(logits, labels).mean()
+        return loss, {}
+
+    def init_fn(rng, sample):
+        return model.init(rng, sample)["params"]
+
+    data = synthetic_transformed_batchset(N_ROWS)
+    _, result = train_loop(
+        loss_fn=loss_fn,
+        init_params_fn=init_fn,
+        optimizer=optax.adam(1e-3),
+        train_iter=batches(data, BATCH_SIZE),
+        config=TrainLoopConfig(
+            train_steps=TRAIN_STEPS, batch_size=BATCH_SIZE, log_every=0,
+        ),
+    )
+    value = result.examples_per_sec_per_chip
+
+    if os.path.exists(SELF_BASELINE_FILE):
+        with open(SELF_BASELINE_FILE) as f:
+            base = json.load(f)["value"]
+        vs_baseline = round(value / base, 4) if base else 1.0
+    else:
+        vs_baseline = 1.0
+
+    print(json.dumps({
+        "metric": "taxi_trainer_examples_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "examples/sec/chip",
+        "vs_baseline": vs_baseline,
+    }))
+
+
+if __name__ == "__main__":
+    main()
