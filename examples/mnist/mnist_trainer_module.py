@@ -1,0 +1,97 @@
+"""MNIST trainer module file (BASELINE config 1).
+
+Same ``run_fn`` contract as the taxi module: the pipeline's Trainer imports
+this by path.  Expects Examples rows with an ``image`` column (flattened
+28*28 floats or (28,28) arrays) and an integer ``label`` column.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tpu_pipelines.data.input_pipeline import BatchIterator, InputConfig
+from tpu_pipelines.models.mnist import DEFAULT_HPARAMS, build_mnist_model
+from tpu_pipelines.parallel.mesh import MeshConfig
+from tpu_pipelines.trainer import TrainLoopConfig, export_model, train_loop
+
+
+def build_model(hyperparameters):
+    return build_mnist_model(hyperparameters)
+
+
+def apply_fn(model, params, batch):
+    """Serving hook: pull the image column out of the feature dict."""
+    img = jnp.asarray(batch["image"], jnp.float32)
+    if img.ndim == 2:
+        img = img.reshape(img.shape[0], 28, 28, 1)
+    return model.apply({"params": params}, img)
+
+
+def _to_images(batch):
+    img = np.asarray(batch["image"], np.float32)
+    if img.ndim == 2:  # flattened rows
+        img = img.reshape(len(img), 28, 28, 1)
+    return img
+
+
+def run_fn(fn_args):
+    hp = {**DEFAULT_HPARAMS, **fn_args.hyperparameters}
+    model = build_model(hp)
+    batch_size = int(hp["batch_size"])
+
+    def with_images(it):
+        for b in it:
+            yield {**b, "image": _to_images(b)}
+
+    train_iter = with_images(BatchIterator(
+        fn_args.train_examples_uri, "train",
+        InputConfig(batch_size=batch_size, shuffle=True, seed=0),
+    ))
+
+    def eval_iter_fn():
+        return with_images(BatchIterator(
+            fn_args.eval_examples_uri, "eval",
+            InputConfig(batch_size=batch_size, shuffle=False, num_epochs=1,
+                        drop_remainder=True),
+        ))
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply({"params": params}, batch["image"],
+                             train=True, dropout_rng=rng)
+        labels = jnp.asarray(batch["label"], jnp.int32)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+        accuracy = jnp.mean(jnp.argmax(logits, -1) == labels)
+        return loss, {"accuracy": accuracy}
+
+    def init_params_fn(rng, sample_batch):
+        return model.init(rng, sample_batch["image"])["params"]
+
+    mesh_cfg = MeshConfig(**fn_args.mesh_config) if fn_args.mesh_config else None
+    params, result = train_loop(
+        loss_fn=loss_fn,
+        init_params_fn=init_params_fn,
+        optimizer=optax.adam(hp["learning_rate"]),
+        train_iter=train_iter,
+        eval_iter_fn=eval_iter_fn,
+        config=TrainLoopConfig(
+            train_steps=fn_args.train_steps,
+            batch_size=batch_size,
+            eval_steps=fn_args.eval_steps,
+            checkpoint_every=max(1, fn_args.train_steps // 4),
+            log_every=max(1, fn_args.train_steps // 10),
+            mesh_config=mesh_cfg,
+        ),
+        checkpoint_dir=fn_args.model_run_dir,
+    )
+
+    export_model(
+        serving_model_dir=fn_args.serving_model_dir,
+        params=params,
+        module_file=__file__,
+        hyperparameters=hp,
+        transform_graph_uri=fn_args.transform_graph_uri,
+        extra_spec={"label": "label"},
+    )
+    return result

@@ -61,8 +61,9 @@ def test_train_loop_converges_and_measures():
     assert abs(float(params["b"][0]) + 1.0) < 0.1
     assert result.final_metrics["loss"] < 0.01
     assert result.examples_per_sec > 0
+    # Both fields are rounded to 2 decimals, so allow that much slack.
     assert result.examples_per_sec_per_chip == pytest.approx(
-        result.examples_per_sec / 8, rel=1e-6
+        result.examples_per_sec / 8, rel=1e-3, abs=0.01
     )
     assert result.steps_completed == 200
 

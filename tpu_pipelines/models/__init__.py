@@ -2,9 +2,12 @@
 
 Present:
   - taxi: Chicago-Taxi wide-and-deep DNN (config 0)
+  - mnist: Keras-CNN-equivalent convnet (config 1)
+  - resnet: ResNet-18/34/50/101/152, NHWC bfloat16 (config 2)
 
-Planned (BASELINE configs 1-4): mnist convnet, ResNet-50, BERT-base, T5-small.
+Planned (BASELINE configs 3-4): BERT-base, T5-small.
 
-All models take a dict of (transformed) feature arrays, so the same batch
-flows from the input pipeline or the TransformGraph device stage.
+Tabular models (taxi) take a dict of (transformed) feature arrays; array-input
+models (mnist, resnet) define an ``apply_fn`` hook in their trainer module file
+so the serving/export path can adapt the feature dict (see trainer/export.py).
 """

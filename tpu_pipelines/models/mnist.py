@@ -1,0 +1,61 @@
+"""MNIST CNN (BASELINE config 1: "MNIST Keras CNN via TFX Trainer").
+
+The reference trains a small Keras convnet through the Trainer's ``run_fn``
+under a single-host strategy (SURVEY.md §0, configs[1]).  Same capability
+here as a flax module driven by the framework train loop: two conv blocks +
+MLP head, NHWC layout (what XLA:TPU expects for conv tiling).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class MnistCNN(nn.Module):
+    """(batch, 28, 28, 1) images in, (batch, num_classes) logits out."""
+
+    num_classes: int = 10
+    conv_features: Sequence[int] = (32, 64)
+    hidden_dim: int = 128
+    dropout_rate: float = 0.25
+
+    @nn.compact
+    def __call__(self, images: jnp.ndarray, *, train: bool = False,
+                 dropout_rng=None) -> jnp.ndarray:
+        x = jnp.asarray(images, jnp.float32)
+        if x.ndim == 3:
+            x = x[..., None]
+        for i, feat in enumerate(self.conv_features):
+            x = nn.Conv(feat, kernel_size=(3, 3), name=f"conv_{i}")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden_dim, name="dense_0")(x))
+        if train and self.dropout_rate > 0:
+            x = nn.Dropout(rate=self.dropout_rate, deterministic=False)(
+                x, rng=dropout_rng
+            )
+        return nn.Dense(self.num_classes, name="head")(x)
+
+
+DEFAULT_HPARAMS = {
+    "num_classes": 10,
+    "conv_features": [32, 64],
+    "hidden_dim": 128,
+    "dropout_rate": 0.25,
+    "learning_rate": 1e-3,
+    "batch_size": 256,
+}
+
+
+def build_mnist_model(hparams: Dict) -> MnistCNN:
+    hp = {**DEFAULT_HPARAMS, **(hparams or {})}
+    return MnistCNN(
+        num_classes=int(hp["num_classes"]),
+        conv_features=tuple(hp["conv_features"]),
+        hidden_dim=int(hp["hidden_dim"]),
+        dropout_rate=float(hp["dropout_rate"]),
+    )

@@ -1,0 +1,131 @@
+"""ResNet for image classification (BASELINE config 2: ResNet-50 ImageNet).
+
+The reference runs ResNet-50 under ``MultiWorkerMirroredStrategy`` in a
+multi-worker Kubeflow pod (SURVEY.md §0 configs[2]); here the model is a flax
+module whose scaling comes from the framework mesh (batch over ``data``) —
+the train loop, not the model, owns distribution.
+
+TPU-first choices: NHWC layout, bfloat16 compute with float32 params/batch
+stats (MXU-friendly), BatchNorm folded into flax's mutable-collection idiom.
+``v1.5`` bottleneck ordering (stride on the 3x3) matches the torchvision /
+Keras variant the reference family uses.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+ModuleDef = Any
+
+# depth -> per-stage block counts
+STAGE_SIZES = {
+    18: (2, 2, 2, 2),
+    34: (3, 4, 6, 3),
+    50: (3, 4, 6, 3),
+    101: (3, 4, 23, 3),
+    152: (3, 8, 36, 3),
+}
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = nn.relu(self.norm()(y))
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = nn.relu(self.norm()(y))
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), self.strides, name="proj"
+            )(residual)
+            residual = self.norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = nn.relu(self.norm()(y))
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides,
+                                 name="proj")(residual)
+            residual = self.norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """NHWC images in, (batch, num_classes) logits out.
+
+    Call with ``train=True`` inside ``nn.Module.apply(..., mutable=["batch_stats"])``
+    to update BatchNorm statistics.
+    """
+
+    num_classes: int = 1000
+    depth: int = 50
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, images: jnp.ndarray, *, train: bool = False):
+        stage_sizes = STAGE_SIZES[self.depth]
+        block_cls = BottleneckBlock if self.depth >= 50 else BasicBlock
+        conv = functools.partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, padding="SAME"
+        )
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype,
+        )
+        x = jnp.asarray(images, self.dtype)
+        x = conv(self.width, (7, 7), (2, 2), name="conv_init")(x)
+        x = nn.relu(norm(name="bn_init")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(stage_sizes):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = block_cls(
+                    filters=self.width * 2 ** i, conv=conv, norm=norm,
+                    strides=strides, name=f"stage{i}_block{j}",
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        # Head in float32 for numerically stable softmax/loss.
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+DEFAULT_HPARAMS = {
+    "num_classes": 1000,
+    "depth": 50,
+    "width": 64,
+    "learning_rate": 0.1,
+    "batch_size": 1024,
+}
+
+
+def build_resnet_model(hparams: Dict) -> ResNet:
+    hp = {**DEFAULT_HPARAMS, **(hparams or {})}
+    return ResNet(
+        num_classes=int(hp["num_classes"]),
+        depth=int(hp["depth"]),
+        width=int(hp["width"]),
+    )

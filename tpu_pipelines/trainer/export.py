@@ -28,7 +28,7 @@ import jax
 import numpy as np
 
 from tpu_pipelines.transform.graph import TransformGraph
-from tpu_pipelines.utils.module_loader import load_fn
+from tpu_pipelines.utils.module_loader import load_fn, load_module
 
 SPEC_FILE = "model_spec.json"
 MODULE_COPY = "module_copy.py"
@@ -94,8 +94,16 @@ def load_exported_model(uri: str) -> LoadedModel:
             f"model at {uri!r} has format {spec.get('format')!r}, "
             f"expected {FORMAT_VERSION}"
         )
-    build_model = load_fn(os.path.join(uri, MODULE_COPY), "build_model")
+    module_copy = os.path.join(uri, MODULE_COPY)
+    module = load_module(module_copy)
+    build_model = load_fn(module_copy, "build_model")
     model = build_model(spec.get("hyperparameters", {}))
+    # Optional module hook for models whose __call__ is not dict-of-features
+    # (e.g. image models taking one array): apply_fn(model, params, batch).
+    apply_fn = getattr(
+        module, "apply_fn",
+        lambda model, params, batch: model.apply({"params": params}, batch),
+    )
 
     import orbax.checkpoint as ocp
 
@@ -110,7 +118,7 @@ def load_exported_model(uri: str) -> LoadedModel:
 
     @jax.jit
     def _forward(transformed: Dict[str, Any]):
-        return model.apply({"params": params}, transformed)
+        return apply_fn(model, params, transformed)
 
     if transform is not None:
         host_fn, device_fn, _ = transform.split_host_device()
@@ -118,7 +126,7 @@ def load_exported_model(uri: str) -> LoadedModel:
         @jax.jit
         def _transform_and_forward(iface: Dict[str, Any]):
             # Numeric transform + model forward in ONE compiled computation.
-            return model.apply({"params": params}, device_fn(iface))
+            return apply_fn(model, params, device_fn(iface))
 
         def predict(raw_batch: Dict[str, np.ndarray]):
             return _transform_and_forward(host_fn(raw_batch))
