@@ -1,0 +1,171 @@
+"""Text model family: BERT (config 3) and T5 (config 4) on the CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_pipelines.models.bert import (
+    bert_partition_rules,
+    build_bert_model,
+)
+from tpu_pipelines.models.t5 import build_t5_model, t5_partition_rules
+from tpu_pipelines.parallel.mesh import MeshConfig, make_mesh
+from tpu_pipelines.parallel.partition import (
+    make_param_partition,
+    validate_partition,
+)
+from tpu_pipelines.trainer import TrainLoopConfig, train_loop
+
+TINY_BERT = {
+    "vocab_size": 64, "d_model": 32, "n_layers": 2, "n_heads": 4,
+    "d_ff": 64, "max_len": 32, "dropout_rate": 0.0, "num_classes": 3,
+}
+TINY_T5 = {
+    "vocab_size": 48, "d_model": 32, "n_layers": 2, "n_heads": 4,
+    "head_dim": 8, "d_ff": 64, "dropout_rate": 0.0,
+}
+
+
+def _bert_batch(b=4, l=16, seed=0, vocab=64):
+    rng = np.random.default_rng(seed)
+    return {
+        "input_ids": rng.integers(0, vocab, size=(b, l)).astype(np.int32),
+        "attention_mask": np.ones((b, l), np.int32),
+    }
+
+
+def test_bert_classifier_forward():
+    model = build_bert_model(TINY_BERT)
+    batch = _bert_batch()
+    params = model.init(jax.random.key(0), batch)["params"]
+    logits = model.apply({"params": params}, batch)
+    assert logits.shape == (4, 3)
+    assert logits.dtype == jnp.float32
+
+
+def test_bert_mlm_forward():
+    model = build_bert_model({**TINY_BERT, "head": "mlm"})
+    batch = _bert_batch()
+    params = model.init(jax.random.key(0), batch)["params"]
+    logits = model.apply({"params": params}, batch)
+    assert logits.shape == (4, 16, 64)
+
+
+def test_bert_ring_attention_matches_dense():
+    # Same params, same batch: ring SP over seq must equal the dense path.
+    mesh = make_mesh(MeshConfig(data=2, seq=2, model=2))
+    dense = build_bert_model(TINY_BERT)
+    ring = build_bert_model({**TINY_BERT, "attn_impl": "ring"}, mesh=mesh)
+    batch = _bert_batch(b=4, l=16)
+    params = dense.init(jax.random.key(0), batch)["params"]
+
+    want = dense.apply({"params": params}, batch)
+    sharded_batch = {
+        "input_ids": jax.device_put(
+            batch["input_ids"], NamedSharding(mesh, P("data", "seq"))
+        ),
+        "attention_mask": jax.device_put(
+            batch["attention_mask"], NamedSharding(mesh, P("data", "seq"))
+        ),
+    }
+    got = jax.jit(lambda p, b: ring.apply({"params": p}, b))(
+        params, sharded_batch
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-2, atol=2e-2)  # bf16 blocks
+
+
+def test_bert_tp_training_step():
+    # Megatron-style TP rules must validate and train on a model=4 mesh.
+    model = build_bert_model(TINY_BERT)
+    batch = _bert_batch(b=8, l=8)
+    params_shape = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), batch)["params"]
+    )
+    partition = make_param_partition(params_shape, bert_partition_rules())
+    mesh = make_mesh(MeshConfig(data=2, model=4))
+    assert validate_partition(params_shape, partition, mesh) == []
+
+    labels = np.arange(8) % 3
+
+    def batches():
+        while True:
+            yield {**batch, "label": labels}
+
+    def loss_fn(params, b, rng):
+        logits = model.apply({"params": params},
+                             {k: v for k, v in b.items() if k != "label"})
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, jnp.asarray(b["label"], jnp.int32)
+        ).mean()
+        return loss, {}
+
+    params, result = train_loop(
+        loss_fn=loss_fn,
+        init_params_fn=lambda rng, b: model.init(
+            rng, {k: v for k, v in b.items() if k != "label"}
+        )["params"],
+        optimizer=optax.adam(1e-3),
+        train_iter=batches(),
+        config=TrainLoopConfig(
+            train_steps=4, batch_size=8, log_every=0,
+            mesh_config=MeshConfig(data=2, model=4),
+            param_partition=partition,
+        ),
+    )
+    assert result.steps_completed == 4
+    assert np.isfinite(result.final_metrics["loss"])
+    # a TP-ruled kernel actually ended up sharded over 'model'
+    k = params["encoder"]["layer_0"]["attn"]["query"]["kernel"]
+    assert "model" in str(k.sharding.spec)
+
+
+def _t5_batch(b=4, li=12, lt=8, seed=0, vocab=48):
+    rng = np.random.default_rng(seed)
+    return {
+        "inputs": rng.integers(1, vocab, size=(b, li)).astype(np.int32),
+        "targets": rng.integers(1, vocab, size=(b, lt)).astype(np.int32),
+        "input_mask": np.ones((b, li), np.int32),
+    }
+
+
+def test_t5_forward_shapes():
+    model = build_t5_model(TINY_T5)
+    batch = _t5_batch()
+    params = model.init(jax.random.key(0), batch)["params"]
+    logits = model.apply({"params": params}, batch)
+    assert logits.shape == (4, 8, 48)
+    assert logits.dtype == jnp.float32
+
+
+def test_t5_decoder_is_causal():
+    # Changing target token t must not change logits at positions <= t.
+    model = build_t5_model(TINY_T5)
+    batch = _t5_batch()
+    params = model.init(jax.random.key(0), batch)["params"]
+    base = np.asarray(model.apply({"params": params}, batch))
+    mutated = dict(batch)
+    tgt = batch["targets"].copy()
+    tgt[:, 5] = (tgt[:, 5] + 7) % 48
+    mutated["targets"] = tgt
+    out = np.asarray(model.apply({"params": params}, mutated))
+    # decoder inputs are shifted right: target[5] feeds position 6 onward
+    np.testing.assert_allclose(out[:, :6], base[:, :6], rtol=1e-4, atol=1e-4)
+    assert np.abs(out[:, 6:] - base[:, 6:]).max() > 1e-4
+
+
+def test_t5_partition_rules_validate():
+    model = build_t5_model(TINY_T5)
+    batch = _t5_batch()
+    params_shape = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), batch)["params"]
+    )
+    partition = make_param_partition(params_shape, t5_partition_rules())
+    mesh = make_mesh(MeshConfig(data=2, model=4))
+    assert validate_partition(params_shape, partition, mesh) == []
+    flat = jax.tree_util.tree_leaves(
+        partition, is_leaf=lambda x: isinstance(x, P)
+    )
+    assert any("model" in str(s) for s in flat)

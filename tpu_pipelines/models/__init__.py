@@ -4,8 +4,10 @@ Present:
   - taxi: Chicago-Taxi wide-and-deep DNN (config 0)
   - mnist: Keras-CNN-equivalent convnet (config 1)
   - resnet: ResNet-18/34/50/101/152, NHWC bfloat16 (config 2)
-
-Planned (BASELINE configs 3-4): BERT-base, T5-small.
+  - bert: BERT-base encoder + classifier/MLM heads (config 3)
+  - t5: T5-small encoder-decoder seq2seq (config 4)
+  - transformer: shared sharded blocks (TP over 'model', ring-attention SP
+    over 'seq') used by bert/t5
 
 Tabular models (taxi) take a dict of (transformed) feature arrays; array-input
 models (mnist, resnet) define an ``apply_fn`` hook in their trainer module file

@@ -1,0 +1,204 @@
+"""T5 encoder-decoder seq2seq (BASELINE config 4: T5-small, JAX run_fn).
+
+The reference's stretch config runs a T5-small seq2seq fine-tune through a
+JAX ``run_fn`` (SURVEY.md §0 configs[4]).  Built from the sharded transformer
+blocks with the T5 particulars: RMSNorm pre-normalization, bucketed
+relative-position attention bias shared across each stack's self-attention
+layers, tied input/output embedding scaled by 1/sqrt(d_model) at the logits.
+
+Relative-position bias is an additive [h, q, k] score term, so these
+attention calls take the dense path (ring attention covers unbiased
+self-attention; see models/transformer.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_pipelines.models.transformer import (
+    TRANSFORMER_PARTITION_RULES,
+    TransformerBlock,
+)
+
+
+def relative_position_buckets(
+    qlen: int, klen: int, *, bidirectional: bool, num_buckets: int = 32,
+    max_distance: int = 128,
+):
+    """T5's log-bucketed relative positions; returns int32 [qlen, klen]."""
+    ctx = np.arange(qlen)[:, None]
+    mem = np.arange(klen)[None, :]
+    rel = mem - ctx
+    buckets = np.zeros_like(rel)
+    n = num_buckets
+    if bidirectional:
+        n //= 2
+        buckets += (rel > 0).astype(np.int64) * n
+        rel = np.abs(rel)
+    else:
+        rel = -np.minimum(rel, 0)
+    max_exact = n // 2
+    is_small = rel < max_exact
+    large = max_exact + (
+        np.log(np.maximum(rel, 1) / max_exact)
+        / np.log(max_distance / max_exact)
+        * (n - max_exact)
+    ).astype(np.int64)
+    large = np.minimum(large, n - 1)
+    buckets += np.where(is_small, rel, large)
+    return jnp.asarray(buckets, jnp.int32)
+
+
+class RelativePositionBias(nn.Module):
+    n_heads: int
+    bidirectional: bool
+    num_buckets: int = 32
+    max_distance: int = 128
+
+    @nn.compact
+    def __call__(self, qlen: int, klen: int):
+        buckets = relative_position_buckets(
+            qlen, klen, bidirectional=self.bidirectional,
+            num_buckets=self.num_buckets, max_distance=self.max_distance,
+        )
+        table = self.param(
+            "rel_embedding",
+            nn.initializers.normal(stddev=1.0),
+            (self.num_buckets, self.n_heads),
+        )
+        # [q, k, h] -> [1, h, q, k] additive bias
+        return jnp.transpose(table[buckets], (2, 0, 1))[None].astype(jnp.float32)
+
+
+class T5Stack(nn.Module):
+    n_layers: int
+    n_heads: int
+    head_dim: int
+    d_ff: int
+    dropout_rate: float
+    dtype: Any
+    causal: bool          # True = decoder
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x, *, encoded=None, kv_mask=None, enc_mask=None,
+                 deterministic: bool = True):
+        bias = RelativePositionBias(
+            n_heads=self.n_heads, bidirectional=not self.causal,
+            name="rel_pos",
+        )(x.shape[1], x.shape[1])
+        for i in range(self.n_layers):
+            x = TransformerBlock(
+                n_heads=self.n_heads, head_dim=self.head_dim, d_ff=self.d_ff,
+                dropout_rate=self.dropout_rate, dtype=self.dtype,
+                causal=self.causal, prenorm=True, norm="rmsnorm",
+                use_cross=self.causal and encoded is not None,
+                mesh=self.mesh, name=f"layer_{i}",
+            )(
+                x, encoded=encoded, kv_mask=kv_mask, enc_mask=enc_mask,
+                self_bias=bias, deterministic=deterministic,
+            )
+        return nn.RMSNorm(dtype=self.dtype, name="final_norm")(x)
+
+
+class T5(nn.Module):
+    """batch {inputs, targets [, input_mask, target_mask]} -> vocab logits.
+
+    ``targets`` are teacher-forcing decoder inputs shifted right internally
+    (BOS = 0, the T5 convention).
+    """
+
+    vocab_size: int = 32128
+    d_model: int = 512
+    n_layers: int = 6
+    n_heads: int = 8
+    head_dim: int = 64
+    d_ff: int = 2048
+    dropout_rate: float = 0.1
+    dtype: Any = jnp.bfloat16
+    mesh: Optional[Mesh] = None
+
+    def setup(self):
+        self.shared = nn.Embed(
+            self.vocab_size, self.d_model, dtype=self.dtype, name="shared"
+        )
+        common = dict(
+            n_heads=self.n_heads, head_dim=self.head_dim, d_ff=self.d_ff,
+            dropout_rate=self.dropout_rate, dtype=self.dtype, mesh=self.mesh,
+        )
+        self.encoder = T5Stack(n_layers=self.n_layers, causal=False,
+                               name="encoder", **common)
+        self.decoder = T5Stack(n_layers=self.n_layers, causal=True,
+                               name="decoder", **common)
+
+    def encode(self, inputs, input_mask=None, *, deterministic=True):
+        x = self.shared(jnp.asarray(inputs, jnp.int32))
+        return self.encoder(x, kv_mask=input_mask, deterministic=deterministic)
+
+    def decode(self, decoder_input_ids, encoded, *, target_mask=None,
+               enc_mask=None, deterministic=True):
+        y = self.shared(jnp.asarray(decoder_input_ids, jnp.int32))
+        y = self.decoder(
+            y, encoded=encoded, kv_mask=target_mask, enc_mask=enc_mask,
+            deterministic=deterministic,
+        )
+        # tied embedding as the output projection, T5's 1/sqrt(d) scaling;
+        # logits in float32 for a stable softmax loss
+        y = y * (self.d_model ** -0.5)
+        return jnp.einsum(
+            "bld,vd->blv", y.astype(jnp.float32),
+            self.shared.embedding.astype(jnp.float32),
+        )
+
+    def __call__(self, batch: Dict[str, Any], *, deterministic: bool = True):
+        inputs = jnp.asarray(batch["inputs"], jnp.int32)
+        targets = jnp.asarray(batch["targets"], jnp.int32)
+        input_mask = batch.get("input_mask")
+        decoder_inputs = jnp.pad(targets, ((0, 0), (1, 0)))[:, :-1]
+        encoded = self.encode(
+            inputs, input_mask, deterministic=deterministic
+        )
+        return self.decode(
+            decoder_inputs, encoded,
+            target_mask=batch.get("target_mask"), enc_mask=input_mask,
+            deterministic=deterministic,
+        )
+
+
+DEFAULT_HPARAMS = {
+    # t5-small geometry
+    "vocab_size": 32128,
+    "d_model": 512,
+    "n_layers": 6,
+    "n_heads": 8,
+    "head_dim": 64,
+    "d_ff": 2048,
+    "dropout_rate": 0.1,
+    "learning_rate": 1e-3,
+    "batch_size": 64,
+}
+
+
+def build_t5_model(hparams: Dict, mesh: Optional[Mesh] = None) -> T5:
+    hp = {**DEFAULT_HPARAMS, **(hparams or {})}
+    return T5(
+        vocab_size=int(hp["vocab_size"]),
+        d_model=int(hp["d_model"]),
+        n_layers=int(hp["n_layers"]),
+        n_heads=int(hp["n_heads"]),
+        head_dim=int(hp["head_dim"]),
+        d_ff=int(hp["d_ff"]),
+        dropout_rate=float(hp["dropout_rate"]),
+        mesh=mesh,
+    )
+
+
+def t5_partition_rules():
+    return list(TRANSFORMER_PARTITION_RULES) + [
+        (r"rel_pos/rel_embedding", P(None, "model")),
+    ]

@@ -1,0 +1,173 @@
+"""Sharded transformer building blocks (backbone for BERT/T5 configs).
+
+TPU-first design: every matmul is a large batched einsum that XLA tiles onto
+the MXU in bfloat16; parallelism is declared, not coded — heads/FFN shard
+over the mesh ``model`` axis (TP) via the partition rules below, batch over
+``data`` (DP), and long sequences over ``seq`` via ring attention
+(parallel/ring_attention.py).  The modules themselves contain no collectives;
+XLA inserts them from the shardings, except the explicit ``ppermute`` ring
+inside ring attention.
+
+The reference's BERT/T5 workloads (SURVEY.md §0 configs 3-4) run through
+these blocks; its only parallelism was data-parallel NCCL allreduce
+(SURVEY.md §2c) — TP and SP here are TPU-native additions, kept optional
+(mesh axes default to size 1).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_pipelines.parallel.ring_attention import dense_attention, ring_attention
+
+Dtype = Any
+
+
+class MlpBlock(nn.Module):
+    d_ff: int
+    dropout_rate: float = 0.0
+    dtype: Dtype = jnp.bfloat16
+    activation: str = "gelu"
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        d_model = x.shape[-1]
+        h = nn.Dense(self.d_ff, dtype=self.dtype, name="wi")(x)
+        h = getattr(nn, self.activation)(h)
+        if self.dropout_rate:
+            h = nn.Dropout(self.dropout_rate)(h, deterministic=deterministic)
+        return nn.Dense(d_model, dtype=self.dtype, name="wo")(h)
+
+
+class MultiHeadAttention(nn.Module):
+    """Self/cross attention; TP over heads, optional ring SP over sequence.
+
+    ``attn_impl``: "dense" or "ring".  Ring requires self-attention (q and kv
+    the same length/sharding) and no additive bias; cross-attention and
+    biased attention (T5 relative positions) always take the dense path.
+    """
+
+    n_heads: int
+    head_dim: int
+    dropout_rate: float = 0.0
+    dtype: Dtype = jnp.bfloat16
+    attn_impl: str = "dense"
+    mesh: Optional[Mesh] = None
+    causal: bool = False
+
+    @nn.compact
+    def __call__(
+        self,
+        x_q,
+        x_kv=None,
+        *,
+        kv_mask=None,
+        bias=None,
+        deterministic: bool = True,
+    ):
+        is_self = x_kv is None
+        x_kv = x_q if is_self else x_kv
+        proj = lambda name: nn.DenseGeneral(
+            (self.n_heads, self.head_dim), axis=-1, dtype=self.dtype, name=name
+        )
+        q = proj("query")(x_q)
+        k = proj("key")(x_kv)
+        v = proj("value")(x_kv)
+
+        use_ring = (
+            self.attn_impl == "ring"
+            and is_self
+            and bias is None
+            and self.mesh is not None
+            and self.mesh.shape.get("seq", 1) > 1
+        )
+        if use_ring:
+            out = ring_attention(
+                q, k, v, mesh=self.mesh, causal=self.causal, kv_mask=kv_mask
+            )
+        else:
+            out = dense_attention(
+                q, k, v, causal=self.causal, kv_mask=kv_mask, bias=bias
+            )
+        out = nn.DenseGeneral(
+            x_q.shape[-1], axis=(-2, -1), dtype=self.dtype, name="out"
+        )(out)
+        if self.dropout_rate:
+            out = nn.Dropout(self.dropout_rate)(out, deterministic=deterministic)
+        return out
+
+
+class TransformerBlock(nn.Module):
+    """Pre- or post-LN encoder/decoder block (self-attn [+cross] + MLP)."""
+
+    n_heads: int
+    head_dim: int
+    d_ff: int
+    dropout_rate: float = 0.0
+    dtype: Dtype = jnp.bfloat16
+    attn_impl: str = "dense"
+    mesh: Optional[Mesh] = None
+    causal: bool = False
+    prenorm: bool = True
+    use_cross: bool = False
+    norm: str = "layernorm"   # "layernorm" (BERT) or "rmsnorm" (T5)
+
+    @nn.compact
+    def __call__(
+        self,
+        x,
+        *,
+        encoded=None,
+        kv_mask=None,
+        enc_mask=None,
+        self_bias=None,
+        deterministic: bool = True,
+    ):
+        mha = lambda name, causal: MultiHeadAttention(
+            n_heads=self.n_heads, head_dim=self.head_dim,
+            dropout_rate=self.dropout_rate, dtype=self.dtype,
+            attn_impl=self.attn_impl, mesh=self.mesh, causal=causal,
+            name=name,
+        )
+        norm_cls = nn.RMSNorm if self.norm == "rmsnorm" else nn.LayerNorm
+        ln = lambda name: norm_cls(dtype=self.dtype, name=name)
+
+        def sub(x, name, fn):
+            if self.prenorm:
+                return x + fn(ln(f"{name}_norm")(x))
+            return ln(f"{name}_norm")(x + fn(x))
+
+        x = sub(x, "attn", lambda h: mha("attn", self.causal)(
+            h, kv_mask=kv_mask, bias=self_bias, deterministic=deterministic
+        ))
+        if self.use_cross:
+            x = sub(x, "cross", lambda h: mha("cross", False)(
+                h, encoded, kv_mask=enc_mask, deterministic=deterministic
+            ))
+        x = sub(x, "mlp", lambda h: MlpBlock(
+            d_ff=self.d_ff, dropout_rate=self.dropout_rate,
+            dtype=self.dtype, name="mlp",
+        )(h, deterministic=deterministic))
+        return x
+
+
+# Megatron-style TP rules for the blocks above (parallel/partition.py):
+# QKV projections and MLP wi shard their output dim over `model`
+# (column-parallel); attention out and MLP wo shard their input dim
+# (row-parallel) so XLA inserts one all-reduce per block, over ICI.
+TRANSFORMER_PARTITION_RULES = [
+    (r"(query|key|value)/kernel", P(None, "model", None)),
+    (r"attn/out/kernel", P("model", None, None)),
+    (r"cross/out/kernel", P("model", None, None)),
+    (r"mlp/wi/kernel", P(None, "model")),
+    (r"mlp/wo/kernel", P("model", None)),
+    # token embeddings only (vocab dim sharded); positional/type tables are
+    # small and replicate — (^|/) anchors to a whole path segment so
+    # e.g. "type_embed" does not match.
+    (r"(^|/)(embed|shared)/embedding", P("model", None)),
+]

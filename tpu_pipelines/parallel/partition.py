@@ -1,0 +1,77 @@
+"""Param-partition rules: regex path → PartitionSpec, applied to a pytree.
+
+The train loop takes ``param_partition`` as a pytree of ``PartitionSpec``
+matching the params (trainer/train_loop.py); models ship a rule list
+(ordered, first match wins) and this module expands it against the actual
+params tree — the moral equivalent of t5x/flaxformer logical-axis rules
+without the extra annotation layer.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, List, Sequence, Tuple
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+Rules = Sequence[Tuple[str, P]]
+
+
+def path_str(path) -> str:
+    """'block_0/attn/q/kernel' style path string for a tree_flatten_with_path key."""
+    parts = []
+    for entry in path:
+        for attr in ("key", "idx", "name"):
+            if hasattr(entry, attr):
+                parts.append(str(getattr(entry, attr)))
+                break
+        else:
+            parts.append(str(entry))
+    return "/".join(parts)
+
+
+def make_param_partition(params: Any, rules: Rules) -> Any:
+    """Pytree of PartitionSpec for ``params``; unmatched leaves replicate.
+
+    ``params`` may be real arrays or ``jax.eval_shape`` output.  Each rule is
+    ``(regex, PartitionSpec)``, matched with ``re.search`` against the
+    '/'-joined path; first match wins.
+    """
+    compiled = [(re.compile(rx), spec) for rx, spec in rules]
+
+    def spec_for(path, leaf):
+        s = path_str(path)
+        for rx, spec in compiled:
+            if rx.search(s):
+                return spec
+        return P()
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    return jax.tree_util.tree_unflatten(
+        treedef, [spec_for(path, leaf) for path, leaf in flat]
+    )
+
+
+def validate_partition(params: Any, partition: Any, mesh) -> List[str]:
+    """Return human-readable problems (axis sizes not dividing dims)."""
+    problems = []
+    flat_p, _ = jax.tree_util.tree_flatten_with_path(params)
+    flat_s = jax.tree_util.tree_leaves(
+        partition, is_leaf=lambda x: isinstance(x, P)
+    )
+    for (path, leaf), spec in zip(flat_p, flat_s):
+        shape = getattr(leaf, "shape", ())
+        for dim, axis in enumerate(spec):
+            if axis is None:
+                continue
+            axes = (axis,) if isinstance(axis, str) else tuple(axis)
+            size = 1
+            for a in axes:
+                size *= mesh.shape[a]
+            if dim >= len(shape) or shape[dim] % size:
+                problems.append(
+                    f"{path_str(path)}: dim {dim} of {shape} not divisible "
+                    f"by mesh axes {axes} (size {size})"
+                )
+    return problems
