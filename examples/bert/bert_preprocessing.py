@@ -1,0 +1,20 @@
+"""BERT preprocessing module (BASELINE config 3): tokenize text on host.
+
+The reference runs BERT tokenization inside TFX Transform; here the
+``tokenize`` analyzer learns/loads the vocabulary in the full pass and emits
+fixed-length ``input_ids`` host-side, while everything numeric downstream
+(the attention mask derivation included) can run on-chip — the host/device
+split of SURVEY.md §7 hard part 5.
+"""
+
+MAX_LEN = 64
+VOCAB_SIZE = 4096
+
+
+def preprocessing_fn(inputs, tft):
+    ids = tft.tokenize(inputs["text"], max_len=MAX_LEN, vocab_size=VOCAB_SIZE)
+    return {
+        "input_ids": ids,
+        "attention_mask": tft.greater(ids, 0),
+        "label": tft.cast(inputs["label"], "int32"),
+    }

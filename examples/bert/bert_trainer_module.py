@@ -1,0 +1,130 @@
+"""BERT fine-tune trainer module (BASELINE config 3).
+
+Trains a BERT classifier on tokenized examples produced by the Transform
+component (bert_preprocessing.py).  Hyperparameters select geometry (defaults
+are bert-base) and mesh axes; TP/SP shardings come from
+``bert_partition_rules`` when the mesh has a model axis.
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tpu_pipelines.data.input_pipeline import BatchIterator, InputConfig
+from tpu_pipelines.models.bert import (
+    DEFAULT_HPARAMS,
+    bert_partition_rules,
+    build_bert_model,
+)
+from tpu_pipelines.parallel.mesh import MeshConfig, make_mesh
+from tpu_pipelines.parallel.partition import make_param_partition
+from tpu_pipelines.trainer import TrainLoopConfig, export_model, train_loop
+
+LABEL = "label"
+
+
+def build_model(hyperparameters):
+    return build_bert_model(hyperparameters)
+
+
+def apply_fn(model, params, batch):
+    """Serving hook: route the tokenized feature dict into the classifier."""
+    ids = jnp.asarray(batch["input_ids"], jnp.int32)
+    mask = batch.get("attention_mask")
+    mask = ids > 0 if mask is None else jnp.asarray(mask, jnp.int32)
+    return model.apply(
+        {"params": params}, {"input_ids": ids, "attention_mask": mask}
+    )
+
+
+def run_fn(fn_args):
+    hp = {**DEFAULT_HPARAMS, **fn_args.hyperparameters}
+    # Size the embedding from what the tokenizer actually learned (padded to
+    # a multiple of 64 for clean TP sharding) unless the user pinned it.
+    if "vocab_size" not in fn_args.hyperparameters and fn_args.transform_graph_uri:
+        from tpu_pipelines.transform.graph import TransformGraph
+
+        sizes = TransformGraph.load(
+            fn_args.transform_graph_uri
+        ).tokenizer_vocab_sizes()
+        if "input_ids" in sizes:
+            hp["vocab_size"] = -(-sizes["input_ids"] // 64) * 64
+    batch_size = int(hp["batch_size"])
+    mesh_cfg = MeshConfig(**fn_args.mesh_config) if fn_args.mesh_config else None
+    mesh = make_mesh(mesh_cfg) if mesh_cfg else None
+    model = build_bert_model(hp, mesh=mesh)
+
+    train_iter = BatchIterator(
+        fn_args.train_examples_uri, "train",
+        InputConfig(batch_size=batch_size, shuffle=True, seed=0),
+    )
+
+    def eval_iter_fn():
+        return BatchIterator(
+            fn_args.eval_examples_uri, "eval",
+            InputConfig(batch_size=batch_size, shuffle=False, num_epochs=1,
+                        drop_remainder=True),
+        )
+
+    def features(b):
+        return {k: v for k, v in b.items() if k != LABEL}
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply(
+            {"params": params}, features(batch),
+            deterministic=False, rngs={"dropout": rng},
+        )
+        labels = jnp.asarray(batch[LABEL], jnp.int32)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+        accuracy = jnp.mean(jnp.argmax(logits, -1) == labels)
+        return loss, {"accuracy": accuracy}
+
+    def init_params_fn(rng, sample_batch):
+        return model.init(rng, features(sample_batch))["params"]
+
+    # TP/SP param shardings only when the mesh has a model/seq axis.
+    param_partition = None
+    if mesh is not None and (
+        mesh.shape.get("model", 1) > 1 or mesh.shape.get("seq", 1) > 1
+    ):
+        sample = next(iter(BatchIterator(
+            fn_args.train_examples_uri, "train",
+            InputConfig(batch_size=2, shuffle=False),
+        )))
+        params_shape = jax.eval_shape(
+            lambda: model.init(jax.random.key(0), features(sample))["params"]
+        )
+        param_partition = make_param_partition(
+            params_shape, bert_partition_rules()
+        )
+
+    params, result = train_loop(
+        loss_fn=loss_fn,
+        init_params_fn=init_params_fn,
+        optimizer=optax.adamw(hp["learning_rate"]),
+        train_iter=train_iter,
+        eval_iter_fn=eval_iter_fn,
+        config=TrainLoopConfig(
+            train_steps=fn_args.train_steps,
+            batch_size=batch_size,
+            eval_steps=fn_args.eval_steps,
+            checkpoint_every=max(1, fn_args.train_steps // 4),
+            log_every=max(1, fn_args.train_steps // 10),
+            mesh_config=mesh_cfg,
+            param_partition=param_partition,
+        ),
+        checkpoint_dir=fn_args.model_run_dir,
+        mesh=mesh,
+    )
+
+    export_model(
+        serving_model_dir=fn_args.serving_model_dir,
+        params=params,
+        module_file=__file__,
+        hyperparameters=hp,
+        transform_graph_uri=fn_args.transform_graph_uri,
+        extra_spec={"label": LABEL},
+    )
+    return result

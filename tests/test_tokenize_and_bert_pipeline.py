@@ -1,0 +1,199 @@
+"""Tokenize analyzer + BERT pipeline end-to-end (config 3)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tpu_pipelines.data.schema import Feature, FeatureType, Schema
+from tpu_pipelines.transform.graph import TransformGraph
+
+HERE = os.path.dirname(__file__)
+EXAMPLES = os.path.join(os.path.dirname(HERE), "examples")
+
+
+def _text_schema():
+    return Schema(features={
+        "text": Feature("text", FeatureType.BYTES),
+        "label": Feature("label", FeatureType.INT),
+    })
+
+
+def _tok_fn(inputs, tft):
+    ids = tft.tokenize(inputs["text"], max_len=8, vocab_size=64)
+    return {"input_ids": ids, "attention_mask": tft.greater(ids, 0)}
+
+
+def test_tokenize_learned_vocab_roundtrip(tmp_path):
+    texts = np.asarray(
+        ["the cat sat", "the dog sat!", "a cat, a dog", "the the the"],
+        dtype=object,
+    )
+    g = TransformGraph.build(_tok_fn, _text_schema())
+    g.analyze({"text": texts, "label": np.zeros(4)})
+    out = g.apply_host({"text": texts, "label": np.zeros(4)})
+    ids = out["input_ids"]
+    assert ids.shape == (4, 8) and ids.dtype == np.int32
+    # [CLS]=2 first, [SEP]=3 terminates, pad=0 after
+    assert (ids[:, 0] == 2).all()
+    for row in ids:
+        sep = np.where(row == 3)[0]
+        assert len(sep) == 1
+        assert (row[sep[0] + 1:] == 0).all()
+    # same word -> same id across rows ("the" in rows 0,1,3)
+    assert ids[0, 1] == ids[1, 1] == ids[3, 1]
+    # mask matches nonzero ids
+    np.testing.assert_array_equal(out["attention_mask"], (ids > 0).astype(np.float32))
+
+    # save/load roundtrip preserves tokenization exactly
+    uri = str(tmp_path / "tg")
+    g.save(uri)
+    g2 = TransformGraph.load(uri)
+    out2 = g2.apply_host({"text": texts, "label": np.zeros(4)})
+    np.testing.assert_array_equal(out2["input_ids"], ids)
+
+
+def test_tokenize_wordpiece_vocab_file(tmp_path):
+    vpath = tmp_path / "vocab.txt"
+    vpath.write_text(
+        "\n".join(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "play", "##ing",
+                   "##ed", "ball"]) + "\n"
+    )
+
+    def fn(inputs, tft):
+        return {"ids": tft.tokenize(inputs["text"], max_len=8,
+                                    vocab_file=str(vpath))}
+
+    g = TransformGraph.build(fn, _text_schema())
+    texts = np.asarray(["playing played ball zzz"], dtype=object)
+    g.analyze({"text": texts, "label": np.zeros(1)})
+    ids = g.apply_host({"text": texts, "label": np.zeros(1)})["ids"][0]
+    # [CLS] play ##ing play ##ed ball [UNK] [SEP]
+    assert list(ids) == [2, 4, 5, 4, 6, 7, 1, 3]
+
+
+def test_tokenize_truncation():
+    def fn(inputs, tft):
+        return {"ids": tft.tokenize(inputs["text"], max_len=4, vocab_size=64)}
+
+    g = TransformGraph.build(fn, _text_schema())
+    texts = np.asarray(["one two three four five six"], dtype=object)
+    g.analyze({"text": texts, "label": np.zeros(1)})
+    ids = g.apply_host({"text": texts, "label": np.zeros(1)})["ids"][0]
+    assert len(ids) == 4
+    assert ids[0] == 2 and ids[-1] == 3 and (ids != 0).all()
+
+
+def test_bert_pipeline_e2e(tmp_path):
+    """CSV text -> tokenizing Transform -> tiny-BERT Trainer -> predict."""
+    from tpu_pipelines.components import (
+        CsvExampleGen, SchemaGen, StatisticsGen, Trainer, Transform,
+    )
+    from tpu_pipelines.dsl.pipeline import Pipeline
+    from tpu_pipelines.orchestration import LocalDagRunner
+    from tpu_pipelines.trainer.export import load_exported_model
+
+    rng = np.random.default_rng(0)
+    pos = ["great movie truly fun", "loved it wonderful film",
+           "fun and wonderful", "truly great and fun"]
+    neg = ["terrible boring mess", "awful waste dull",
+           "boring and awful", "dull terrible film"]
+    rows = ["text,label"]
+    for i in range(120):
+        if i % 2 == 0:
+            rows.append(f'"{pos[rng.integers(len(pos))]}",1')
+        else:
+            rows.append(f'"{neg[rng.integers(len(neg))]}",0')
+    csv = tmp_path / "reviews.csv"
+    csv.write_text("\n".join(rows) + "\n")
+
+    gen = CsvExampleGen(input_path=str(csv))
+    stats = StatisticsGen(examples=gen.outputs["examples"])
+    schema = SchemaGen(statistics=stats.outputs["statistics"])
+    transform = Transform(
+        examples=gen.outputs["examples"],
+        schema=schema.outputs["schema"],
+        module_file=os.path.join(EXAMPLES, "bert", "bert_preprocessing.py"),
+    )
+    trainer = Trainer(
+        examples=transform.outputs["transformed_examples"],
+        transform_graph=transform.outputs["transform_graph"],
+        module_file=os.path.join(EXAMPLES, "bert", "bert_trainer_module.py"),
+        train_steps=25,
+        hyperparameters={
+            "vocab_size": 256, "d_model": 32, "n_layers": 2, "n_heads": 4,
+            "d_ff": 64, "max_len": 64, "dropout_rate": 0.0,
+            "num_classes": 2, "batch_size": 32, "learning_rate": 3e-3,
+        },
+    )
+    p = Pipeline(
+        "bert-finetune", [trainer],
+        pipeline_root=str(tmp_path / "root"),
+        metadata_path=str(tmp_path / "md.sqlite"),
+    )
+    result = LocalDagRunner().run(p)
+    assert result.succeeded
+
+    # Exported model classifies raw text (tokenizer embedded in transform).
+    model_uri = result.outputs_of("Trainer", "model")[0].uri
+    loaded = load_exported_model(model_uri)
+    raw = {"text": np.asarray(
+        ["truly wonderful fun film", "awful boring mess"], dtype=object
+    ), "label": np.zeros(2, np.int64)}
+    logits = np.asarray(loaded.predict(raw))
+    assert logits.shape == (2, 2)
+    assert logits[0, 1] > logits[0, 0]   # positive review
+    assert logits[1, 0] > logits[1, 1]   # negative review
+
+
+def test_t5_pipeline_e2e(tmp_path):
+    """CSV (source,target) -> tokenizing Transform -> tiny-T5 Trainer."""
+    from tpu_pipelines.components import (
+        CsvExampleGen, SchemaGen, StatisticsGen, Trainer, Transform,
+    )
+    from tpu_pipelines.dsl.pipeline import Pipeline
+    from tpu_pipelines.metadata import MetadataStore
+    from tpu_pipelines.orchestration import LocalDagRunner
+
+    pairs = [("hello world", "bonjour monde"),
+             ("good day", "bonne journee"),
+             ("thank you", "merci"),
+             ("see you", "a bientot")]
+    rows = ["source,target"]
+    for i in range(60):
+        s, t = pairs[i % len(pairs)]
+        rows.append(f'"{s}","{t}"')
+    csv = tmp_path / "pairs.csv"
+    csv.write_text("\n".join(rows) + "\n")
+
+    gen = CsvExampleGen(input_path=str(csv))
+    stats = StatisticsGen(examples=gen.outputs["examples"])
+    schema = SchemaGen(statistics=stats.outputs["statistics"])
+    transform = Transform(
+        examples=gen.outputs["examples"],
+        schema=schema.outputs["schema"],
+        module_file=os.path.join(EXAMPLES, "t5", "t5_preprocessing.py"),
+    )
+    trainer = Trainer(
+        examples=transform.outputs["transformed_examples"],
+        transform_graph=transform.outputs["transform_graph"],
+        module_file=os.path.join(EXAMPLES, "t5", "t5_trainer_module.py"),
+        train_steps=10,
+        hyperparameters={
+            "vocab_size": 128, "d_model": 32, "n_layers": 1, "n_heads": 2,
+            "head_dim": 8, "d_ff": 32, "dropout_rate": 0.0,
+            "batch_size": 8, "learning_rate": 3e-3,
+        },
+    )
+    p = Pipeline(
+        "t5-seq2seq", [trainer],
+        pipeline_root=str(tmp_path / "root"),
+        metadata_path=str(tmp_path / "md.sqlite"),
+    )
+    result = LocalDagRunner().run(p)
+    assert result.succeeded
+    store = MetadataStore(str(tmp_path / "md.sqlite"))
+    ex = store.get_execution(result.nodes["Trainer"].execution_id)
+    assert ex.properties["steps_completed"] == 10
+    assert np.isfinite(ex.properties["final_loss"])
+    store.close()

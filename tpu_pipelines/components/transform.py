@@ -17,6 +17,7 @@ import shutil
 from tpu_pipelines.data import examples_io
 from tpu_pipelines.data.schema import Schema
 from tpu_pipelines.dsl.component import Parameter, component
+from tpu_pipelines.transform.expr import OPS
 from tpu_pipelines.transform.graph import TransformGraph
 from tpu_pipelines.utils.module_loader import load_fn
 
@@ -81,8 +82,8 @@ def Transform(ctx):
     transformed_out.properties["split_counts"] = counts
     return {
         "num_analyzers": sum(
-            1 for n in graph.nodes if n.op in
-        ("z_score", "scale_to_0_1", "vocab_apply", "bucketize")
+            1 for n in graph.nodes
+            if n.op in OPS and OPS[n.op].is_analyzer
         ),
         "output_features": graph.output_feature_names(),
     }

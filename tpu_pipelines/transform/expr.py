@@ -44,6 +44,8 @@ _ANALYZERS = [
     OpDef("scale_to_0_1", NUMERIC, is_analyzer=True),
     OpDef("vocab_apply", NUMERIC, is_analyzer=True),
     OpDef("bucketize", NUMERIC, is_analyzer=True),
+    # text -> [n, max_len] int token ids (host-side; SURVEY.md §7 hard part 5)
+    OpDef("tokenize", NUMERIC, is_analyzer=True),
 ]
 OPS: Dict[str, OpDef] = {o.name: o for o in _STATELESS + _ANALYZERS}
 
@@ -87,11 +89,25 @@ class ColumnRef:
         return f"ColumnRef(#{self.id}, {self.dtype})"
 
 
+REF_KEY = "ref"
+
+
+def is_ref(x: Any) -> bool:
+    """True if an entry of ``Node.inputs`` references another node."""
+    return isinstance(x, dict) and REF_KEY in x
+
+
+def ref_id(x: Any) -> int:
+    return int(x[REF_KEY])
+
+
 @dataclasses.dataclass
 class Node:
     id: int
     op: str                    # "input" or an OPS name
-    inputs: List[Any]          # node ids (int) or literal scalars
+    # Node references are {"ref": id}; anything else is a literal scalar.
+    # (A bare int would be ambiguous with literal operands like `x > 0`.)
+    inputs: List[Any]
     params: Dict[str, Any]
     dtype: str
     name: str = ""             # input column name for op == "input"
@@ -136,7 +152,7 @@ class GraphBuilder:
             if isinstance(x, ColumnRef):
                 if x.graph is not self:
                     raise ValueError("mixing ColumnRefs from different graphs")
-                in_vals.append(x.id)
+                in_vals.append({REF_KEY: x.id})
                 in_dtypes.append(x.dtype)
             elif isinstance(x, (int, float)):
                 in_vals.append(x)
@@ -188,6 +204,25 @@ class TftNamespace:
 
     def bucketize(self, x: ColumnRef, num_buckets: int) -> ColumnRef:
         return self._b.add_op("bucketize", [x], {"num_buckets": num_buckets})
+
+    def tokenize(
+        self, x: ColumnRef, max_len: int, vocab_size: int = 8000,
+        lowercase: bool = True, vocab_file: Optional[str] = None,
+    ) -> ColumnRef:
+        """Text column -> [n, max_len] int32 ids: [CLS] tokens… [SEP] [PAD]….
+
+        Without ``vocab_file`` the analyzer learns a word-level vocabulary
+        (most frequent ``vocab_size`` terms) in the full pass; with one, it
+        loads it (one term per line; '##'-prefixed pieces switch matching to
+        greedy wordpiece, the BERT convention).  Ids 0-3 are reserved:
+        [PAD]=0 [UNK]=1 [CLS]=2 [SEP]=3.  Derive an attention mask with
+        ``tft.greater(ids, 0)``.
+        """
+        return self._b.add_op(
+            "tokenize", [x],
+            {"max_len": max_len, "vocab_size": vocab_size,
+             "lowercase": lowercase, "vocab_file": vocab_file},
+        )
 
     # ---- stateless
     def hash_strings(self, x: ColumnRef, hash_buckets: int) -> ColumnRef:

@@ -27,11 +27,16 @@ from tpu_pipelines.transform.expr import (
     GraphBuilder,
     Node,
     TftNamespace,
+    is_ref,
+    ref_id,
 )
 
 GRAPH_FILE = "transform_graph.json"
 STATE_FILE = "analyzer_state.npz"
 VOCAB_DIR = "vocabularies"
+# v2: Node.inputs encodes node references as {"ref": id} (bare ints are
+# literal scalars).  v1 graphs (bare-int refs) are rejected, not mis-read.
+GRAPH_FORMAT = "transform-graph/v2"
 
 
 class _LazyInputs:
@@ -152,8 +157,7 @@ class TransformGraph:
                 vals[node.id] = data[node.name]
                 continue
             args = [
-                vals[a] if isinstance(a, int) and not isinstance(a, bool) else a
-                for a in node.inputs
+                vals[ref_id(a)] if is_ref(a) else a for a in node.inputs
             ]
             opdef = OPS[node.op]
             if opdef.is_analyzer:
@@ -193,7 +197,7 @@ class TransformGraph:
                 if node.dtype == STRING:
                     host_nodes.add(node.id)
                 continue
-            arg_ids = [a for a in node.inputs if isinstance(a, int) and not isinstance(a, bool)]
+            arg_ids = [ref_id(a) for a in node.inputs if is_ref(a)]
             consumes_string = any(
                 self.nodes[a].dtype == STRING for a in arg_ids
             )
@@ -211,9 +215,9 @@ class TransformGraph:
                     iface_ids.append(node.id)
                 continue
             for a in node.inputs:
-                if isinstance(a, int) and not isinstance(a, bool) and a in host_nodes:
-                    if a not in iface_ids:
-                        iface_ids.append(a)
+                if is_ref(a) and ref_id(a) in host_nodes:
+                    if ref_id(a) not in iface_ids:
+                        iface_ids.append(ref_id(a))
         # Outputs computed entirely on host also cross the boundary.
         for name, nid in self.outputs.items():
             if nid in host_nodes and nid not in iface_ids:
@@ -257,8 +261,7 @@ class TransformGraph:
             if node.id not in host_nodes:
                 continue
             args = [
-                vals[a] if isinstance(a, int) and not isinstance(a, bool) else a
-                for a in node.inputs
+                vals[ref_id(a)] if is_ref(a) else a for a in node.inputs
             ]
             opdef = OPS[node.op]
             if opdef.is_analyzer:
@@ -278,6 +281,7 @@ class TransformGraph:
     def save(self, uri: str) -> None:
         os.makedirs(uri, exist_ok=True)
         graph_json = {
+            "format": GRAPH_FORMAT,
             "nodes": [n.to_json() for n in self.nodes],
             "outputs": self.outputs,
         }
@@ -287,6 +291,8 @@ class TransformGraph:
         vocab_meta: Dict[str, Dict] = {}
         for nid, st in self.state.items():
             for key, val in st.items():
+                if key.startswith("_"):
+                    continue  # derived caches (e.g. tokenize _table)
                 if key == "vocab":
                     # Human-inspectable vocabulary files, one term per line —
                     # the tf.Transform vocab-file convention.
@@ -307,6 +313,12 @@ class TransformGraph:
     def load(cls, uri: str) -> "TransformGraph":
         with open(os.path.join(uri, GRAPH_FILE)) as f:
             graph_json = json.load(f)
+        fmt = graph_json.get("format")
+        if fmt != GRAPH_FORMAT:
+            raise ValueError(
+                f"transform graph at {uri!r} has format {fmt!r}, expected "
+                f"{GRAPH_FORMAT!r}; re-run the Transform component"
+            )
         nodes = [Node.from_json(d) for d in graph_json["nodes"]]
         outputs = {k: int(v) for k, v in graph_json["outputs"].items()}
         state: Dict[int, Dict[str, Any]] = {}
@@ -331,6 +343,20 @@ class TransformGraph:
 
     def output_feature_names(self) -> List[str]:
         return sorted(self.outputs)
+
+    def tokenizer_vocab_sizes(self) -> Dict[str, int]:
+        """Resolved vocab size per tokenize-producing output column.
+
+        Lets a trainer module size its embedding table from what the
+        tokenizer actually learned (plus OOV-free specials), instead of
+        guessing — ids are always < this size.
+        """
+        out: Dict[str, int] = {}
+        for name, nid in self.outputs.items():
+            node = self.nodes[nid]
+            if node.op == "tokenize" and nid in self.state:
+                out[name] = len(self.state[nid]["vocab"])
+        return out
 
 
 # ---------------------------------------------------------------- operators
@@ -375,7 +401,95 @@ def _compute_state(node: Node, col: np.ndarray) -> Dict[str, Any]:
         qs = np.linspace(0, 1, num_buckets + 1)[1:-1]
         boundaries = np.quantile(vals, qs) if len(vals) else np.zeros(0)
         return {"boundaries": np.unique(boundaries)}
+    if node.op == "tokenize":
+        p = node.params
+        if p.get("vocab_file"):
+            with open(p["vocab_file"]) as f:
+                vocab = [line.rstrip("\n") for line in f if line.rstrip("\n")]
+            missing = [t for t in SPECIAL_TOKENS if t not in vocab]
+            if missing:
+                raise ValueError(
+                    f"tokenize vocab_file {p['vocab_file']!r} lacks special "
+                    f"tokens {missing}; the ids-0-3 = [PAD]/[UNK]/[CLS]/[SEP] "
+                    "contract requires them"
+                )
+            return {"vocab": vocab}
+        counts: Dict[str, int] = {}
+        for text in col:
+            for tok in _pretokenize(text, p.get("lowercase", True)):
+                counts[tok] = counts.get(tok, 0) + 1
+        # descending frequency, then lexical — deterministic
+        terms = sorted(counts, key=lambda t: (-counts[t], t))
+        budget = max(0, int(p.get("vocab_size", 8000)) - len(SPECIAL_TOKENS))
+        return {"vocab": list(SPECIAL_TOKENS) + terms[:budget]}
     raise ValueError(f"unknown analyzer {node.op!r}")
+
+
+SPECIAL_TOKENS = ("[PAD]", "[UNK]", "[CLS]", "[SEP]")
+_PUNCT_SPLIT = None  # compiled lazily
+
+
+def _pretokenize(text, lowercase: bool) -> List[str]:
+    """Whitespace + punctuation split (the BERT basic-tokenizer convention)."""
+    global _PUNCT_SPLIT
+    if _PUNCT_SPLIT is None:
+        import re
+
+        _PUNCT_SPLIT = re.compile(r"\w+|[^\w\s]")
+    s = "" if text is None else str(text)
+    if lowercase:
+        s = s.lower()
+    return _PUNCT_SPLIT.findall(s)
+
+
+def _wordpiece(tok: str, table: Dict[str, int], unk: int) -> List[int]:
+    """Greedy longest-match-first wordpiece (BERT); whole-word if present."""
+    if tok in table:
+        return [table[tok]]
+    ids: List[int] = []
+    start = 0
+    while start < len(tok):
+        end = len(tok)
+        piece_id = None
+        while start < end:
+            sub = tok[start:end] if start == 0 else "##" + tok[start:end]
+            if sub in table:
+                piece_id = table[sub]
+                break
+            end -= 1
+        if piece_id is None:
+            return [unk]
+        ids.append(piece_id)
+        start = end
+    return ids
+
+
+def _apply_tokenize(node: Node, state: Dict[str, Any], col) -> np.ndarray:
+    p = node.params
+    vocab = state["vocab"]
+    # Memoized on the state dict: predict() re-enters here per batch.
+    table = state.get("_table")
+    if table is None:
+        table = state["_table"] = {v: i for i, v in enumerate(vocab)}
+        state["_has_wordpiece"] = any(v.startswith("##") for v in vocab)
+    has_wordpiece = state["_has_wordpiece"]
+    unk = table.get("[UNK]", 1)
+    cls_id = table.get("[CLS]", 2)
+    sep_id = table.get("[SEP]", 3)
+    max_len = int(p["max_len"])
+    out = np.zeros((len(col), max_len), dtype=np.int32)  # 0 = [PAD]
+    for i, text in enumerate(col):
+        ids = [cls_id]
+        for tok in _pretokenize(text, p.get("lowercase", True)):
+            if has_wordpiece:
+                ids.extend(_wordpiece(tok, table, unk))
+            else:
+                ids.append(table.get(tok, unk))
+            if len(ids) >= max_len - 1:
+                break
+        ids = ids[: max_len - 1] + [sep_id]
+        out[i, : len(ids)] = ids
+    return out
 
 
 def _apply_analyzer(node: Node, state: Dict[str, Any], col, xp):
@@ -412,6 +526,9 @@ def _apply_analyzer(node: Node, state: Dict[str, Any], col, xp):
         boundaries = xp.asarray(state["boundaries"], dtype=xp.float32)
         x = xp.asarray(col, dtype=xp.float32)
         return xp.searchsorted(boundaries, x).astype(xp.int32)
+    if node.op == "tokenize":
+        assert xp is np, "tokenize must run host-side"
+        return _apply_tokenize(node, state, np.asarray(col))
     raise ValueError(f"unknown analyzer {node.op!r}")
 
 
