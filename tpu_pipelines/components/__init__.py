@@ -13,6 +13,7 @@ from tpu_pipelines.components.schema_gen import SchemaGen  # noqa: F401
 from tpu_pipelines.components.example_validator import ExampleValidator  # noqa: F401
 from tpu_pipelines.components.transform import Transform  # noqa: F401
 from tpu_pipelines.components.trainer import Trainer  # noqa: F401
+from tpu_pipelines.components.tuner import Tuner  # noqa: F401
 from tpu_pipelines.components.evaluator import Evaluator  # noqa: F401
 from tpu_pipelines.components.pusher import Pusher  # noqa: F401
 from tpu_pipelines.components.bulk_inferrer import BulkInferrer  # noqa: F401

@@ -15,7 +15,7 @@ import os
 from typing import Any, Dict
 
 from tpu_pipelines.dsl.component import Parameter, component
-from tpu_pipelines.trainer.fn_args import FnArgs, TrainResult
+from tpu_pipelines.trainer.fn_args import TrainResult, resolve_fn_args
 from tpu_pipelines.utils.module_loader import load_fn
 
 
@@ -43,7 +43,6 @@ from tpu_pipelines.utils.module_loader import load_fn
 def Trainer(ctx):
     run_fn = load_fn(ctx.exec_properties["module_file"], "run_fn")
 
-    examples_uri = ctx.input("examples").uri
     hyperparameters: Dict[str, Any] = dict(
         ctx.exec_properties["hyperparameters"] or {}
     )
@@ -57,22 +56,14 @@ def Trainer(ctx):
     if ctx.inputs.get("base_model"):
         custom_config["base_model_uri"] = ctx.input("base_model").uri
 
-    fn_args = FnArgs(
-        train_examples_uri=examples_uri,
-        eval_examples_uri=examples_uri,
-        transform_graph_uri=(
-            ctx.input("transform_graph").uri
-            if ctx.inputs.get("transform_graph") else ""
-        ),
-        schema_uri=(
-            ctx.input("schema").uri if ctx.inputs.get("schema") else ""
-        ),
+    fn_args = resolve_fn_args(
+        ctx,
         serving_model_dir=ctx.output("model").uri,
         model_run_dir=ctx.output("model_run").uri,
+        hyperparameters=hyperparameters,
         train_steps=ctx.exec_properties["train_steps"],
         eval_steps=ctx.exec_properties["eval_steps"],
-        hyperparameters=hyperparameters,
-        mesh_config=dict(ctx.exec_properties["mesh"] or {}),
+        mesh=ctx.exec_properties["mesh"],
         custom_config=custom_config,
     )
 

@@ -1,0 +1,188 @@
+"""Tuner component: hyperparameter search over the Trainer's run_fn.
+
+Capability match for TFX Tuner + the workshop's Katib HPO (SURVEY.md §2a
+row 7, §2b Katib row): trials run the same ``run_fn(FnArgs)`` contract the
+Trainer uses — no separate tuning API — with grid or random candidate
+generation, and the winner is emitted as a ``HyperParameters`` artifact whose
+``best_hyperparameters.json`` the Trainer merges over its own defaults.
+
+On-chip efficiency note: trials run sequentially in-process, each a fresh
+jit; identical shapes across trials hit XLA's compilation cache, so later
+trials pay only run time.  (Katib's parallel-pod fan-out belongs to the
+cluster runner; the emitted spec can schedule trials as separate TPUJobs.)
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import random
+from typing import Any, Dict, List
+
+from tpu_pipelines.dsl.component import Parameter, component
+from tpu_pipelines.trainer.fn_args import TrainResult, resolve_fn_args
+from tpu_pipelines.utils.module_loader import load_fn, load_module
+
+BEST_FILE = "best_hyperparameters.json"
+TRIALS_FILE = "trials.json"
+
+
+def _grid(space: Dict[str, List[Any]]) -> List[Dict[str, Any]]:
+    keys = sorted(space)
+    return [
+        dict(zip(keys, combo))
+        for combo in itertools.product(*(space[k] for k in keys))
+    ]
+
+
+def _random(space: Dict[str, List[Any]], n: int, seed: int) -> List[Dict[str, Any]]:
+    rng = random.Random(seed)
+    keys = sorted(space)
+    seen = set()
+    out: List[Dict[str, Any]] = []
+    # Bounded rejection sampling; falls back to duplicates-allowed if the
+    # space is smaller than n.
+    attempts = 0
+    while len(out) < n and attempts < 50 * n:
+        cand = {k: rng.choice(space[k]) for k in keys}
+        key = json.dumps(cand, sort_keys=True, default=str)
+        if key not in seen or len(seen) >= _space_size(space):
+            seen.add(key)
+            out.append(cand)
+        attempts += 1
+    return out
+
+
+def _space_size(space: Dict[str, List[Any]]) -> int:
+    size = 1
+    for v in space.values():
+        size *= max(1, len(v))
+    return size
+
+
+@component(
+    inputs={
+        "examples": "Examples",
+        "transform_graph": "TransformGraph",
+        "schema": "Schema",
+    },
+    optional_inputs=("transform_graph", "schema"),
+    outputs={"best_hyperparameters": "HyperParameters"},
+    parameters={
+        "module_file": Parameter(type=str, required=True),
+        # {name: [candidate values]}; falls back to module SEARCH_SPACE.
+        "search_space": Parameter(type=dict, default=None),
+        "algorithm": Parameter(type=str, default="grid"),  # grid | random
+        "max_trials": Parameter(type=int, default=0),      # 0 = all (grid)
+        "train_steps": Parameter(type=int, default=100),
+        "eval_steps": Parameter(type=int, default=0),
+        # Metric key from TrainResult.final_metrics; "" = eval_loss if
+        # present else loss.
+        "objective": Parameter(type=str, default=""),
+        "direction": Parameter(type=str, default="min"),   # min | max
+        "base_hyperparameters": Parameter(type=dict, default=None),
+        "mesh": Parameter(type=dict, default=None),
+        "custom_config": Parameter(type=dict, default=None),
+        "seed": Parameter(type=int, default=0),
+    },
+    external_input_parameters=("module_file",),
+)
+def Tuner(ctx):
+    module_file = ctx.exec_properties["module_file"]
+    run_fn = load_fn(module_file, "run_fn")
+
+    space = ctx.exec_properties["search_space"]
+    if not space:
+        space = getattr(load_module(module_file), "SEARCH_SPACE", None)
+    if not space:
+        raise ValueError(
+            "Tuner needs a search_space parameter or a SEARCH_SPACE dict in "
+            f"the module file {module_file!r}"
+        )
+    space = {k: list(v) for k, v in space.items()}
+    empty = sorted(k for k, v in space.items() if not v)
+    if empty:
+        raise ValueError(f"search_space entries have no candidates: {empty}")
+
+    algorithm = ctx.exec_properties["algorithm"]
+    max_trials = ctx.exec_properties["max_trials"]
+    if algorithm == "grid":
+        candidates = _grid(space)
+        if max_trials:
+            candidates = candidates[:max_trials]
+    elif algorithm == "random":
+        n = max_trials or min(10, _space_size(space))
+        candidates = _random(space, n, ctx.exec_properties["seed"])
+    else:
+        raise ValueError(f"unknown tuner algorithm {algorithm!r}")
+    if not candidates:
+        raise ValueError(
+            f"tuner produced no candidates (space={space}, "
+            f"max_trials={max_trials})"
+        )
+
+    direction = ctx.exec_properties["direction"]
+    if direction not in ("min", "max"):
+        raise ValueError(f"direction must be 'min' or 'max', got {direction!r}")
+    objective = ctx.exec_properties["objective"]
+    base_hp = dict(ctx.exec_properties["base_hyperparameters"] or {})
+    out = ctx.output("best_hyperparameters")
+
+    trials: List[Dict[str, Any]] = []
+    best_idx = -1
+    best_score = None
+    obj = objective  # resolved from the first trial's metrics when unset
+    for i, cand in enumerate(candidates):
+        trial_dir = os.path.join(out.uri, "trials", str(i))
+        fn_args = resolve_fn_args(
+            ctx,
+            serving_model_dir=os.path.join(trial_dir, "model"),
+            model_run_dir=os.path.join(trial_dir, "model_run"),
+            hyperparameters={**base_hp, **cand},
+            train_steps=ctx.exec_properties["train_steps"],
+            eval_steps=ctx.exec_properties["eval_steps"],
+            mesh=ctx.exec_properties["mesh"],
+            custom_config=ctx.exec_properties["custom_config"],
+        )
+        result = run_fn(fn_args)
+        if not isinstance(result, TrainResult):
+            raise TypeError(
+                "run_fn must return TrainResult for tuning, got "
+                f"{type(result).__name__}"
+            )
+        metrics = result.final_metrics
+        if not obj:
+            # One objective for ALL trials — never compare across metrics.
+            obj = "eval_loss" if "eval_loss" in metrics else "loss"
+        if obj not in metrics:
+            raise KeyError(
+                f"objective {obj!r} not in trial metrics {sorted(metrics)}"
+            )
+        score = float(metrics[obj])
+        trials.append({
+            "trial": i, "hyperparameters": cand, "objective": obj,
+            "score": score, "metrics": metrics,
+        })
+        better = (
+            best_score is None
+            or (direction == "min" and score < best_score)
+            or (direction == "max" and score > best_score)
+        )
+        if better:
+            best_score, best_idx = score, i
+
+    os.makedirs(out.uri, exist_ok=True)
+    best = {**base_hp, **candidates[best_idx]}
+    with open(os.path.join(out.uri, BEST_FILE), "w") as f:
+        json.dump(best, f, indent=2, sort_keys=True, default=str)
+    with open(os.path.join(out.uri, TRIALS_FILE), "w") as f:
+        json.dump(trials, f, indent=2, sort_keys=True, default=str)
+    out.properties["num_trials"] = len(trials)
+    out.properties["best_trial"] = best_idx
+    out.properties["best_score"] = best_score
+    return {
+        "num_trials": len(trials),
+        "best_trial": best_idx,
+        "best_score": best_score,
+    }

@@ -10,3 +10,7 @@ from tpu_pipelines.orchestration.local_runner import (  # noqa: F401
     PipelineRunError,
     RunResult,
 )
+from tpu_pipelines.orchestration.cluster_runner import (  # noqa: F401
+    TPUJobRunner,
+    TPUJobRunnerConfig,
+)

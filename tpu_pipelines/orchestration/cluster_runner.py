@@ -1,0 +1,256 @@
+"""TPUJobRunner: compile a pipeline to TPU cluster manifests (no execution).
+
+Equivalent of ``KubeflowDagRunner().run(pipeline)`` (SURVEY.md §3.2), which
+only COMPILES — it emits Argo workflow YAML and the operator substrate runs
+it.  Here the BASELINE north-star applies: instead of GPU ``TFJob``s the
+runner renders **TPU JobSet** specs (jobset.x-k8s.io, the k8s API Cloud TPU
+multi-host training uses) plus an Argo ``Workflow`` expressing the component
+DAG.  Everything after submission is substrate, not framework.
+
+Emitted per run directory:
+  - ``pipeline_ir.json``  — compiled IR (golden-testable)
+  - ``workflow.yaml``     — Argo Workflow: one DAG task per component.
+    Single-host nodes are container templates invoking
+    ``python -m tpu_pipelines.run_node`` in the user image; distributed
+    nodes (Trainer/Tuner with ``num_hosts`` > 1) are Argo ``resource``
+    templates that CREATE the node's JobSet and await its completion, so
+    multi-host training runs inside the DAG with its dependencies honored.
+  - ``jobset_<node>.yaml`` — the same JobSet standalone (num_hosts workers,
+    TPU nodeSelectors, TPP_* bootstrap env consumed by
+    parallel/distributed.py), for manual submission/debugging.
+
+Multi-host wiring: worker 0's headless-service DNS name is the coordination
+service address; each worker derives its process id from the JobSet
+completion index.  This replaces TF_CONFIG + TFJob operator (SURVEY.md §2b).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+import re
+
+from tpu_pipelines.dsl.compiler import Compiler, PipelineIR
+from tpu_pipelines.dsl.pipeline import Pipeline
+from tpu_pipelines.parallel.distributed import (
+    DEFAULT_PORT,
+    ENV_COORDINATOR,
+    ENV_NUM_PROCESSES,
+)
+
+# Components that train and therefore get a JobSet when num_hosts > 1.
+DISTRIBUTED_COMPONENT_TYPES = ("Trainer", "Tuner")
+
+
+def k8s_name(s: str) -> str:
+    """DNS-1123 subdomain: lowercase alphanumerics and '-', edge-trimmed."""
+    out = re.sub(r"[^a-z0-9-]+", "-", s.lower()).strip("-")
+    if not out:
+        raise ValueError(f"cannot derive a k8s name from {s!r}")
+    return out[:253]
+
+
+@dataclasses.dataclass
+class TPUJobRunnerConfig:
+    image: str                              # container image with user code
+    pipeline_module: str                    # path inside image defining create_pipeline()
+    output_dir: str
+    # TPU slice geometry (GKE labels; v5e-8 single host by default).
+    tpu_accelerator: str = "tpu-v5-lite-podslice"
+    tpu_topology: str = "2x4"
+    num_hosts: int = 1
+    chips_per_host: int = 8
+    namespace: str = "default"
+    service_account: str = ""
+    workflow_name: str = ""                 # defaults to pipeline name
+
+
+class TPUJobRunner:
+    """Compile-only runner; returns the paths of the emitted manifests."""
+
+    def __init__(self, config: TPUJobRunnerConfig):
+        self.config = config
+
+    def run(self, pipeline: Pipeline) -> Dict[str, str]:
+        ir = Compiler().compile(pipeline)
+        cfg = self.config
+        os.makedirs(cfg.output_dir, exist_ok=True)
+        out: Dict[str, str] = {}
+
+        ir_path = os.path.join(cfg.output_dir, "pipeline_ir.json")
+        with open(ir_path, "w") as f:
+            f.write(ir.to_json_str())
+        out["pipeline_ir"] = ir_path
+
+        wf_path = os.path.join(cfg.output_dir, "workflow.yaml")
+        with open(wf_path, "w") as f:
+            yaml.safe_dump(self._workflow(ir), f, sort_keys=True)
+        out["workflow"] = wf_path
+
+        for node in ir.nodes:
+            if self._is_distributed(node):
+                js_path = os.path.join(
+                    cfg.output_dir, f"jobset_{k8s_name(node.id)}.yaml"
+                )
+                with open(js_path, "w") as f:
+                    yaml.safe_dump(self._jobset(ir, node.id), f, sort_keys=True)
+                out[f"jobset_{node.id}"] = js_path
+        return out
+
+    # ------------------------------------------------------------ manifests
+
+    def _node_command(self, node_id: str) -> List[str]:
+        return [
+            "python", "-m", "tpu_pipelines.run_node",
+            "--pipeline-module", self.config.pipeline_module,
+            "--node-id", node_id,
+        ]
+
+    def _is_distributed(self, node) -> bool:
+        return (
+            node.component_type in DISTRIBUTED_COMPONENT_TYPES
+            and self.config.num_hosts > 1
+        )
+
+    def _workflow(self, ir: PipelineIR) -> Dict[str, Any]:
+        cfg = self.config
+        name = k8s_name(cfg.workflow_name or ir.name)
+        tasks = []
+        for node in ir.nodes:
+            task: Dict[str, Any] = {
+                "name": k8s_name(node.id),
+                "template": k8s_name(node.id),
+            }
+            if node.upstream:
+                task["dependencies"] = sorted(
+                    k8s_name(u) for u in node.upstream
+                )
+            tasks.append(task)
+        templates: List[Dict[str, Any]] = [
+            {"name": "pipeline-dag", "dag": {"tasks": tasks}}
+        ]
+        for node in ir.nodes:
+            tpl: Dict[str, Any] = {
+                "name": k8s_name(node.id),
+                "retryStrategy": {"limit": 2},
+            }
+            if self._is_distributed(node):
+                # Create the node's JobSet and await it: multi-host training
+                # runs inside the DAG, dependencies intact.
+                jobset = self._jobset(ir, node.id)
+                tpl["resource"] = {
+                    "action": "create",
+                    "setOwnerReference": True,
+                    "successCondition": "status.terminalState == Completed",
+                    "failureCondition": "status.terminalState == Failed",
+                    "manifest": yaml.safe_dump(jobset, sort_keys=True),
+                }
+            else:
+                tpl["container"] = {
+                    "image": cfg.image,
+                    "command": self._node_command(node.id),
+                    "resources": self._node_resources(node.component_type),
+                }
+                if self._is_tpu_node(node.component_type):
+                    tpl["nodeSelector"] = self._tpu_node_selector()
+            templates.append(tpl)
+        spec: Dict[str, Any] = {
+            "entrypoint": "pipeline-dag",
+            "templates": templates,
+        }
+        if cfg.service_account:
+            spec["serviceAccountName"] = cfg.service_account
+        return {
+            "apiVersion": "argoproj.io/v1alpha1",
+            "kind": "Workflow",
+            "metadata": {
+                "generateName": f"{name}-",
+                "namespace": cfg.namespace,
+                "labels": {"tpu-pipelines/pipeline": name},
+            },
+            "spec": spec,
+        }
+
+    def _jobset(self, ir: PipelineIR, node_id: str) -> Dict[str, Any]:
+        """Multi-host TPU JobSet for one training node (replaces TFJob)."""
+        cfg = self.config
+        name = k8s_name(f"{ir.name}-{node_id}")
+        coordinator = (
+            f"{name}-workers-0-0.{name}:{DEFAULT_PORT}"
+        )
+        env = [
+            {"name": ENV_COORDINATOR, "value": coordinator},
+            {"name": ENV_NUM_PROCESSES, "value": str(cfg.num_hosts)},
+            # process id comes from the completion index injected by the Job
+            # controller; parallel/distributed.py reads it as the fallback.
+        ]
+        container = {
+            "name": "worker",
+            "image": cfg.image,
+            "command": self._node_command(node_id),
+            "env": env,
+            "resources": {
+                "requests": {"google.com/tpu": cfg.chips_per_host},
+                "limits": {"google.com/tpu": cfg.chips_per_host},
+            },
+            "ports": [{"containerPort": DEFAULT_PORT}],
+        }
+        return {
+            "apiVersion": "jobset.x-k8s.io/v1alpha2",
+            "kind": "JobSet",
+            "metadata": {
+                "name": name,
+                "namespace": cfg.namespace,
+                "labels": {
+                    "tpu-pipelines/pipeline": k8s_name(ir.name),
+                    "tpu-pipelines/node": k8s_name(node_id),
+                },
+            },
+            "spec": {
+                "replicatedJobs": [{
+                    "name": "workers",
+                    "replicas": 1,
+                    "template": {
+                        "spec": {
+                            "parallelism": cfg.num_hosts,
+                            "completions": cfg.num_hosts,
+                            "completionMode": "Indexed",
+                            "backoffLimit": 0,
+                            "template": {
+                                "spec": {
+                                    "subdomain": name,
+                                    "restartPolicy": "Never",
+                                    "nodeSelector": self._tpu_node_selector(),
+                                    "containers": [container],
+                                },
+                            },
+                        },
+                    },
+                }],
+            },
+        }
+
+    def _tpu_node_selector(self) -> Dict[str, str]:
+        return {
+            "cloud.google.com/gke-tpu-accelerator": self.config.tpu_accelerator,
+            "cloud.google.com/gke-tpu-topology": self.config.tpu_topology,
+        }
+
+    def _is_tpu_node(self, component_type: str) -> bool:
+        # Components that run jitted on-chip work (SURVEY.md §2a TPU-equiv
+        # column); data/metadata-plane components stay on CPU nodes.
+        return component_type in (
+            "Trainer", "Tuner", "Evaluator", "BulkInferrer", "Transform",
+        )
+
+    def _node_resources(self, component_type: str) -> Dict[str, Any]:
+        if self._is_tpu_node(component_type):
+            return {
+                "requests": {"google.com/tpu": self.config.chips_per_host},
+                "limits": {"google.com/tpu": self.config.chips_per_host},
+            }
+        return {"requests": {"cpu": "2", "memory": "4Gi"}}

@@ -1,0 +1,100 @@
+"""Multi-host bootstrap: the TF_CONFIG / TFJob-operator equivalent.
+
+The reference forms its worker mesh from ``TF_CONFIG`` injected by the
+training operator (SURVEY.md §2b TFJob row, §5 comm backend).  The TPU-native
+equivalent is JAX's coordination service: every process calls
+``jax.distributed.initialize(coordinator, num_processes, process_id)`` and
+XLA then sees one global device set; collectives ride ICI within a host's
+slice and DCN across hosts — no NCCL, no user-level comms library.
+
+The cluster runner (orchestration/cluster_runner.py) injects the TPP_* env
+vars below into each JobSet worker pod; ``maybe_initialize_from_env`` is
+called by the node entrypoint before any JAX computation.  Locally, tests
+spawn N subprocesses with the same env vars over localhost (gloo CPU
+collectives) — multi-host semantics without a cluster (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger("tpu_pipelines.distributed")
+
+ENV_COORDINATOR = "TPP_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "TPP_NUM_PROCESSES"
+ENV_PROCESS_ID = "TPP_PROCESS_ID"
+# JobSet injects the worker index here; used when TPP_PROCESS_ID is absent.
+ENV_JOB_COMPLETION_INDEX = "JOB_COMPLETION_INDEX"
+DEFAULT_PORT = 8476
+
+
+@dataclasses.dataclass
+class DistributedConfig:
+    coordinator_address: str
+    num_processes: int
+    process_id: int
+
+    @classmethod
+    def from_env(cls, env=os.environ) -> Optional["DistributedConfig"]:
+        """None when the env describes a single-process run."""
+        n = int(env.get(ENV_NUM_PROCESSES, "1"))
+        if n <= 1:
+            return None
+        coordinator = env.get(ENV_COORDINATOR, "")
+        if not coordinator:
+            raise ValueError(
+                f"{ENV_NUM_PROCESSES}={n} but {ENV_COORDINATOR} is unset"
+            )
+        pid_s = env.get(ENV_PROCESS_ID, env.get(ENV_JOB_COMPLETION_INDEX))
+        if pid_s is None:
+            raise ValueError(
+                f"{ENV_NUM_PROCESSES}={n} but neither {ENV_PROCESS_ID} nor "
+                f"{ENV_JOB_COMPLETION_INDEX} is set"
+            )
+        return cls(coordinator, n, int(pid_s))
+
+    def env_vars(self) -> dict:
+        return {
+            ENV_COORDINATOR: self.coordinator_address,
+            ENV_NUM_PROCESSES: str(self.num_processes),
+            ENV_PROCESS_ID: str(self.process_id),
+        }
+
+
+def maybe_initialize_from_env(
+    *, cpu_devices_per_process: int = 0, env=os.environ
+) -> Optional[DistributedConfig]:
+    """Join the coordination service if the env asks for it; else no-op.
+
+    Must run before any JAX backend is touched.  ``cpu_devices_per_process``
+    > 0 switches to the CPU/gloo simulation path (tests, dry runs): each
+    process contributes that many virtual CPU devices to the global mesh.
+    """
+    cfg = DistributedConfig.from_env(env)
+    if cfg is None:
+        return None
+    import jax
+
+    if cpu_devices_per_process:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        jax.config.update("jax_num_cpu_devices", cpu_devices_per_process)
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator_address,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )
+    if jax.process_count() != cfg.num_processes:
+        raise RuntimeError(
+            f"distributed init: expected {cfg.num_processes} processes, "
+            f"backend reports {jax.process_count()}"
+        )
+    log.info(
+        "joined coordination service %s as process %d/%d; %d global devices",
+        cfg.coordinator_address, cfg.process_id, cfg.num_processes,
+        len(jax.devices()),
+    )
+    return cfg

@@ -1,0 +1,84 @@
+"""Per-node container entrypoint: ``python -m tpu_pipelines.run_node``.
+
+The cluster-side launcher (SURVEY.md §3.2 ``container_entrypoint``): each
+Argo/JobSet pod runs exactly one pipeline node.  The pod image carries the
+user's pipeline module (a file defining ``create_pipeline() -> Pipeline``);
+this entrypoint joins the multi-host coordination service when the TPP_* env
+vars are present (parallel/distributed.py), then executes the single node as
+a partial run — input artifacts resolve from the shared metadata store, so
+the DAG's ordering/caching semantics are identical to a local run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from tpu_pipelines.orchestration.local_runner import LocalDagRunner
+from tpu_pipelines.parallel.distributed import maybe_initialize_from_env
+from tpu_pipelines.utils.module_loader import load_fn
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--pipeline-module", required=True,
+                        help="file defining create_pipeline() -> Pipeline")
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--run-id", default=None)
+    parser.add_argument(
+        "--cpu-devices-per-process", type=int, default=0,
+        help="simulate multi-host on CPU with N local devices (tests)",
+    )
+    parser.add_argument("--max-retries", type=int, default=0)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    dist = maybe_initialize_from_env(
+        cpu_devices_per_process=args.cpu_devices_per_process
+    )
+
+    pipeline = load_fn(args.pipeline_module, "create_pipeline")()
+    if dist is not None and dist.process_id != 0:
+        # SPMD workers all execute the node's computation, but only process 0
+        # publishes to the shared metadata store (single-writer discipline,
+        # same as TF_CONFIG "chief"); peers work on a scratch copy.
+        import os
+        import shutil
+        import tempfile
+
+        if not os.path.isfile(pipeline.metadata_path):
+            raise FileNotFoundError(
+                f"multi-host run needs a shared on-disk metadata store; "
+                f"{pipeline.metadata_path!r} does not exist (is the pipeline "
+                "using the in-memory default, or has no upstream node run?)"
+            )
+        scratch = tempfile.mkdtemp(prefix=f"tpp_worker{dist.process_id}_")
+        scratch_md = f"{scratch}/metadata.sqlite"
+        shutil.copyfile(pipeline.metadata_path, scratch_md)
+        pipeline.metadata_path = scratch_md
+        # Output artifacts too: only process 0 writes the real pipeline root.
+        pipeline.pipeline_root = f"{scratch}/root"
+
+    runner = LocalDagRunner(max_retries=args.max_retries)
+    result = runner.run(
+        pipeline,
+        run_id=args.run_id,
+        from_nodes=[args.node_id],
+        to_nodes=[args.node_id],
+        raise_on_failure=False,
+    )
+    node = result.nodes[args.node_id]
+    if dist is not None:
+        # One barrier so no worker exits while peers still compute.
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"run_node:{args.node_id}:done")
+    if node.status in ("COMPLETE", "CACHED"):
+        return 0
+    print(f"node {args.node_id} failed: {node.error}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

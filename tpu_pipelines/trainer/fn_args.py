@@ -33,6 +33,43 @@ class FnArgs:
     custom_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
+def resolve_fn_args(
+    ctx,
+    *,
+    serving_model_dir: str,
+    model_run_dir: str,
+    hyperparameters: Dict[str, Any],
+    train_steps: int,
+    eval_steps: int,
+    mesh: Optional[Dict[str, int]] = None,
+    custom_config: Optional[Dict[str, Any]] = None,
+) -> "FnArgs":
+    """Build FnArgs from an executor context's resolved artifacts.
+
+    Shared by Trainer and Tuner so the run_fn contract (optional
+    transform_graph/schema wiring, custom_config passthrough) cannot drift
+    between them.
+    """
+    return FnArgs(
+        train_examples_uri=ctx.input("examples").uri,
+        eval_examples_uri=ctx.input("examples").uri,
+        transform_graph_uri=(
+            ctx.input("transform_graph").uri
+            if ctx.inputs.get("transform_graph") else ""
+        ),
+        schema_uri=(
+            ctx.input("schema").uri if ctx.inputs.get("schema") else ""
+        ),
+        serving_model_dir=serving_model_dir,
+        model_run_dir=model_run_dir,
+        train_steps=train_steps,
+        eval_steps=eval_steps,
+        hyperparameters=hyperparameters,
+        mesh_config=dict(mesh or {}),
+        custom_config=dict(custom_config or {}),
+    )
+
+
 @dataclasses.dataclass
 class TrainResult:
     """What run_fn reports back; recorded as execution properties."""
