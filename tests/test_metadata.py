@@ -19,9 +19,23 @@ from tpu_pipelines.utils.fingerprint import (
 )
 
 
-@pytest.fixture
-def store():
-    s = MetadataStore(":memory:")
+def _make_store(backend: str, path: str = ":memory:"):
+    if backend == "native":
+        from tpu_pipelines.metadata.native_store import (
+            NativeMetadataStore,
+            NativeUnavailable,
+        )
+
+        try:
+            return NativeMetadataStore(path)
+        except NativeUnavailable as e:
+            pytest.skip(f"native backend unavailable: {e}")
+    return MetadataStore(path)
+
+
+@pytest.fixture(params=["python", "native"])
+def store(request):
+    s = _make_store(request.param)
     yield s
     s.close()
 

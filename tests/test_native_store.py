@@ -1,0 +1,116 @@
+"""Native (C++) metadata backend specifics beyond the shared suite."""
+
+import os
+
+import pytest
+
+from tpu_pipelines.metadata import MetadataStore, open_store
+from tpu_pipelines.metadata.types import Artifact, ArtifactState
+
+
+def _native(path):
+    from tpu_pipelines.metadata.native_store import (
+        NativeMetadataStore,
+        NativeUnavailable,
+    )
+
+    try:
+        return NativeMetadataStore(path)
+    except NativeUnavailable as e:
+        pytest.skip(f"native backend unavailable: {e}")
+
+
+def test_cross_backend_file_compatibility(tmp_path):
+    """A store written by the C++ core opens identically in Python (and back)."""
+    path = str(tmp_path / "md.sqlite")
+    n = _native(path)
+    aid = n.put_artifact(Artifact(
+        type_name="Examples", uri="/x",
+        properties={"note": 'quotes "and" \\slashes\n', "n": 3, "f": 1.5},
+    ))
+    n.close()
+
+    p = MetadataStore(path)
+    art = p.get_artifact(aid)
+    assert art.type_name == "Examples"
+    assert art.properties == {"note": 'quotes "and" \\slashes\n', "n": 3,
+                              "f": 1.5}
+    art.state = ArtifactState.LIVE
+    p.put_artifact(art)
+    p.close()
+
+    n2 = _native(path)
+    assert n2.get_artifact(aid).state == ArtifactState.LIVE
+    n2.close()
+
+
+def test_unpersisted_id_zero_matches_nothing(tmp_path):
+    """id=0 is the unpersisted sentinel; lookups must return None/empty,
+    not the first row (parity with the Python backend)."""
+    s = _native(str(tmp_path / "md.sqlite"))
+    s.put_artifact(Artifact(type_name="Examples", uri="/x"))
+    assert s.get_artifact(0) is None
+    assert s.get_execution(0) is None
+    assert s.get_events_by_artifact(0) == []
+    assert s.get_events_by_execution(0) == []
+    s.close()
+
+
+def test_publish_rollback_is_atomic(tmp_path):
+    """A failing publish in the native backend leaves no partial rows."""
+    from tpu_pipelines.metadata.types import Execution, ExecutionState
+
+    s = _native(str(tmp_path / "md.sqlite"))
+    out_art = Artifact(type_name="Model", uri="/m")
+    bad_input = Artifact(type_name="Examples", uri="/e")  # no id -> assert
+    ex = Execution(type_name="Trainer", node_id="Trainer",
+                   state=ExecutionState.COMPLETE)
+    with pytest.raises(AssertionError):
+        s.publish_execution(ex, {"examples": [bad_input]}, {"model": [out_art]})
+    assert s.get_executions() == []
+    assert s.get_artifacts() == []
+    s.close()
+
+
+def test_open_store_backend_selection(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPP_METADATA_BACKEND", "native")
+    s = open_store(str(tmp_path / "a.sqlite"))
+    # either the native class, or (toolchain-free machine) the fallback
+    from tpu_pipelines.metadata.native_store import NativeMetadataStore
+
+    assert isinstance(s, (NativeMetadataStore, MetadataStore))
+    s.close()
+    monkeypatch.setenv("TPP_METADATA_BACKEND", "python")
+    s2 = open_store(str(tmp_path / "b.sqlite"))
+    assert type(s2) is MetadataStore
+    s2.close()
+    monkeypatch.setenv("TPP_METADATA_BACKEND", "bogus")
+    with pytest.raises(ValueError):
+        open_store(str(tmp_path / "c.sqlite"))
+
+
+def test_pipeline_runs_on_native_backend(tmp_path, monkeypatch):
+    """Full pipeline + cache hit with TPP_METADATA_BACKEND=native."""
+    _native(":memory:")  # skip early if unbuildable
+    monkeypatch.setenv("TPP_METADATA_BACKEND", "native")
+    from tpu_pipelines.components import CsvExampleGen, StatisticsGen
+    from tpu_pipelines.dsl.pipeline import Pipeline
+    from tpu_pipelines.orchestration import LocalDagRunner
+
+    csv = tmp_path / "d.csv"
+    csv.write_text("a,b\n" + "\n".join(f"{i},{i*2}" for i in range(20)) + "\n")
+
+    def build():
+        gen = CsvExampleGen(input_path=str(csv))
+        stats = StatisticsGen(examples=gen.outputs["examples"])
+        return Pipeline(
+            "native-md", [stats],
+            pipeline_root=str(tmp_path / "root"),
+            metadata_path=str(tmp_path / "md.sqlite"),
+        )
+
+    r1 = LocalDagRunner().run(build())
+    assert r1.succeeded
+    assert all(n.status == "COMPLETE" for n in r1.nodes.values())
+    r2 = LocalDagRunner().run(build())
+    assert all(n.status == "CACHED" for n in r2.nodes.values())

@@ -15,3 +15,31 @@ from tpu_pipelines.metadata.types import (  # noqa: F401
     Context,
 )
 from tpu_pipelines.metadata.store import MetadataStore  # noqa: F401
+
+
+def open_store(db_path: str = ":memory:", backend: str = "") -> MetadataStore:
+    """Open a metadata store, selecting the backend.
+
+    ``backend`` (or env ``TPP_METADATA_BACKEND``): "python" (default) uses
+    the stdlib-sqlite store; "native" uses the C++ core
+    (native/metadata_core.cc via ctypes — the ml-metadata-shaped backend),
+    falling back to "python" with a warning if it cannot be built/loaded.
+    Both backends share one on-disk schema, so they are interchangeable per
+    open.
+    """
+    import logging
+    import os
+
+    choice = (backend or os.environ.get("TPP_METADATA_BACKEND", "python")).lower()
+    if choice == "native":
+        try:
+            from tpu_pipelines.metadata.native_store import NativeMetadataStore
+
+            return NativeMetadataStore(db_path)
+        except Exception as e:  # toolchain-free deployment images
+            logging.getLogger("tpu_pipelines.metadata").warning(
+                "native metadata backend unavailable (%s); using python", e
+            )
+    elif choice != "python":
+        raise ValueError(f"unknown metadata backend {choice!r}")
+    return MetadataStore(db_path)

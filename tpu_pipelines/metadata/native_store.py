@@ -1,0 +1,359 @@
+"""Native metadata backend: ctypes binding over native/metadata_core.cc.
+
+The reference's metadata plane is ml-metadata — a C++ storage core with a
+thin Python client (SURVEY.md §2b MLMD row).  Same architecture here: the
+C++ engine (schema, prepared statements, transactions, row serialization)
+compiles to ``native/libtppmeta.so``; this module is the client.  The
+composite logic (publish_execution, cache lookup, lineage walks) is
+inherited from :class:`~tpu_pipelines.metadata.store.MetadataStore`
+unchanged, so both backends behave identically — and the on-disk SQLite
+schema matches exactly, so a store written by one backend opens in the other.
+
+Select at runtime with ``TPP_METADATA_BACKEND=native`` (see
+``metadata.open_store``); falls back to the Python backend if the shared
+object cannot be built (e.g. no toolchain in the deployment image).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+import threading
+from typing import Dict, Iterable, List, Optional
+
+from tpu_pipelines.metadata.store import MetadataStore
+from tpu_pipelines.metadata.types import (
+    Artifact,
+    ArtifactState,
+    Context,
+    Event,
+    EventType,
+    Execution,
+    ExecutionState,
+)
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+LIB_NAME = "libtppmeta.so"
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def _load_library():
+    """Build (make) if needed, then dlopen; raises NativeUnavailable."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = os.path.join(NATIVE_DIR, LIB_NAME)
+        # Always invoke make: it is a no-op when the .so is newer than the
+        # sources, and it rebuilds a stale .so after metadata_core.cc edits.
+        try:
+            subprocess.run(
+                ["make", "-C", NATIVE_DIR], check=True,
+                capture_output=True, text=True, timeout=120,
+            )
+        except (subprocess.SubprocessError, OSError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            if not os.path.exists(path):
+                raise NativeUnavailable(
+                    f"cannot build {LIB_NAME}: {detail[-500:]}"
+                ) from e
+            # toolchain-free image with a prebuilt .so: use it as-is
+        lib = ctypes.CDLL(path)
+        lib.tpp_meta_open.restype = ctypes.c_void_p
+        lib.tpp_meta_open.argtypes = [ctypes.c_char_p]
+        lib.tpp_meta_close.argtypes = [ctypes.c_void_p]
+        lib.tpp_meta_errmsg.restype = ctypes.c_char_p
+        lib.tpp_meta_errmsg.argtypes = [ctypes.c_void_p]
+        lib.tpp_meta_free.argtypes = [ctypes.c_void_p]
+        lib.tpp_meta_exec.restype = ctypes.c_int
+        lib.tpp_meta_exec.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.tpp_meta_put_artifact.restype = ctypes.c_int64
+        lib.tpp_meta_put_artifact.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_double,
+        ]
+        lib.tpp_meta_get_artifacts.restype = ctypes.c_void_p
+        lib.tpp_meta_get_artifacts.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_int64,
+        ]
+        lib.tpp_meta_put_execution.restype = ctypes.c_int64
+        lib.tpp_meta_put_execution.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_double, ctypes.c_double,
+        ]
+        lib.tpp_meta_get_executions.restype = ctypes.c_void_p
+        lib.tpp_meta_get_executions.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+        ]
+        lib.tpp_meta_put_event.restype = ctypes.c_int
+        lib.tpp_meta_put_event.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_double,
+        ]
+        lib.tpp_meta_get_events.restype = ctypes.c_void_p
+        lib.tpp_meta_get_events.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ]
+        lib.tpp_meta_put_context.restype = ctypes.c_int64
+        lib.tpp_meta_put_context.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_double,
+        ]
+        lib.tpp_meta_get_context.restype = ctypes.c_void_p
+        lib.tpp_meta_get_context.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        lib.tpp_meta_link.restype = ctypes.c_int
+        lib.tpp_meta_link.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+        ]
+        lib.tpp_meta_by_context.restype = ctypes.c_void_p
+        lib.tpp_meta_by_context.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+        ]
+        lib.tpp_meta_latest_cached_execution.restype = ctypes.c_int64
+        lib.tpp_meta_latest_cached_execution.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        _lib = lib
+        return lib
+
+
+def _b(s: Optional[str]) -> bytes:
+    return (s or "").encode("utf-8")
+
+
+class NativeMetadataStore(MetadataStore):
+    """MetadataStore with every primitive served by the C++ core."""
+
+    def __init__(self, db_path: str = ":memory:"):
+        self._lib = _load_library()
+        super().__init__(db_path)
+
+    def _open_backend(self, db_path: str) -> None:
+        self._handle = self._lib.tpp_meta_open(_b(db_path))
+        if not self._handle:
+            raise NativeUnavailable(f"tpp_meta_open failed for {db_path!r}")
+
+    # ------------------------------------------------------------ plumbing
+
+    def _err(self, what: str):
+        msg = self._lib.tpp_meta_errmsg(self._handle).decode("utf-8", "replace")
+        raise RuntimeError(f"native metadata store: {what}: {msg}")
+
+    def _take_json(self, ptr) -> list:
+        if not ptr:
+            self._err("query")
+        try:
+            return json.loads(ctypes.string_at(ptr).decode("utf-8"))
+        finally:
+            self._lib.tpp_meta_free(ptr)
+
+    def _commit(self) -> None:
+        pass  # autocommit per statement outside explicit transactions
+
+    def _tx_begin(self) -> None:
+        if self._lib.tpp_meta_exec(self._handle, b"BEGIN") != 0:
+            self._err("BEGIN")
+
+    def _tx_commit(self) -> None:
+        if self._lib.tpp_meta_exec(self._handle, b"COMMIT") != 0:
+            self._err("COMMIT")
+
+    def _tx_rollback(self) -> None:
+        self._lib.tpp_meta_exec(self._handle, b"ROLLBACK")
+
+    def publish_execution(self, execution, input_artifacts, output_artifacts,
+                          contexts=()):
+        # Open an explicit transaction; super() ends it via _tx_commit /
+        # _tx_rollback (the shared composite logic).
+        with self._lock:
+            self._tx_begin()
+            return super().publish_execution(
+                execution, input_artifacts, output_artifacts, contexts
+            )
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.tpp_meta_close(self._handle)
+            self._handle = None
+
+    # ----------------------------------------------------------- artifacts
+
+    def _artifact(self, row: dict) -> Artifact:
+        art = Artifact(
+            type_name=row["type_name"], uri=row["uri"],
+            state=ArtifactState(row["state"]), properties=row["properties"],
+            fingerprint=row["fingerprint"], create_time=row["create_time"],
+        )
+        art.id = row["id"]
+        return art
+
+    def put_artifact(self, artifact: Artifact) -> int:
+        with self._lock:
+            rid = self._lib.tpp_meta_put_artifact(
+                self._handle, artifact.id, _b(artifact.type_name),
+                _b(artifact.uri), _b(artifact.state.value),
+                _b(json.dumps(artifact.properties, sort_keys=True, default=str)),
+                _b(artifact.fingerprint), artifact.create_time,
+            )
+            if rid < 0:
+                self._err("put_artifact")
+            artifact.id = rid
+            return rid
+
+    def get_artifact(self, artifact_id: int) -> Optional[Artifact]:
+        rows = self._take_json(self._lib.tpp_meta_get_artifacts(
+            self._handle, b"", b"", b"", artifact_id))
+        return self._artifact(rows[0]) if rows else None
+
+    # NB: the C ABI treats id/filter arguments < 0 as "no filter"; 0 is a
+    # real value (the unpersisted sentinel) and matches nothing.
+
+    def get_artifacts(self, type_name=None, state=None) -> List[Artifact]:
+        rows = self._take_json(self._lib.tpp_meta_get_artifacts(
+            self._handle, _b(type_name),
+            _b(state.value if state else None), b"", -1))
+        return [self._artifact(r) for r in rows]
+
+    def get_artifacts_by_uri(self, uri: str) -> List[Artifact]:
+        rows = self._take_json(self._lib.tpp_meta_get_artifacts(
+            self._handle, b"", b"", _b(uri), -1))
+        return [self._artifact(r) for r in rows]
+
+    # ---------------------------------------------------------- executions
+
+    def _execution(self, row: dict) -> Execution:
+        ex = Execution(
+            type_name=row["type_name"], node_id=row["node_id"],
+            state=ExecutionState(row["state"]), properties=row["properties"],
+            cache_key=row["cache_key"], create_time=row["create_time"],
+            update_time=row["update_time"],
+        )
+        ex.id = row["id"]
+        return ex
+
+    def put_execution(self, execution: Execution) -> int:
+        import time
+
+        execution.update_time = time.time()
+        with self._lock:
+            rid = self._lib.tpp_meta_put_execution(
+                self._handle, execution.id, _b(execution.type_name),
+                _b(execution.node_id), _b(execution.state.value),
+                _b(json.dumps(execution.properties, sort_keys=True,
+                              default=str)),
+                _b(execution.cache_key), execution.create_time,
+                execution.update_time,
+            )
+            if rid < 0:
+                self._err("put_execution")
+            execution.id = rid
+            return rid
+
+    def get_execution(self, execution_id: int) -> Optional[Execution]:
+        rows = self._take_json(self._lib.tpp_meta_get_executions(
+            self._handle, b"", b"", execution_id))
+        return self._execution(rows[0]) if rows else None
+
+    def get_executions(self, node_id=None, state=None) -> List[Execution]:
+        rows = self._take_json(self._lib.tpp_meta_get_executions(
+            self._handle, _b(node_id), _b(state.value if state else None), -1))
+        return [self._execution(r) for r in rows]
+
+    # -------------------------------------------------------------- events
+
+    def put_events(self, events: Iterable[Event]) -> None:
+        with self._lock:
+            for e in events:
+                if self._lib.tpp_meta_put_event(
+                    self._handle, e.artifact_id, e.execution_id,
+                    _b(e.type.value), _b(e.path), e.index, e.ts,
+                ) != 0:
+                    self._err("put_event")
+
+    def _events(self, rows: list) -> List[Event]:
+        return [
+            Event(r["artifact_id"], r["execution_id"], EventType(r["type"]),
+                  r["path"], r["idx"], r["ts"])
+            for r in rows
+        ]
+
+    def get_events_by_execution(self, execution_id: int) -> List[Event]:
+        return self._events(self._take_json(
+            self._lib.tpp_meta_get_events(self._handle, -1, execution_id)))
+
+    def get_events_by_artifact(self, artifact_id: int) -> List[Event]:
+        return self._events(self._take_json(
+            self._lib.tpp_meta_get_events(self._handle, artifact_id, -1)))
+
+    # ------------------------------------------------------------ contexts
+
+    def put_context(self, context: Context) -> int:
+        with self._lock:
+            rid = self._lib.tpp_meta_put_context(
+                self._handle, _b(context.type_name), _b(context.name),
+                _b(json.dumps(context.properties, sort_keys=True, default=str)),
+                context.create_time,
+            )
+            if rid < 0:
+                self._err("put_context")
+            context.id = rid
+            return rid
+
+    def get_context(self, type_name: str, name: str) -> Optional[Context]:
+        rows = self._take_json(self._lib.tpp_meta_get_context(
+            self._handle, _b(type_name), _b(name)))
+        if not rows:
+            return None
+        r = rows[0]
+        ctx = Context(type_name=r["type_name"], name=r["name"],
+                      properties=r["properties"], create_time=r["create_time"])
+        ctx.id = r["id"]
+        return ctx
+
+    def associate(self, context_id: int, execution_id: int) -> None:
+        with self._lock:
+            if self._lib.tpp_meta_link(
+                self._handle, b"associations", context_id, execution_id
+            ) != 0:
+                self._err("associate")
+
+    def attribute(self, context_id: int, artifact_id: int) -> None:
+        with self._lock:
+            if self._lib.tpp_meta_link(
+                self._handle, b"attributions", context_id, artifact_id
+            ) != 0:
+                self._err("attribute")
+
+    def get_executions_by_context(self, context_id: int) -> List[Execution]:
+        return [self._execution(r) for r in self._take_json(
+            self._lib.tpp_meta_by_context(self._handle, b"executions",
+                                          context_id))]
+
+    def get_artifacts_by_context(self, context_id: int) -> List[Artifact]:
+        return [self._artifact(r) for r in self._take_json(
+            self._lib.tpp_meta_by_context(self._handle, b"artifacts",
+                                          context_id))]
+
+    # ------------------------------------------------------- cache lookup
+
+    def _latest_cached_execution_id(self, cache_key: str) -> int:
+        rid = self._lib.tpp_meta_latest_cached_execution(
+            self._handle, _b(cache_key), _b(ExecutionState.COMPLETE.value))
+        if rid < 0:
+            self._err("cache lookup")
+        return int(rid)

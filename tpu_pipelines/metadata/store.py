@@ -104,6 +104,10 @@ class MetadataStore:
         if db_path != ":memory:":
             parent = os.path.dirname(os.path.abspath(db_path))
             os.makedirs(parent, exist_ok=True)
+        self._open_backend(db_path)
+
+    def _open_backend(self, db_path: str) -> None:
+        """Open the storage engine; the native backend overrides only this."""
         self._conn = sqlite3.connect(db_path, check_same_thread=False)
         with self._lock:
             if db_path != ":memory:":
@@ -116,6 +120,14 @@ class MetadataStore:
         """Commit unless inside an explicit multi-write transaction."""
         if not self._in_tx:
             self._conn.commit()
+
+    # Transaction hooks — overridden by alternative backends
+    # (metadata/native_store.py) so publish_execution stays shared.
+    def _tx_commit(self) -> None:
+        self._conn.commit()
+
+    def _tx_rollback(self) -> None:
+        self._conn.rollback()
 
     def close(self) -> None:
         self._conn.close()
@@ -344,9 +356,9 @@ class MetadataStore:
                 self._publish_locked(
                     execution, input_artifacts, output_artifacts, contexts
                 )
-                self._conn.commit()
+                self._tx_commit()
             except BaseException:
-                self._conn.rollback()
+                self._tx_rollback()
                 raise
             finally:
                 self._in_tx = False
@@ -399,15 +411,11 @@ class MetadataStore:
         """
         if not cache_key:
             return None
-        row = self._conn.execute(
-            "SELECT id FROM executions WHERE cache_key=? AND state=? "
-            "ORDER BY id DESC LIMIT 1",
-            (cache_key, ExecutionState.COMPLETE.value),
-        ).fetchone()
-        if not row:
+        exec_id = self._latest_cached_execution_id(cache_key)
+        if not exec_id:
             return None
         outputs: Dict[str, List[Artifact]] = {}
-        for ev in self.get_events_by_execution(row[0]):
+        for ev in self.get_events_by_execution(exec_id):
             if ev.type != EventType.OUTPUT:
                 continue
             art = self.get_artifact(ev.artifact_id)
@@ -422,6 +430,15 @@ class MetadataStore:
             path: [a for _, a in sorted(pairs, key=lambda p: p[0])]
             for path, pairs in outputs.items()
         }
+
+    def _latest_cached_execution_id(self, cache_key: str) -> int:
+        """Id of the newest COMPLETE execution with this key; 0 = miss."""
+        row = self._conn.execute(
+            "SELECT id FROM executions WHERE cache_key=? AND state=? "
+            "ORDER BY id DESC LIMIT 1",
+            (cache_key, ExecutionState.COMPLETE.value),
+        ).fetchone()
+        return row[0] if row else 0
 
     # ------------------------------------------------------ lineage queries
 

@@ -113,7 +113,9 @@ class LocalDagRunner:
         """
         ir = Compiler().compile(pipeline)
         executors = {c.id: c for c in pipeline.components}
-        store = MetadataStore(pipeline.metadata_path)
+        from tpu_pipelines.metadata import open_store
+
+        store = open_store(pipeline.metadata_path)
         run_id = run_id or f"{pipeline.name}-{int(time.time() * 1000)}"
         runtime_parameters = dict(runtime_parameters or {})
 
