@@ -1,0 +1,67 @@
+"""Driver entry points + the train-loop features they exercise."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from tpu_pipelines.parallel.mesh import MeshConfig
+from tpu_pipelines.trainer import TrainLoopConfig, train_loop
+
+
+def test_dryrun_multichip_8():
+    """The driver's multi-chip validation path: dp*tp*sp on 8 CPU devices."""
+    from __graft_entry__ import dryrun_multichip
+
+    dryrun_multichip(8)
+
+
+def test_batch_partition_shards_seq_axis():
+    def loss_fn(params, batch, rng):
+        x = jnp.asarray(batch["tokens"], jnp.float32)
+        return jnp.mean((x * params["w"]) ** 2), {}
+
+    def batches():
+        while True:
+            yield {"tokens": np.ones((8, 16), np.float32)}
+
+    def init_fn(rng, sample):
+        return {"w": jnp.ones(())}
+
+    params, result = train_loop(
+        loss_fn=loss_fn, init_params_fn=init_fn,
+        optimizer=optax.sgd(0.1), train_iter=batches(),
+        config=TrainLoopConfig(
+            train_steps=2, batch_size=8, log_every=0,
+            mesh_config=MeshConfig(data=2, seq=4),
+            batch_partition={"tokens": P("data", "seq")},
+        ),
+    )
+    assert result.steps_completed == 2
+
+
+def test_goodput_and_profile(tmp_path):
+    def loss_fn(params, batch, rng):
+        return jnp.mean((batch["x"] @ params["w"]) ** 2), {}
+
+    def batches():
+        while True:
+            yield {"x": np.ones((16, 4), np.float32)}
+
+    prof_dir = str(tmp_path / "profile")
+    params, result = train_loop(
+        loss_fn=loss_fn,
+        init_params_fn=lambda rng, b: {"w": jnp.ones((4, 2))},
+        optimizer=optax.sgd(0.1), train_iter=batches(),
+        config=TrainLoopConfig(
+            train_steps=8, batch_size=16, log_every=0,
+            profile_dir=prof_dir, profile_from=2, profile_to=4,
+        ),
+    )
+    assert 0.0 <= result.goodput <= 1.0
+    # a trace landed on disk (plugins/profile/... under the dir)
+    found = [f for _, _, fs in os.walk(prof_dir) for f in fs]
+    assert found, "no profiler trace written"

@@ -79,3 +79,7 @@ class TrainResult:
     examples_per_sec_per_chip: float = 0.0
     steps_completed: int = 0
     resumed_from_step: int = 0
+    # Fraction of post-compile wall-clock not spent in host-side input work.
+    # A lower bound on device goodput (host input can overlap async device
+    # execution); 1.0 when the run was too short to measure.
+    goodput: float = 0.0

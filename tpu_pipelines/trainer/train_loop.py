@@ -70,7 +70,16 @@ class TrainLoopConfig:
     # Optional pytree-of-PartitionSpec matching params, for model parallelism;
     # None = fully replicated params (pure DP, the reference's strategy).
     param_partition: Optional[Any] = None
+    # Optional {batch_key: PartitionSpec} for input sharding beyond plain
+    # batch-dim DP — e.g. P("data", "seq") on token ids for ring-attention
+    # sequence parallelism.  Keys not listed shard dim 0 over "data".
+    batch_partition: Optional[Dict[str, Any]] = None
     donate_state: bool = True
+    # Device profiling (the TensorBoard-profile equivalent, SURVEY.md §5):
+    # capture a jax.profiler trace for steps [profile_from, profile_to).
+    profile_dir: str = ""
+    profile_from: int = 2
+    profile_to: int = 5
 
 
 LossFn = Callable[[Any, Dict[str, jax.Array], jax.Array], Tuple[jax.Array, Dict[str, jax.Array]]]
@@ -169,9 +178,20 @@ def train_loop(
     state = jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, s), state, state_shard
     )
-    batch_shard = jax.tree_util.tree_map(
-        lambda x: data_parallel_sharding(mesh, np.asarray(x).ndim), first_batch
-    )
+    bp = config.batch_partition or {}
+    unknown = sorted(set(bp) - set(first_batch))
+    if unknown:
+        raise ValueError(
+            f"batch_partition keys {unknown} not in batch "
+            f"(has {sorted(first_batch)})"
+        )
+    batch_shard = {
+        k: (
+            NamedSharding(mesh, bp[k]) if k in bp
+            else data_parallel_sharding(mesh, np.asarray(v).ndim)
+        )
+        for k, v in first_batch.items()
+    }
 
     def step_fn(state: TrainState, batch) -> Tuple[TrainState, Dict[str, jax.Array]]:
         step_rng = jax.random.fold_in(state.rng, state.step)
@@ -254,11 +274,24 @@ def train_loop(
     metrics = None   # stays None when resume starts at/past train_steps
     t_start = None
     examples_after_t0 = 0
+    input_wait_s = 0.0     # host-side time not overlapped with device work
+    profiling = False
     batch = first_batch
     step = start_step
     while step < config.train_steps:
-        state, metrics = train_step(state, put_batch(batch))
+        if config.profile_dir and not profiling and step - start_step == config.profile_from:
+            jax.profiler.start_trace(config.profile_dir)
+            profiling = True
+        t_in = time.perf_counter()
+        device_batch = put_batch(batch)
+        if t_start is not None:  # only measure the post-compile window
+            input_wait_s += time.perf_counter() - t_in
+        state, metrics = train_step(state, device_batch)
         step += 1
+        if profiling and step - start_step >= config.profile_to:
+            jax.block_until_ready(metrics["loss"])
+            jax.profiler.stop_trace()
+            profiling = False
         if t_start is None:
             # Start timing after step 1 retires (excludes compile time).
             jax.block_until_ready(metrics["loss"])
@@ -287,11 +320,16 @@ def train_loop(
         if step >= config.train_steps:
             break
         try:
+            t_in = time.perf_counter()
             batch = next(train_it)
+            if t_start is not None:
+                input_wait_s += time.perf_counter() - t_in
         except StopIteration:
             log.info("train iterator exhausted at step %d", step)
             break
 
+    if profiling:
+        jax.profiler.stop_trace()
     jax.block_until_ready(state.params)
     elapsed = max(1e-9, time.perf_counter() - (t_start or time.perf_counter()))
     eps = examples_after_t0 / elapsed if examples_after_t0 else 0.0
@@ -315,6 +353,15 @@ def train_loop(
         examples_per_sec_per_chip=round(eps / n_devices, 2),
         steps_completed=step,
         resumed_from_step=start_step,
+        # Goodput proxy (SURVEY.md §5 failure/goodput accounting): fraction
+        # of post-compile wall-clock not spent in host-side input work.
+        # Host input may overlap async device execution, so this is a LOWER
+        # bound on true device goodput; 1.0 when too few post-compile steps
+        # ran to measure anything.
+        goodput=(
+            round(max(0.0, 1.0 - input_wait_s / elapsed), 4)
+            if examples_after_t0 else 1.0
+        ),
     )
     return state.params, result
 
