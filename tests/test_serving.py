@@ -1,0 +1,132 @@
+"""Serving: ModelServer REST surface, version dirs, SavedModel export."""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpu_pipelines.trainer.export import export_model
+
+
+def _toy_module(tmp_path):
+    mod = tmp_path / "toy_model.py"
+    mod.write_text(
+        "import jax.numpy as jnp\n"
+        "def build_model(hp):\n"
+        "    return None  # params-only model; apply_fn does the math\n"
+        "def apply_fn(model, params, batch):\n"
+        "    return jnp.asarray(batch['x'], jnp.float32) @ params['w']\n"
+    )
+    return str(mod)
+
+
+def _export(tmp_path, dirname, scale=1.0):
+    payload = tmp_path / dirname
+    export_model(
+        serving_model_dir=str(payload),
+        params={"w": (scale * np.eye(3, 2)).astype(np.float32)},
+        module_file=_toy_module(tmp_path),
+    )
+    return str(payload)
+
+
+def test_server_versions_and_rest(tmp_path):
+    from tpu_pipelines.serving import ModelServer
+
+    base = tmp_path / "served" / "toy"
+    _export(tmp_path, "served/toy/1", scale=1.0)
+    server = ModelServer("toy", str(base))
+    assert server.version == "1"
+
+    port = server.start()
+    try:
+        # status endpoint
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/models/toy"
+        ) as r:
+            status = json.load(r)
+        assert status["model_version_status"][0]["version"] == "1"
+
+        # row-oriented predict
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/toy:predict",
+            data=json.dumps(
+                {"instances": [{"x": [1.0, 2.0, 3.0]},
+                               {"x": [0.0, 1.0, 0.0]}]}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            preds = json.load(r)["predictions"]
+        np.testing.assert_allclose(preds, [[1.0, 2.0], [0.0, 1.0]])
+
+        # column-oriented predict
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/toy:predict",
+            data=json.dumps(
+                {"inputs": {"x": [[1.0, 0.0, 0.0]]}}
+            ).encode(),
+        )
+        with urllib.request.urlopen(req) as r:
+            assert json.load(r)["predictions"] == [[1.0, 0.0]]
+
+        # bad request -> 400 with error body
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/toy:predict",
+            data=b'{"bogus": 1}',
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 400
+
+        # new version appears -> reload() hot-swaps, same endpoint
+        _export(tmp_path, "served/toy/2", scale=2.0)
+        assert server.reload() == "2"
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/toy:predict",
+            data=json.dumps({"inputs": {"x": [[1.0, 0.0, 0.0]]}}).encode(),
+        )
+        with urllib.request.urlopen(req) as r:
+            assert json.load(r)["predictions"] == [[2.0, 0.0]]
+    finally:
+        server.stop()
+
+
+def test_server_flat_payload(tmp_path):
+    from tpu_pipelines.serving import ModelServer
+
+    payload = _export(tmp_path, "flat_model")
+    server = ModelServer("flat", payload)
+    out = server.predict({"inputs": {"x": [[0.0, 0.0, 1.0]]}})
+    np.testing.assert_allclose(out["predictions"], [[0.0, 0.0]])
+
+
+def test_infra_validator_http_canary(tmp_path):
+    from tpu_pipelines.components.infra_validator import _predict_over_http
+
+    payload = _export(tmp_path, "http_model")
+    preds = _predict_over_http(payload, {"x": np.eye(3, dtype=np.float32)})
+    np.testing.assert_allclose(preds, np.eye(3, 2, dtype=np.float32))
+
+
+def test_saved_model_export_roundtrip(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    from tpu_pipelines.serving.saved_model import export_saved_model
+
+    payload = _export(tmp_path, "sm_model")
+    out_dir = str(tmp_path / "saved_model")
+    example = {"x": np.ones((2, 3), np.float32)}
+    export_saved_model(payload, out_dir, example)
+
+    reloaded = tf.saved_model.load(out_dir)
+    fn = reloaded.signatures["serving_default"]
+    # different batch size than the example -> polymorphic batch dim works
+    x = np.asarray([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0], [9.0, 0.0, 0.0]],
+                   np.float32)
+    out = fn(x=tf.constant(x))
+    (val,) = out.values()
+    np.testing.assert_allclose(
+        np.asarray(val), x @ np.eye(3, 2, dtype=np.float32)
+    )

@@ -31,6 +31,11 @@ NOT_BLESSED_FILE = "NOT_BLESSED"
         "num_examples": Parameter(type=int, default=8),
         # Raw examples (apply embedded transform) vs pre-transformed.
         "raw_examples": Parameter(type=bool, default=True),
+        # "inprocess": load + call predict directly.  "http": boot the
+        # framework ModelServer on a loopback port and canary through the
+        # REST surface — the closest local equivalent of the reference's
+        # serving-container canary.
+        "serving_binary": Parameter(type=str, default="inprocess"),
     },
 )
 def InfraValidator(ctx):
@@ -40,14 +45,20 @@ def InfraValidator(ctx):
     split = ctx.exec_properties["split"]
     error = ""
     try:
-        loaded = load_exported_model(ctx.input("model").uri)
         data = examples_io.read_split(ctx.input("examples").uri, split)
         batch = {k: v[:n] for k, v in data.items()}
-        predict = (
-            loaded.predict if ctx.exec_properties["raw_examples"]
-            else loaded.predict_transformed
-        )
-        preds = np.asarray(predict(batch))
+        if ctx.exec_properties["serving_binary"] == "http":
+            preds = _predict_over_http(
+                ctx.input("model").uri, batch,
+                raw=ctx.exec_properties["raw_examples"],
+            )
+        else:
+            loaded = load_exported_model(ctx.input("model").uri)
+            predict = (
+                loaded.predict if ctx.exec_properties["raw_examples"]
+                else loaded.predict_transformed
+            )
+            preds = np.asarray(predict(batch))
         if len(preds) != len(next(iter(batch.values()))):
             error = f"prediction count {len(preds)} != batch size"
         elif not np.isfinite(np.asarray(preds, dtype=np.float64)).all():
@@ -62,3 +73,27 @@ def InfraValidator(ctx):
     if error:
         return {"blessed": False, "error": error}
     return {"blessed": True}
+
+
+def _predict_over_http(model_uri: str, batch, raw: bool = True) -> np.ndarray:
+    """Canary through the REST surface on a loopback port."""
+    import urllib.request
+
+    from tpu_pipelines.serving import ModelServer
+
+    server = ModelServer("canary", model_uri, raw=raw)
+    port = server.start()
+    try:
+        instances = [
+            {k: np.asarray(v[i]).tolist() for k, v in batch.items()}
+            for i in range(len(next(iter(batch.values()))))
+        ]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/canary:predict",
+            data=json.dumps({"instances": instances}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return np.asarray(json.load(r)["predictions"])
+    finally:
+        server.stop()

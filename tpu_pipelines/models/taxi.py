@@ -40,8 +40,11 @@ class WideAndDeep(nn.Module):
         deep_logit = nn.Dense(1, name="deep_head")(x)[..., 0]
 
         if self.wide_features:
+            # .shape[0] (not len()) keeps the batch dim symbolic-friendly
+            # for jax2tf polymorphic SavedModel export.
             wide = jnp.concatenate(
-                [jnp.asarray(batch[f], jnp.float32).reshape(len(deep_logit), -1)
+                [jnp.asarray(batch[f], jnp.float32)
+                 .reshape(deep_logit.shape[0], -1)
                  for f in self.wide_features],
                 axis=-1,
             )

@@ -1,0 +1,14 @@
+"""Serving: model server + export formats (the TF-Serving-shaped surface).
+
+SURVEY.md §3.5 / §2b TF Serving row: the reference serves Pusher output with
+TensorFlow Serving (C++ gRPC/REST, versioned model dirs).  Here:
+
+  - :class:`~tpu_pipelines.serving.server.ModelServer` — REST predict server
+    over the framework's self-contained model payloads, with TF-Serving's
+    version-dir convention (serves the highest numeric subdir, re-scans on
+    demand) and endpoint shapes (``/v1/models/<name>:predict``).
+  - ``tpu_pipelines.serving.saved_model`` — optional jax2tf SavedModel export
+    for interop with actual TF Serving deployments.
+"""
+
+from tpu_pipelines.serving.server import ModelServer  # noqa: F401

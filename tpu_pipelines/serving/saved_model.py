@@ -1,0 +1,74 @@
+"""SavedModel export: interop with real TF Serving deployments.
+
+SURVEY.md §3.5 / §7 hard part 2: the reference's Pusher ships SavedModels to
+TensorFlow Serving.  This exporter converts the payload's single jitted
+device computation (numeric transform fused with the model forward pass)
+through jax2tf into a SavedModel with a ``serving_default`` signature, with
+a symbolic batch dimension so the server can batch freely.
+
+The host string stage (tokenization, vocab lookup — numpy) is NOT inside the
+SavedModel; it runs in the client/ingestion tier, exactly as the framework's
+own server does (``LoadedModel.host_preprocess``).  For fully self-contained
+serving of raw strings, use the framework ModelServer instead.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+import numpy as np
+
+from tpu_pipelines.trainer.export import load_exported_model
+
+log = logging.getLogger("tpu_pipelines.serving")
+
+
+def export_saved_model(
+    model_uri: str,
+    out_dir: str,
+    example_batch: Dict[str, np.ndarray],
+    *,
+    polymorphic_batch: bool = True,
+) -> str:
+    """Convert an exported payload to a SavedModel; returns ``out_dir``.
+
+    ``example_batch``: raw features (any batch size) used to derive the
+    device-side input signature through the payload's own host stage.
+    """
+    import tensorflow as tf
+    from jax.experimental import jax2tf
+
+    loaded = load_exported_model(model_uri)
+    iface = {
+        k: np.asarray(v) for k, v in loaded.host_preprocess(example_batch).items()
+    }
+
+    if polymorphic_batch:
+        shapes = {
+            k: "(b, " + ", ".join(str(d) for d in v.shape[1:]) + ")"
+            if v.ndim > 1 else "(b,)"
+            for k, v in iface.items()
+        }
+        tf_fn = jax2tf.convert(
+            loaded.device_predict, polymorphic_shapes=[shapes],
+            with_gradient=False,
+        )
+        specs = {
+            k: tf.TensorSpec([None, *v.shape[1:]], v.dtype, name=k)
+            for k, v in iface.items()
+        }
+    else:
+        tf_fn = jax2tf.convert(loaded.device_predict, with_gradient=False)
+        specs = {
+            k: tf.TensorSpec(v.shape, v.dtype, name=k) for k, v in iface.items()
+        }
+
+    module = tf.Module()
+    module.fn = tf.function(tf_fn, input_signature=[specs])
+    tf.saved_model.save(
+        module, out_dir,
+        signatures={"serving_default": module.fn.get_concrete_function(specs)},
+    )
+    log.info("SavedModel written to %s (inputs: %s)", out_dir, sorted(specs))
+    return out_dir

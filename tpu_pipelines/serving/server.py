@@ -1,0 +1,181 @@
+"""REST model server over exported model payloads.
+
+The TF-Serving-equivalent serving path (SURVEY.md §3.5): Pusher copies a
+blessed payload into ``<base>/<version>/``; this server watches that layout,
+loads the highest version (preprocessing fused with the forward pass in one
+jitted function — trainer/export.py), and answers TF-Serving-style REST:
+
+    GET  /v1/models/<name>            -> version status
+    POST /v1/models/<name>:predict    -> {"predictions": [...]}
+         body: {"instances": [{feature: value, ...}, ...]}
+         or    {"inputs": {feature: [values...], ...}}
+
+Implementation is stdlib ``http.server`` with a thread pool of one — the
+jitted predict path is already batched and single-stream; this server exists
+for InfraValidator canaries, e2e tests, and small deployments.  High-QPS
+serving exports a SavedModel (serving/saved_model.py) into TF Serving.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from tpu_pipelines.trainer.export import LoadedModel, load_exported_model
+
+log = logging.getLogger("tpu_pipelines.serving")
+
+
+def latest_version_dir(base_dir: str) -> Optional[str]:
+    """Highest numeric subdirectory — the TF Serving version convention."""
+    if not os.path.isdir(base_dir):
+        return None
+    versions = [
+        d for d in os.listdir(base_dir)
+        if d.isdigit() and os.path.isdir(os.path.join(base_dir, d))
+    ]
+    if not versions:
+        return None
+    return os.path.join(base_dir, max(versions, key=int))
+
+
+class ModelServer:
+    """Serves one model name from a version-dir layout (or a flat payload).
+
+    ``raw=True`` (default) serves ``LoadedModel.predict`` (embedded transform
+    applied to raw features); ``raw=False`` serves ``predict_transformed``
+    for callers sending already-materialized features.
+    """
+
+    def __init__(self, model_name: str, base_dir: str, *, raw: bool = True):
+        self.model_name = model_name
+        self.base_dir = base_dir
+        self.raw = raw
+        self._lock = threading.Lock()
+        self._loaded: Optional[LoadedModel] = None
+        self._loaded_version: Optional[str] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.reload()
+
+    # ----------------------------------------------------------- lifecycle
+
+    def reload(self) -> str:
+        """(Re)load the newest version; returns the version string.
+
+        The (slow) load happens outside the predict lock; in-flight requests
+        keep answering on the old version until the reference swap.
+        """
+        vdir = latest_version_dir(self.base_dir)
+        if vdir is None:
+            # flat layout: base_dir IS the payload
+            if os.path.exists(os.path.join(self.base_dir, "model_spec.json")):
+                vdir = self.base_dir
+            else:
+                raise FileNotFoundError(
+                    f"no model versions under {self.base_dir!r}"
+                )
+        version = os.path.basename(vdir.rstrip("/"))
+        if version == self._loaded_version:
+            return version
+        loaded = load_exported_model(vdir)
+        with self._lock:
+            self._loaded = loaded
+            self._loaded_version = version
+        log.info("loaded %s version %s", self.model_name, version)
+        return version
+
+    @property
+    def version(self) -> Optional[str]:
+        return self._loaded_version
+
+    # ------------------------------------------------------------- predict
+
+    def predict(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """TF-Serving REST semantics: 'instances' (row) or 'inputs' (column)."""
+        with self._lock:
+            loaded = self._loaded
+        if loaded is None:
+            raise RuntimeError("no model loaded")
+        if "instances" in payload:
+            rows = payload["instances"]
+            if not rows:
+                return {"predictions": []}
+            batch = {
+                k: np.asarray([r[k] for r in rows])
+                for k in rows[0]
+            }
+        elif "inputs" in payload:
+            batch = {k: np.asarray(v) for k, v in payload["inputs"].items()}
+        else:
+            raise ValueError("request needs 'instances' or 'inputs'")
+        predict = loaded.predict if self.raw else loaded.predict_transformed
+        preds = np.asarray(predict(batch))
+        return {"predictions": preds.tolist()}
+
+    # ---------------------------------------------------------------- HTTP
+
+    def start(self, port: int = 0, host: str = "127.0.0.1") -> int:
+        """Serve in a background thread; returns the bound port."""
+        if self._httpd is not None:
+            raise RuntimeError(
+                f"server for {self.model_name!r} already running on port "
+                f"{self._httpd.server_address[1]}; call stop() first"
+            )
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route to logging, not stderr
+                log.debug("http: " + fmt, *args)
+
+            def _reply(self, code: int, obj: Dict[str, Any]) -> None:
+                body = json.dumps(obj).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == f"/v1/models/{server.model_name}":
+                    self._reply(200, {
+                        "model_version_status": [{
+                            "version": server.version,
+                            "state": "AVAILABLE",
+                        }],
+                    })
+                else:
+                    self._reply(404, {"error": f"unknown path {self.path}"})
+
+            def do_POST(self):
+                if self.path != f"/v1/models/{server.model_name}:predict":
+                    self._reply(404, {"error": f"unknown path {self.path}"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    self._reply(200, server.predict(payload))
+                except Exception as e:
+                    self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -83,6 +83,12 @@ class LoadedModel:
     transform: Optional[TransformGraph]
     predict: Callable[[Dict[str, np.ndarray]], Any]
     predict_transformed: Callable[[Dict[str, np.ndarray]], Any]
+    # The two halves of `predict`, exposed for exporters (serving/
+    # saved_model.py): host string stage (numpy, identity when no transform)
+    # and the single jitted device computation (numeric transform fused with
+    # the forward pass).
+    host_preprocess: Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]] = None
+    device_predict: Callable[[Dict[str, Any]], Any] = None
 
 
 def load_exported_model(uri: str) -> LoadedModel:
@@ -130,9 +136,13 @@ def load_exported_model(uri: str) -> LoadedModel:
 
         def predict(raw_batch: Dict[str, np.ndarray]):
             return _transform_and_forward(host_fn(raw_batch))
+
+        host_preprocess, device_predict = host_fn, _transform_and_forward
     else:
         def predict(raw_batch: Dict[str, np.ndarray]):
             return _forward(raw_batch)
+
+        host_preprocess, device_predict = (lambda b: b), _forward
 
     return LoadedModel(
         params=params,
@@ -141,4 +151,6 @@ def load_exported_model(uri: str) -> LoadedModel:
         transform=transform,
         predict=predict,
         predict_transformed=_forward,
+        host_preprocess=host_preprocess,
+        device_predict=device_predict,
     )
