@@ -47,9 +47,14 @@ class MlpBlock(nn.Module):
 class MultiHeadAttention(nn.Module):
     """Self/cross attention; TP over heads, optional ring SP over sequence.
 
-    ``attn_impl``: "dense" or "ring".  Ring requires self-attention (q and kv
-    the same length/sharding) and no additive bias; cross-attention and
-    biased attention (T5 relative positions) always take the dense path.
+    ``attn_impl``:
+      - "dense": plain XLA attention (any mask/bias/cross).
+      - "ring":  sequence-parallel ring attention over the mesh ``seq`` axis.
+      - "flash": the Pallas blockwise kernel (ops/flash_attention.py) — the
+        single-chip hot path; no O(L²) score tensor in HBM.
+    Ring/flash require self-attention without an additive bias; cross
+    attention and biased attention (T5 relative positions) always take the
+    dense path.
     """
 
     n_heads: int
@@ -86,9 +91,18 @@ class MultiHeadAttention(nn.Module):
             and self.mesh is not None
             and self.mesh.shape.get("seq", 1) > 1
         )
+        use_flash = (
+            self.attn_impl == "flash" and is_self and bias is None
+        )
         if use_ring:
             out = ring_attention(
                 q, k, v, mesh=self.mesh, causal=self.causal, kv_mask=kv_mask
+            )
+        elif use_flash:
+            from tpu_pipelines.ops.flash_attention import flash_attention
+
+            out = flash_attention(
+                q, k, v, causal=self.causal, kv_mask=kv_mask
             )
         else:
             out = dense_attention(

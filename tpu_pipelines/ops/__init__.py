@@ -1,0 +1,9 @@
+"""Pallas TPU kernels for hot ops (SURVEY.md §7: the compute path).
+
+XLA fuses most of the framework's elementwise/matmul work on its own; the
+kernels here cover the cases where hand-tiling beats the compiler —
+flash attention keeps the O(L²) score matrix out of HBM entirely by
+accumulating the softmax online in VMEM.
+"""
+
+from tpu_pipelines.ops.flash_attention import flash_attention  # noqa: F401

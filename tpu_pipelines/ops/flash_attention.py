@@ -1,0 +1,183 @@
+"""Flash attention: Pallas TPU kernel for the dense-attention hot path.
+
+Blockwise online-softmax attention (the flash-attention recurrence): the
+kernel streams K/V blocks through VMEM against one Q block, carrying the
+running max/denominator/accumulator — the [L, L] score matrix never
+materializes in HBM, so memory is O(block_q · block_k) instead of O(L²) and
+the two matmuls per block land on the MXU back to back.
+
+Scope: forward pass as a kernel; the backward pass recomputes attention with
+the standard XLA ops (``jax.custom_vjp`` below) — activation memory still
+drops because no O(L²) tensor is saved as a residual, which is where the
+flash trick pays on TPU.  Used by models/transformer.py when
+``attn_impl="flash"``; ring attention (parallel/ring_attention.py) handles
+the sequence-parallel regime and composes the same math across chips.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, block_k: int,
+                  causal: bool, block_q: int, scale: float):
+    """One (batch*head, q-block) grid cell: stream all K/V blocks."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale            # [bq, d]
+    seq_len = k_ref.shape[1]
+    n_kv = seq_len // block_k
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(                        # [bq, bk] on the MXU
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # mask is [b*h, 1, l]: the (1, 1, l) block equals the array's last
+        # two dims, satisfying TPU tiling, with no dynamic sublane index.
+        kmask = mask_ref[0, 0, pl.ds(j * block_k, block_k)]
+        s = jnp.where(kmask[None, :] > 0, s, NEG_INF)
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        s_max = jnp.max(s, axis=1)                      # [bq]
+        m_new = jnp.maximum(m, s_max)
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(s > NEG_INF * 0.5, p, 0.0)        # fully-masked blocks
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc_new, m_new, l_new
+
+    d = q_ref.shape[-1]
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    if causal:
+        # blocks strictly above the diagonal contribute nothing; stop early
+        n_used = jnp.minimum(n_kv, (qi + 1) * block_q // block_k + 1)
+    else:
+        n_used = n_kv
+    acc, m, l = jax.lax.fori_loop(0, n_used, body, (acc0, m0, l0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, kv_mask, *, causal, block_q, block_k, interpret):
+    b, l, h, d = q.shape
+    scale = d ** -0.5
+    # [b, l, h, d] -> [b*h, l, d]: one grid row per (batch, head)
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, l, d)
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    maskf = jnp.repeat(kv_mask, h, axis=0)[:, None, :]  # [b*h, 1, l]
+
+    grid = (b * h, l // block_q)
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel, block_k=block_k, causal=causal,
+            block_q=block_q, scale=scale,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, l, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, l, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, l), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, l, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, maskf)
+    return out.reshape(b, h, l, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, kv_mask, causal, block_q, block_k, interpret):
+    return _flash_forward(
+        q, k, v, kv_mask, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+
+
+def _flash_fwd(q, k, v, kv_mask, causal, block_q, block_k, interpret):
+    out = _flash_forward(
+        q, k, v, kv_mask, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return out, (q, k, v, kv_mask)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
+    # Recompute-based backward: XLA re-derives attention and differentiates;
+    # nothing O(L²) was saved from the forward.
+    from tpu_pipelines.parallel.ring_attention import dense_attention
+
+    q, k, v, kv_mask = residuals
+
+    def ref(q, k, v):
+        return dense_attention(q, k, v, causal=causal, kv_mask=kv_mask)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    dq, dk, dv = vjp(g)
+    # int mask gets a float0 cotangent (JAX's "no gradient" for int inputs)
+    import numpy as np
+
+    dmask = np.zeros(kv_mask.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dmask
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    kv_mask: Optional[jnp.ndarray] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Self-attention over [batch, len, heads, head_dim] via the kernel.
+
+    Numerically equals ``dense_attention`` (same masking semantics, modulo
+    rows whose whole allowed key set is empty: dense leaves them uniform,
+    flash leaves them zero).  Falls back to dense when the sequence length
+    doesn't tile into (block_q, block_k).  ``interpret=None`` auto-selects
+    the Pallas interpreter off-TPU (CPU tests/dry runs).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, l, h, d = q.shape
+    block_q = min(block_q, l)
+    block_k = min(block_k, l)
+    if l % block_q or l % block_k:
+        from tpu_pipelines.parallel.ring_attention import dense_attention
+
+        return dense_attention(q, k, v, causal=causal, kv_mask=kv_mask)
+    if kv_mask is None:
+        kv_mask = jnp.ones((b, l), jnp.int32)
+    return _flash(
+        q, k, v, jnp.asarray(kv_mask, jnp.int32), causal, block_q, block_k,
+        interpret,
+    )
