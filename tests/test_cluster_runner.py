@@ -145,6 +145,61 @@ def test_run_node_entrypoint_executes_single_node(tmp_path):
     assert found, "trained model artifact missing"
 
 
+def test_multihost_run_node_shares_output_dir(tmp_path):
+    """Two run_node workers on one Trainer node must resolve the SAME output
+    uri (execution id broadcast from process 0) and both write into the shared
+    pipeline root — the orbax-collective-save contract."""
+    mod = _pipeline_module(tmp_path)
+    # Trainer run_fn that records which process wrote, in the shared dir.
+    (tmp_path / "toy_trainer.py").write_text(textwrap.dedent("""
+        import os
+        import jax
+        from tpu_pipelines.trainer.fn_args import TrainResult
+        def run_fn(fn_args):
+            os.makedirs(fn_args.serving_model_dir, exist_ok=True)
+            pid = jax.process_index()
+            with open(os.path.join(fn_args.serving_model_dir,
+                                   f"ok_{pid}"), "w") as f:
+                f.write("trained")
+            return TrainResult(final_metrics={"loss": 0.1}, steps_completed=1)
+    """))
+    env = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"}
+    for node in ["CsvExampleGen", "StatisticsGen", "SchemaGen"]:
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_pipelines.run_node",
+             "--pipeline-module", mod, "--node-id", node],
+            env=env, capture_output=True, text=True, timeout=240,
+        )
+        assert proc.returncode == 0, f"{node}: {proc.stderr[-2000:]}"
+    procs = []
+    for pid in range(2):
+        wenv = {
+            **os.environ, "PYTHONPATH": REPO,
+            "TPP_COORDINATOR_ADDRESS": "localhost:9937",
+            "TPP_NUM_PROCESSES": "2",
+            "TPP_PROCESS_ID": str(pid),
+        }
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "tpu_pipelines.run_node",
+             "--pipeline-module", mod, "--node-id", "Trainer",
+             "--cpu-devices-per-process", "2"],
+            env=wenv, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        ))
+    for pid, proc in enumerate(procs):
+        _, err = proc.communicate(timeout=240)
+        assert proc.returncode == 0, f"worker {pid}: {err[-2000:]}"
+    # Both processes wrote into ONE shared model dir under the real root.
+    dirs = set()
+    for dirpath, _, files in os.walk(tmp_path / "root"):
+        for f in files:
+            if f.startswith("ok_"):
+                dirs.add(dirpath)
+    assert len(dirs) == 1, f"expected one shared model dir, got {dirs}"
+    files = set(os.listdir(next(iter(dirs))))
+    assert {"ok_0", "ok_1"} <= files, files
+
+
 def test_multihost_bootstrap_two_processes(tmp_path):
     """Two subprocesses join one coordination service and run a global psum
     over a 2-host x 2-device CPU mesh — TFJob multi-worker without a cluster."""

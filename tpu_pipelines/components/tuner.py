@@ -174,10 +174,17 @@ def Tuner(ctx):
 
     os.makedirs(out.uri, exist_ok=True)
     best = {**base_hp, **candidates[best_idx]}
-    with open(os.path.join(out.uri, BEST_FILE), "w") as f:
-        json.dump(best, f, indent=2, sort_keys=True, default=str)
-    with open(os.path.join(out.uri, TRIALS_FILE), "w") as f:
-        json.dump(trials, f, indent=2, sort_keys=True, default=str)
+    # Multi-host: every process ran the trials (SPMD), but these plain-file
+    # writes land in the shared output dir — process 0 only.  jax is already
+    # live here (the trials trained), so ask the backend, which also covers
+    # users who initialized jax.distributed without the TPP_* env vars.
+    import jax
+
+    if jax.process_index() == 0:
+        with open(os.path.join(out.uri, BEST_FILE), "w") as f:
+            json.dump(best, f, indent=2, sort_keys=True, default=str)
+        with open(os.path.join(out.uri, TRIALS_FILE), "w") as f:
+            json.dump(trials, f, indent=2, sort_keys=True, default=str)
     out.properties["num_trials"] = len(trials)
     out.properties["best_trial"] = best_idx
     out.properties["best_score"] = best_score

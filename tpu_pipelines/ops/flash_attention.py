@@ -71,8 +71,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, block_k: int,
     m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
     if causal:
-        # blocks strictly above the diagonal contribute nothing; stop early
-        n_used = jnp.minimum(n_kv, (qi + 1) * block_q // block_k + 1)
+        # Stop after the KV block containing the last allowed key position,
+        # key index (qi+1)*block_q - 1 — blocks past it are fully masked.
+        n_used = jnp.minimum(n_kv, ((qi + 1) * block_q - 1) // block_k + 1)
     else:
         n_used = n_kv
     acc, m, l = jax.lax.fori_loop(0, n_used, body, (acc0, m0, l0))

@@ -28,11 +28,17 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import re
 from typing import Any, Dict, List, Optional
 
-import yaml
 
-import re
+def _yaml():
+    # Lazy: pyyaml is only needed on the compile-to-manifests path, so the
+    # local runner (and run_node, the container entrypoint) must not require
+    # it at import time.
+    import yaml
+
+    return yaml
 
 from tpu_pipelines.dsl.compiler import Compiler, PipelineIR
 from tpu_pipelines.dsl.pipeline import Pipeline
@@ -67,6 +73,15 @@ class TPUJobRunnerConfig:
     namespace: str = "default"
     service_account: str = ""
     workflow_name: str = ""                 # defaults to pipeline name
+    # Shared storage for pipeline_root + the metadata sqlite.  Cross-pod
+    # semantics (artifact URIs, run_node's shared-store precondition, orbax
+    # collective saves) require every pod to see one filesystem: set
+    # ``shared_volume_claim`` to a ReadWriteMany PVC name (NFS/Filestore) and
+    # it is mounted at ``shared_mount_path`` in every container; leave it
+    # empty only when the image itself provides shared storage at the
+    # pipeline's paths (e.g. a GCS FUSE sidecar or bucket mount).
+    shared_volume_claim: str = ""
+    shared_mount_path: str = "/pipeline"
 
 
 class TPUJobRunner:
@@ -88,7 +103,7 @@ class TPUJobRunner:
 
         wf_path = os.path.join(cfg.output_dir, "workflow.yaml")
         with open(wf_path, "w") as f:
-            yaml.safe_dump(self._workflow(ir), f, sort_keys=True)
+            _yaml().safe_dump(self._workflow(ir), f, sort_keys=True)
         out["workflow"] = wf_path
 
         for node in ir.nodes:
@@ -97,7 +112,9 @@ class TPUJobRunner:
                     cfg.output_dir, f"jobset_{k8s_name(node.id)}.yaml"
                 )
                 with open(js_path, "w") as f:
-                    yaml.safe_dump(self._jobset(ir, node.id), f, sort_keys=True)
+                    _yaml().safe_dump(
+                        self._jobset(ir, node.id), f, sort_keys=True
+                    )
                 out[f"jobset_{node.id}"] = js_path
         return out
 
@@ -147,7 +164,7 @@ class TPUJobRunner:
                     "setOwnerReference": True,
                     "successCondition": "status.terminalState == Completed",
                     "failureCondition": "status.terminalState == Failed",
-                    "manifest": yaml.safe_dump(jobset, sort_keys=True),
+                    "manifest": _yaml().safe_dump(jobset, sort_keys=True),
                 }
             else:
                 tpl["container"] = {
@@ -155,6 +172,8 @@ class TPUJobRunner:
                     "command": self._node_command(node.id),
                     "resources": self._node_resources(node.component_type),
                 }
+                if cfg.shared_volume_claim:
+                    tpl["container"]["volumeMounts"] = self._volume_mounts()
                 if self._is_tpu_node(node.component_type):
                     tpl["nodeSelector"] = self._tpu_node_selector()
             templates.append(tpl)
@@ -162,6 +181,8 @@ class TPUJobRunner:
             "entrypoint": "pipeline-dag",
             "templates": templates,
         }
+        if cfg.shared_volume_claim:
+            spec["volumes"] = self._volumes()
         if cfg.service_account:
             spec["serviceAccountName"] = cfg.service_account
         return {
@@ -199,6 +220,16 @@ class TPUJobRunner:
             },
             "ports": [{"containerPort": DEFAULT_PORT}],
         }
+        if cfg.shared_volume_claim:
+            container["volumeMounts"] = self._volume_mounts()
+        pod_spec: Dict[str, Any] = {
+            "subdomain": name,
+            "restartPolicy": "Never",
+            "nodeSelector": self._tpu_node_selector(),
+            "containers": [container],
+        }
+        if cfg.shared_volume_claim:
+            pod_spec["volumes"] = self._volumes()
         return {
             "apiVersion": "jobset.x-k8s.io/v1alpha2",
             "kind": "JobSet",
@@ -220,19 +251,26 @@ class TPUJobRunner:
                             "completions": cfg.num_hosts,
                             "completionMode": "Indexed",
                             "backoffLimit": 0,
-                            "template": {
-                                "spec": {
-                                    "subdomain": name,
-                                    "restartPolicy": "Never",
-                                    "nodeSelector": self._tpu_node_selector(),
-                                    "containers": [container],
-                                },
-                            },
+                            "template": {"spec": pod_spec},
                         },
                     },
                 }],
             },
         }
+
+    def _volumes(self) -> List[Dict[str, Any]]:
+        return [{
+            "name": "pipeline-shared",
+            "persistentVolumeClaim": {
+                "claimName": self.config.shared_volume_claim,
+            },
+        }]
+
+    def _volume_mounts(self) -> List[Dict[str, str]]:
+        return [{
+            "name": "pipeline-shared",
+            "mountPath": self.config.shared_mount_path,
+        }]
 
     def _tpu_node_selector(self) -> Dict[str, str]:
         return {

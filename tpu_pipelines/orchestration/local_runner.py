@@ -49,6 +49,71 @@ from tpu_pipelines.utils.fingerprint import execution_cache_key, fingerprint_dir
 log = logging.getLogger("tpu_pipelines.runner")
 
 
+def _spmd_broadcast_int(value: int) -> int:
+    """Broadcast a small int from process 0 to all processes (collective)."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    return int(multihost_utils.broadcast_one_to_all(np.int32(value)))
+
+
+def _spmd_broadcast_json(obj: Any) -> Any:
+    """Broadcast a JSON-serializable value from process 0 (two collectives:
+    length, then padded payload — workers don't know the size up front)."""
+    import json as _json
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    data = np.frombuffer(_json.dumps(obj).encode(), np.uint8)
+    n = _spmd_broadcast_int(data.size)
+    buf = np.zeros(n, np.uint8)
+    buf[: min(n, data.size)] = data[:n]
+    out = multihost_utils.broadcast_one_to_all(buf)
+    return _json.loads(np.asarray(out).tobytes().decode())
+
+
+def _spmd_sync_inputs(
+    inputs: Dict[str, List[Artifact]],
+) -> Dict[str, List[Artifact]]:
+    """Replace every process's resolved inputs with process 0's.
+
+    Input resolution reads the metadata store, and workers hold a
+    point-in-time snapshot of it — a concurrent run publishing a newer
+    upstream execution between the snapshot and process 0's read would
+    otherwise feed different hosts different artifact URIs for the same
+    training step (silently mixed datasets).
+    """
+    payload = {
+        key: [
+            {
+                "type_name": a.type_name,
+                "uri": a.uri,
+                "id": a.id,
+                "fingerprint": a.fingerprint,
+                "properties": a.properties,
+            }
+            for a in arts
+        ]
+        for key, arts in inputs.items()
+    }
+    synced = _spmd_broadcast_json(payload)
+    return {
+        key: [
+            Artifact(
+                type_name=d["type_name"],
+                uri=d["uri"],
+                id=d["id"],
+                state=ArtifactState.LIVE,
+                properties=d["properties"],
+                fingerprint=d["fingerprint"],
+            )
+            for d in arts
+        ]
+        for key, arts in synced.items()
+    }
+
+
 class PipelineRunError(RuntimeError):
     def __init__(self, message: str, result: "RunResult"):
         super().__init__(message)
@@ -92,8 +157,23 @@ class LocalDagRunner:
     their output artifact uris and tmp dir, so a retry starts clean.
     """
 
-    def __init__(self, max_retries: int = 0):
+    def __init__(self, max_retries: int = 0, spmd_sync: bool = False):
         self.max_retries = max_retries
+        # Multi-host SPMD mode (run_node with a live coordination service):
+        # workers execute against a point-in-time snapshot of the shared
+        # metadata sqlite, so two store-derived decisions could diverge from
+        # process 0's — the cache verdict, and the execution id embedded in
+        # output URIs.  With spmd_sync, both are broadcast from process 0 so
+        # every process takes the same branch and writes the same URIs
+        # (orbax collective saves require a single shared directory).
+        self.spmd_sync = spmd_sync
+        if spmd_sync and max_retries:
+            raise ValueError(
+                "spmd_sync is incompatible with in-runner retries: process 0's"
+                " clean-slate wipe would race workers still in the previous"
+                " attempt; use substrate-level retries (Argo retryStrategy /"
+                " JobSet backoff) instead"
+            )
 
     def run(
         self,
@@ -128,6 +208,15 @@ class LocalDagRunner:
         store.put_context(run_ctx)
 
         selected = self._select_nodes(ir, from_nodes, to_nodes)
+        if self.spmd_sync and len(selected) != 1:
+            # Per-node collective counts must be identical on every process;
+            # the failed-upstream skip path performs none, so a multi-node
+            # run with divergent node outcomes would deadlock peers at the
+            # next node's broadcast.  Cluster mode runs one node per pod.
+            raise ValueError(
+                "spmd_sync requires a single-node partial run "
+                f"(from_nodes=to_nodes=[node]); selected {sorted(selected)}"
+            )
         result = RunResult(pipeline_name=pipeline.name, run_id=run_id)
         # node_id -> {output_key: [Artifact]} for this run's input resolution.
         produced: Dict[str, Dict[str, List[Artifact]]] = {}
@@ -259,12 +348,24 @@ class LocalDagRunner:
         all_ctx = contexts + [node_ctx]
 
         # ---- DRIVER: resolve inputs + cache check
+        resolve_error = ""
         try:
             inputs = self._resolve_inputs(node, produced)
         except KeyError as e:
+            inputs = {}
+            resolve_error = f"input resolution failed: {e}"
+        if self.spmd_sync:
+            # Process 0's resolution is authoritative: a worker that failed
+            # (or resolved differently) against its store snapshot adopts
+            # process 0's artifacts; if process 0 failed, everyone fails.
+            if _spmd_broadcast_int(0 if resolve_error else 1):
+                inputs = _spmd_sync_inputs(inputs)
+                resolve_error = ""
+            elif not resolve_error:
+                resolve_error = "input resolution failed on process 0"
+        if resolve_error:
             return NodeResult(
-                node_id=node.id, status="FAILED",
-                error=f"input resolution failed: {e}",
+                node_id=node.id, status="FAILED", error=resolve_error,
             )
         props = {
             k: resolve_property(v, runtime_parameters)
@@ -285,25 +386,44 @@ class LocalDagRunner:
             node.id, node.executor_version, props, input_fps
         )
 
-        if enable_cache:
-            cached = store.get_cached_outputs(cache_key)
-            if cached is not None:
-                ex = Execution(
-                    type_name=node.component_type,
-                    node_id=node.id,
-                    state=ExecutionState.CACHED,
-                    properties={"cache_hit": True},
-                    cache_key=cache_key,
+        cached = store.get_cached_outputs(cache_key) if enable_cache else None
+        if self.spmd_sync:
+            # Collective: every process learns process 0's cache verdict so
+            # none executes (and blocks in jit collectives) while process 0
+            # takes the cached shortcut.  A worker's snapshot is a subset of
+            # the live store, so worker-hit ⇒ process-0-hit; the reverse gap
+            # (process 0 sees an entry published after the snapshot) is the
+            # case handled here.
+            hit = _spmd_broadcast_int(1 if cached is not None else 0)
+            if hit and cached is None:
+                log.info(
+                    "node %s: process 0 reported a cache hit not in this "
+                    "worker's snapshot; skipping execution", node.id,
                 )
-                store.publish_execution(ex, inputs, cached, all_ctx)
-                log.info("node %s: cache hit (execution %d)", node.id, ex.id)
                 return NodeResult(
                     node_id=node.id,
                     status="CACHED",
-                    execution_id=ex.id,
-                    outputs=cached,
                     wall_clock_s=time.time() - t0,
                 )
+            if not hit:
+                cached = None
+        if cached is not None:
+            ex = Execution(
+                type_name=node.component_type,
+                node_id=node.id,
+                state=ExecutionState.CACHED,
+                properties={"cache_hit": True},
+                cache_key=cache_key,
+            )
+            store.publish_execution(ex, inputs, cached, all_ctx)
+            log.info("node %s: cache hit (execution %d)", node.id, ex.id)
+            return NodeResult(
+                node_id=node.id,
+                status="CACHED",
+                execution_id=ex.id,
+                outputs=cached,
+                wall_clock_s=time.time() - t0,
+            )
 
         # ---- LAUNCHER: register execution, allocate outputs, run executor
         ex = Execution(
@@ -315,9 +435,26 @@ class LocalDagRunner:
         )
         store.put_execution(ex)
 
+        # Output URIs embed the execution id; under spmd_sync process 0's id
+        # is authoritative so all processes write one shared directory tree.
+        # Process 0 wipes any stale dir BEFORE the broadcast barrier releases
+        # the workers — afterwards nobody may delete under the shared URIs.
+        if self.spmd_sync:
+            import jax
+
+            if jax.process_index() == 0:
+                for key in node.outputs:
+                    stale = os.path.join(
+                        ir.pipeline_root, node.id, key, str(ex.id)
+                    )
+                    if os.path.isdir(stale):
+                        shutil.rmtree(stale)
+            uri_ex_id = _spmd_broadcast_int(ex.id)
+        else:
+            uri_ex_id = ex.id
         outputs: Dict[str, List[Artifact]] = {}
         for key, type_name in node.outputs.items():
-            uri = os.path.join(ir.pipeline_root, node.id, key, str(ex.id))
+            uri = os.path.join(ir.pipeline_root, node.id, key, str(uri_ex_id))
             outputs[key] = [Artifact(type_name=type_name, uri=uri)]
 
         error = ""
@@ -333,7 +470,9 @@ class LocalDagRunner:
                 try:
                     for arts in outputs.values():
                         for a in arts:
-                            if os.path.isdir(a.uri):
+                            # spmd_sync: shared dirs were wiped pre-barrier;
+                            # deleting here would race other processes.
+                            if not self.spmd_sync and os.path.isdir(a.uri):
                                 shutil.rmtree(a.uri)  # clean slate on retry
                             os.makedirs(a.uri, exist_ok=True)
                     ctx = ExecutorContext(
@@ -356,6 +495,34 @@ class LocalDagRunner:
                     )
                 finally:
                     shutil.rmtree(tmp, ignore_errors=True)
+
+        if self.spmd_sync:
+            # Collective status exchange, which is also the barrier ensuring
+            # all executor-side writes land before process 0 fingerprints the
+            # shared output dirs.  Any process's failure fails the node
+            # everywhere — otherwise process 0 would publish COMPLETE (and a
+            # cache entry) over an output a worker never finished, and the
+            # substrate's retry would then hit that poisoned cache forever.
+            import jax
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            failures = multihost_utils.process_allgather(
+                np.int32(1 if error else 0)
+            )
+            failed_on = [int(i) for i in np.flatnonzero(np.asarray(failures))]
+            if failed_on and not error:
+                error = f"executor failed on process(es) {failed_on}"
+            if jax.process_index() != 0:
+                # Workers' store writes are scratch-discarded; skip the
+                # (potentially expensive) fingerprint + publish entirely.
+                return NodeResult(
+                    node_id=node.id,
+                    status="FAILED" if error else "COMPLETE",
+                    error=error,
+                    wall_clock_s=time.time() - t0,
+                    retries=attempts - 1,
+                )
 
         # ---- PUBLISHER
         wall = time.time() - t0

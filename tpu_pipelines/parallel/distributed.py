@@ -64,6 +64,16 @@ class DistributedConfig:
         }
 
 
+def local_process_id(env=os.environ) -> int:
+    """This host's process id in a multi-host run; 0 for single-process.
+
+    Reads only the TPP_*/JobSet env vars — safe to call from code that must
+    not import jax (e.g. the metadata-plane parts of the local runner).
+    """
+    cfg = DistributedConfig.from_env(env)
+    return 0 if cfg is None else cfg.process_id
+
+
 def maybe_initialize_from_env(
     *, cpu_devices_per_process: int = 0, env=os.environ
 ) -> Optional[DistributedConfig]:

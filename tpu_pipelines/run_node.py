@@ -42,7 +42,16 @@ def main(argv=None) -> int:
     if dist is not None and dist.process_id != 0:
         # SPMD workers all execute the node's computation, but only process 0
         # publishes to the shared metadata store (single-writer discipline,
-        # same as TF_CONFIG "chief"); peers work on a scratch copy.
+        # same as TF_CONFIG "chief"); peers work on a scratch copy of the
+        # sqlite ONLY.  pipeline_root stays the real shared directory on every
+        # worker: orbax multi-process save is a collective where each process
+        # writes the param shards it owns into the same checkpoint dir, so
+        # redirecting workers to scratch would silently drop the shards owned
+        # by workers 1..N whenever params are model/seq-sharded.  Non-collective
+        # artifact writes are process-0-guarded at the write sites
+        # (trainer/export.py, components/tuner.py); store-derived decisions
+        # that could diverge between the snapshot and the live store are
+        # broadcast from process 0 (LocalDagRunner spmd_sync).
         import os
         import shutil
         import tempfile
@@ -57,10 +66,18 @@ def main(argv=None) -> int:
         scratch_md = f"{scratch}/metadata.sqlite"
         shutil.copyfile(pipeline.metadata_path, scratch_md)
         pipeline.metadata_path = scratch_md
-        # Output artifacts too: only process 0 writes the real pipeline root.
-        pipeline.pipeline_root = f"{scratch}/root"
 
-    runner = LocalDagRunner(max_retries=args.max_retries)
+    if dist is not None and args.max_retries:
+        # In-runner retries are unsafe across SPMD processes (a fast-failing
+        # process would wipe/retry while peers are mid-attempt); the substrate
+        # (Argo retryStrategy / JobSet backoff) owns retries in cluster mode.
+        logging.getLogger(__name__).warning(
+            "ignoring --max-retries=%d in multi-host mode", args.max_retries
+        )
+    runner = LocalDagRunner(
+        max_retries=0 if dist is not None else args.max_retries,
+        spmd_sync=dist is not None,
+    )
     result = runner.run(
         pipeline,
         run_id=args.run_id,

@@ -46,32 +46,43 @@ def export_model(
     transform_graph_uri: str = "",
     extra_spec: Optional[Dict[str, Any]] = None,
 ) -> str:
-    """Write a self-contained model payload; returns the dir."""
+    """Write a self-contained model payload; returns the dir.
+
+    Multi-host safe: the orbax save is a collective every process joins
+    (each writes the param shards it owns into the shared dir); all other
+    writes are plain files and happen on process 0 only.
+    """
     os.makedirs(serving_model_dir, exist_ok=True)
     import orbax.checkpoint as ocp
 
+    primary = jax.process_index() == 0
     ckpt_path = os.path.abspath(os.path.join(serving_model_dir, CHECKPOINT_DIR))
-    if os.path.exists(ckpt_path):
+    if primary and os.path.exists(ckpt_path):
         shutil.rmtree(ckpt_path)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("export_model:pre_save")
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(ckpt_path, params)
 
-    shutil.copyfile(
-        module_file, os.path.join(serving_model_dir, MODULE_COPY)
-    )
-    if transform_graph_uri:
-        dst = os.path.join(serving_model_dir, TRANSFORM_DIR)
-        if os.path.exists(dst):
-            shutil.rmtree(dst)
-        shutil.copytree(transform_graph_uri, dst)
-    spec = {
-        "format": FORMAT_VERSION,
-        "hyperparameters": hyperparameters or {},
-        "has_transform": bool(transform_graph_uri),
-        **(extra_spec or {}),
-    }
-    with open(os.path.join(serving_model_dir, SPEC_FILE), "w") as f:
-        json.dump(spec, f, indent=2, sort_keys=True, default=str)
+    if primary:
+        shutil.copyfile(
+            module_file, os.path.join(serving_model_dir, MODULE_COPY)
+        )
+        if transform_graph_uri:
+            dst = os.path.join(serving_model_dir, TRANSFORM_DIR)
+            if os.path.exists(dst):
+                shutil.rmtree(dst)
+            shutil.copytree(transform_graph_uri, dst)
+        spec = {
+            "format": FORMAT_VERSION,
+            "hyperparameters": hyperparameters or {},
+            "has_transform": bool(transform_graph_uri),
+            **(extra_spec or {}),
+        }
+        with open(os.path.join(serving_model_dir, SPEC_FILE), "w") as f:
+            json.dump(spec, f, indent=2, sort_keys=True, default=str)
     return serving_model_dir
 
 
