@@ -1,15 +1,29 @@
-"""Benchmark: Trainer examples/sec/chip on the flagship pipeline model.
+"""Benchmark: flagship BERT-base fine-tune throughput + MFU on one chip.
 
 Run by the driver on real TPU hardware at the end of each round; prints ONE
-JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}.
 
-The metric is BASELINE.json's headline ("TFX Trainer examples/sec/chip") —
-the framework train loop's steady-state throughput on the taxi wide-and-deep
-workload, timed after compile.  The reference publishes no numbers
-(BASELINE.json "published": {}), so vs_baseline is measured against the
-first recorded run of this benchmark (BENCH_SELF_BASELINE.json, committed in
-round 1) — i.e. it tracks speedups of this framework over its own round-1
-state; 1.0 on the round that creates the baseline.
+Primary metric (BASELINE.json north star, "TFX Trainer examples/sec/chip"):
+steady-state examples/sec/chip of the framework train loop on BERT-base
+(seq 128 classification fine-tune, the reference's configs[3] workload),
+timed after compile.  ``vs_baseline`` is the ratio against a published-band
+A100 reference for the same workload (the north star is ">=90% of A100
+examples/sec", i.e. vs_baseline >= 0.9):
+
+    A100 BERT-base fine-tune at seq 128 with mixed precision lands in the
+    1-2k examples/sec band (NVIDIA DeepLearningExamples BERT-base SQuAD/
+    classification numbers); we take 1500 ex/s as the reference point.
+
+Also reported:
+  - ``mfu``: model-flops utilization — analytic train FLOPs per step
+    (6 * matmul_params * tokens, plus the attention score/value matmuls
+    which the 6NT rule excludes) divided by elapsed * chip peak bf16 FLOPs.
+  - ``taxi_examples_per_sec_per_chip``: the round-1 secondary workload,
+    with its ratio vs the committed round-1 self baseline
+    (BENCH_SELF_BASELINE.json).
+
+Env: BENCH_SMOKE=1 shrinks the model/steps for a CPU smoke test of the
+bench code path itself (numbers meaningless).
 """
 
 import json
@@ -24,83 +38,204 @@ SELF_BASELINE_FILE = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "BENCH_SELF_BASELINE.json"
 )
 
-BATCH_SIZE = 8192
-TRAIN_STEPS = 40
-N_ROWS = 65536
+A100_BERT_BASE_EX_PER_SEC = 1500.0
+
+# Peak bf16 matmul FLOPs per chip by device kind (dense, no sparsity).
+PEAK_BF16_FLOPS = [
+    ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v6 lite", 918e12), ("v6e", 918e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+]
 
 
-def synthetic_transformed_batchset(n: int):
-    """Synthetic taxi-like transformed features (what Transform materializes)."""
+def chip_peak_flops() -> float:
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for key, peak in PEAK_BF16_FLOPS:
+        if key in kind:
+            return peak
+    return 197e12  # assume v5e when unknown (CPU smoke runs don't report MFU)
+
+
+def _count_params(params) -> dict:
+    """Total and matmul-participating (non-embedding-table) param counts."""
+    import jax
+
+    total = 0
+    embed = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        n = int(np.prod(leaf.shape))
+        total += n
+        keys = "/".join(str(getattr(k, "key", k)) for k in path)
+        if "embed" in keys and keys.endswith("embedding"):
+            embed += n
+    return {"total": total, "matmul": total - embed}
+
+
+def bench_bert(smoke: bool) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_pipelines.models.bert import DEFAULT_HPARAMS, build_bert_model
+    from tpu_pipelines.trainer import TrainLoopConfig, train_loop
+
+    seq_len = 128
+    batch = 8 if smoke else 256
+    steps = 4 if smoke else 36
+    hp = {
+        **DEFAULT_HPARAMS,
+        "max_len": seq_len,
+        "attn_impl": "auto",
+        "num_classes": 2,
+    }
+    if smoke:
+        hp.update({"d_model": 64, "n_layers": 2, "n_heads": 4, "d_ff": 128,
+                   "vocab_size": 512})
+    model = build_bert_model(hp)
+
     rng = np.random.default_rng(0)
+    ids = rng.integers(4, hp["vocab_size"], size=(batch, seq_len), dtype=np.int64)
+    data = {
+        "input_ids": ids.astype(np.int32),
+        "attention_mask": np.ones((batch, seq_len), np.int32),
+        "label": (ids[:, 0] % 2).astype(np.int32),
+    }
+
+    def batches():
+        while True:
+            yield data
+
+    def features(b):
+        return {k: v for k, v in b.items() if k != "label"}
+
+    def loss_fn(params, b, step_rng):
+        logits = model.apply(
+            {"params": params}, features(b),
+            deterministic=False, rngs={"dropout": step_rng},
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, jnp.asarray(b["label"], jnp.int32)
+        ).mean()
+        return loss, {}
+
+    def init_fn(init_rng, b):
+        return model.init(init_rng, features(b))["params"]
+
+    params, result = train_loop(
+        loss_fn=loss_fn,
+        init_params_fn=init_fn,
+        optimizer=optax.adamw(2e-5),
+        train_iter=batches(),
+        config=TrainLoopConfig(
+            train_steps=steps, batch_size=batch, log_every=0,
+        ),
+    )
+
+    counts = _count_params(params)
+    tokens_per_step = batch * seq_len
+    # 6NT for the weight matmuls (fwd 2NT + bwd 4NT), plus the attention
+    # score/value einsums (QK^T and PV: 4*L*d_model FLOPs per token fwd,
+    # x3 with backward) which 6NT does not cover.
+    flops_per_step = (
+        6 * counts["matmul"] * tokens_per_step
+        + 12 * int(hp["n_layers"]) * batch * seq_len * seq_len * int(hp["d_model"])
+    )
+    eps = result.examples_per_sec_per_chip
+    steps_per_sec = eps / batch if batch else 0.0
+    mfu = flops_per_step * steps_per_sec / chip_peak_flops()
     return {
-        "miles_z": rng.normal(size=n).astype(np.float32),
-        "fare_01": rng.random(size=n).astype(np.float32),
-        "log_fare_z": rng.normal(size=n).astype(np.float32),
-        "tip_ratio": rng.random(size=n).astype(np.float32),
-        "hour_bucket": rng.integers(0, 4, size=n).astype(np.int32),
-        "company_id": rng.integers(0, 6, size=n).astype(np.int32),
-        "payment_onehot": np.eye(2, dtype=np.float32)[
-            rng.integers(0, 2, size=n)
-        ],
-        "is_cash": rng.integers(0, 2, size=n).astype(np.float32),
-        "label_big_tip": rng.integers(0, 2, size=n).astype(np.float32),
+        "examples_per_sec_per_chip": eps,
+        "mfu": round(mfu, 4),
+        "params_total": counts["total"],
+        "params_matmul": counts["matmul"],
+        "batch_size": batch,
+        "seq_len": seq_len,
+        "steps_timed": result.steps_completed - 1,  # step 1 absorbs compile
+        "goodput": result.goodput,
+        "attn_impl": hp["attn_impl"],
     }
 
 
-def batches(data, batch_size):
-    n = len(data["miles_z"])
-    i = 0
-    while True:
-        rows = np.arange(i, i + batch_size) % n
-        yield {k: v[rows] for k, v in data.items()}
-        i = (i + batch_size) % n
-
-
-def main() -> None:
-    import jax
+def bench_taxi(smoke: bool) -> dict:
     import jax.numpy as jnp
     import optax
 
     from tpu_pipelines.models.taxi import DEFAULT_HPARAMS, build_taxi_model
     from tpu_pipelines.trainer import TrainLoopConfig, train_loop
 
-    n_devices = len(jax.devices())
-    hp = {**DEFAULT_HPARAMS, "hidden_dims": [256, 128, 64]}
-    model = build_taxi_model(hp)
+    batch = 256 if smoke else 8192
+    steps = 4 if smoke else 40
+    n = batch * 8
+    rng = np.random.default_rng(0)
+    data = {
+        "miles_z": rng.normal(size=n).astype(np.float32),
+        "fare_01": rng.random(size=n).astype(np.float32),
+        "log_fare_z": rng.normal(size=n).astype(np.float32),
+        "tip_ratio": rng.random(size=n).astype(np.float32),
+        "hour_bucket": rng.integers(0, 4, size=n).astype(np.int32),
+        "company_id": rng.integers(0, 6, size=n).astype(np.int32),
+        "payment_onehot": np.eye(2, dtype=np.float32)[rng.integers(0, 2, size=n)],
+        "is_cash": rng.integers(0, 2, size=n).astype(np.float32),
+        "label_big_tip": rng.integers(0, 2, size=n).astype(np.float32),
+    }
 
-    def loss_fn(params, batch, rng):
-        logits = model.apply({"params": params}, batch)
-        labels = jnp.asarray(batch["label_big_tip"], jnp.float32)
-        loss = optax.sigmoid_binary_cross_entropy(logits, labels).mean()
-        return loss, {}
+    def batches():
+        i = 0
+        while True:
+            rows = np.arange(i, i + batch) % n
+            yield {k: v[rows] for k, v in data.items()}
+            i = (i + batch) % n
 
-    def init_fn(rng, sample):
-        return model.init(rng, sample)["params"]
+    model = build_taxi_model(
+        {**DEFAULT_HPARAMS, "hidden_dims": [256, 128, 64]}
+    )
 
-    data = synthetic_transformed_batchset(N_ROWS)
+    def loss_fn(params, b, _rng):
+        logits = model.apply({"params": params}, b)
+        labels = jnp.asarray(b["label_big_tip"], jnp.float32)
+        return optax.sigmoid_binary_cross_entropy(logits, labels).mean(), {}
+
     _, result = train_loop(
         loss_fn=loss_fn,
-        init_params_fn=init_fn,
+        init_params_fn=lambda r, b: model.init(r, b)["params"],
         optimizer=optax.adam(1e-3),
-        train_iter=batches(data, BATCH_SIZE),
+        train_iter=batches(),
         config=TrainLoopConfig(
-            train_steps=TRAIN_STEPS, batch_size=BATCH_SIZE, log_every=0,
+            train_steps=steps, batch_size=batch, log_every=0,
         ),
     )
-    value = result.examples_per_sec_per_chip
-
+    out = {"examples_per_sec_per_chip": result.examples_per_sec_per_chip}
     if os.path.exists(SELF_BASELINE_FILE):
         with open(SELF_BASELINE_FILE) as f:
             base = json.load(f)["value"]
-        vs_baseline = round(value / base, 4) if base else 1.0
-    else:
-        vs_baseline = 1.0
+        if base:
+            out["vs_round1_self_baseline"] = round(
+                result.examples_per_sec_per_chip / base, 4
+            )
+    return out
 
+
+def main() -> None:
+    smoke = bool(int(os.environ.get("BENCH_SMOKE", "0")))
+    bert = bench_bert(smoke)
+    taxi = bench_taxi(smoke)
+    value = bert["examples_per_sec_per_chip"]
     print(json.dumps({
-        "metric": "taxi_trainer_examples_per_sec_per_chip",
+        "metric": "bert_base_finetune_examples_per_sec_per_chip",
         "value": round(value, 2),
         "unit": "examples/sec/chip",
-        "vs_baseline": vs_baseline,
+        # North star: >=90% of A100 (vs_baseline >= 0.9 hits the target).
+        "vs_baseline": round(value / A100_BERT_BASE_EX_PER_SEC, 4),
+        "a100_reference_ex_per_sec": A100_BERT_BASE_EX_PER_SEC,
+        "mfu": bert["mfu"],
+        "bert": bert,
+        "taxi": taxi,
+        "smoke": smoke,
     }))
 
 

@@ -1,0 +1,123 @@
+"""ResNet-50 trainer module file (BASELINE config 2: ResNet ImageNet).
+
+Same ``run_fn`` contract as the other modules; the reference ran this
+workload as a multi-worker ``MultiWorkerMirroredStrategy`` TFJob (SURVEY.md
+§0 configs[2]) — here the cluster runner emits the multi-host JobSet and the
+train loop shards the batch over the mesh ``data`` axis.
+
+Expects Examples rows with an ``image`` column (flattened H*W*3 floats) and
+an integer ``label`` column; ``image_size`` in the hyperparameters gives H=W.
+BatchNorm running statistics thread through the train loop's model-state
+support and ship inside the exported payload.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tpu_pipelines.data.input_pipeline import BatchIterator, InputConfig
+from tpu_pipelines.models.resnet import DEFAULT_HPARAMS, build_resnet_model
+from tpu_pipelines.parallel.mesh import MeshConfig
+from tpu_pipelines.trainer import TrainLoopConfig, export_model, train_loop
+
+EXAMPLE_DEFAULTS = {
+    **DEFAULT_HPARAMS,
+    "image_size": 224,
+    "batch_size": 256,
+    "momentum": 0.9,
+    "weight_decay": 1e-4,
+}
+
+
+def build_model(hyperparameters):
+    return build_resnet_model(hyperparameters)
+
+
+def apply_fn(model, params, batch):
+    """Serving hook: ``params`` is the full variables dict (incl. BatchNorm
+    running stats); inference uses the running averages.  jit-safe: the
+    image side length comes from the static column width."""
+    img = jnp.asarray(batch["image"], jnp.float32)
+    if img.ndim == 2:
+        size = int(round((img.shape[1] // 3) ** 0.5))
+        img = img.reshape(img.shape[0], size, size, 3)
+    return model.apply(params, img, train=False)
+
+
+def _to_images(batch, size):
+    img = np.asarray(batch["image"], np.float32)
+    if img.ndim == 2:  # flattened rows
+        img = img.reshape(len(img), size, size, 3)
+    return img
+
+
+def run_fn(fn_args):
+    hp = {**EXAMPLE_DEFAULTS, **fn_args.hyperparameters}
+    model = build_model(hp)
+    batch_size = int(hp["batch_size"])
+    size = int(hp["image_size"])
+
+    def with_images(it):
+        for b in it:
+            yield {**b, "image": _to_images(b, size)}
+
+    train_iter = with_images(BatchIterator(
+        fn_args.train_examples_uri, "train",
+        InputConfig(batch_size=batch_size, shuffle=True, seed=0),
+    ))
+
+    def eval_iter_fn():
+        return with_images(BatchIterator(
+            fn_args.eval_examples_uri, "eval",
+            InputConfig(batch_size=batch_size, shuffle=False, num_epochs=1,
+                        drop_remainder=True),
+        ))
+
+    def loss_fn(params, model_state, batch, rng):
+        logits, mutated = model.apply(
+            {"params": params, **model_state},
+            batch["image"], train=True, mutable=["batch_stats"],
+        )
+        labels = jnp.asarray(batch["label"], jnp.int32)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+        accuracy = jnp.mean(jnp.argmax(logits, -1) == labels)
+        return loss, ({"accuracy": accuracy}, mutated)
+
+    def init_params_fn(rng, sample_batch):
+        variables = model.init(rng, sample_batch["image"], train=False)
+        return variables["params"], {"batch_stats": variables["batch_stats"]}
+
+    mesh_cfg = MeshConfig(**fn_args.mesh_config) if fn_args.mesh_config else None
+    (params, model_state), result = train_loop(
+        loss_fn=loss_fn,
+        init_params_fn=init_params_fn,
+        optimizer=optax.sgd(
+            hp["learning_rate"], momentum=hp["momentum"], nesterov=True
+        ),
+        train_iter=train_iter,
+        eval_iter_fn=eval_iter_fn,
+        config=TrainLoopConfig(
+            train_steps=fn_args.train_steps,
+            batch_size=batch_size,
+            eval_steps=fn_args.eval_steps,
+            checkpoint_every=max(1, fn_args.train_steps // 4),
+            log_every=max(1, fn_args.train_steps // 10),
+            mesh_config=mesh_cfg,
+        ),
+        checkpoint_dir=fn_args.model_run_dir,
+        has_model_state=True,
+    )
+
+    export_model(
+        serving_model_dir=fn_args.serving_model_dir,
+        # Full variables dict: apply_fn above consumes it whole, so the
+        # exported payload carries the BatchNorm running statistics.
+        params={"params": params, **model_state},
+        module_file=__file__,
+        hyperparameters=hp,
+        transform_graph_uri=fn_args.transform_graph_uri,
+        extra_spec={"label": "label"},
+    )
+    return result

@@ -1,0 +1,84 @@
+"""Observability CLI over a completed pipeline run (SURVEY.md §5)."""
+
+import os
+import subprocess
+import sys
+
+from tpu_pipelines.dsl.component import Parameter, component
+from tpu_pipelines.dsl.pipeline import Pipeline
+from tpu_pipelines.orchestration import LocalDagRunner
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@component(outputs={"examples": "Examples"},
+           parameters={"n": Parameter(type=int, default=4)})
+def Ingest(ctx):
+    with open(os.path.join(ctx.output("examples").uri, "rows.txt"), "w") as f:
+        f.write("r\n" * ctx.exec_properties["n"])
+
+
+@component(inputs={"examples": "Examples"}, outputs={"model": "Model"})
+def Train(ctx):
+    with open(os.path.join(ctx.output("model").uri, "weights.txt"), "w") as f:
+        f.write("w")
+    return {"examples_per_sec_per_chip": 123.0}
+
+
+def _run(tmp_path):
+    ing = Ingest(instance_name="ingest")
+    tr = Train(examples=ing.outputs["examples"], instance_name="train")
+    pipe = Pipeline(
+        name="cli-demo", components=[ing, tr],
+        pipeline_root=str(tmp_path / "root"),
+        metadata_path=str(tmp_path / "md.sqlite"),
+    )
+    return LocalDagRunner().run(pipe)
+
+
+def test_inspect_runs_and_lineage(tmp_path, capsys):
+    from tpu_pipelines.__main__ import main
+
+    result = _run(tmp_path)
+    md = str(tmp_path / "md.sqlite")
+
+    assert main(["inspect", "--metadata", md, "runs", "cli-demo"]) == 0
+    out = capsys.readouterr().out
+    assert "train" in out and "COMPLETE" in out
+    assert "ingest" in out
+    assert "wall" not in out  # wall-clock rendered as seconds, not key name
+    assert "s" in out
+
+    model_art = result.outputs_of("train", "model")[0]
+    assert main(["inspect", "--metadata", md, "lineage",
+                 str(model_art.id)]) == 0
+    out = capsys.readouterr().out
+    # provenance chain: Model <- Train execution <- Examples artifact
+    assert f"Model#{model_art.id}" in out
+    assert "Examples#" in out
+    assert "Train#" in out
+
+    assert main(["inspect", "--metadata", md, "artifacts",
+                 "--type", "Model"]) == 0
+    out = capsys.readouterr().out
+    assert "Model" in out and "Examples" not in out
+
+
+def test_inspect_unknown_pipeline_fails(tmp_path, capsys):
+    from tpu_pipelines.__main__ import main
+
+    _run(tmp_path)
+    md = str(tmp_path / "md.sqlite")
+    assert main(["inspect", "--metadata", md, "runs", "nope"]) == 1
+
+
+def test_cli_entrypoint_subprocess(tmp_path):
+    _run(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_pipelines", "inspect",
+         "--metadata", str(tmp_path / "md.sqlite"), "runs", "cli-demo"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "train" in proc.stdout

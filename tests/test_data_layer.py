@@ -145,3 +145,73 @@ def test_mesh_and_shard_batch():
     assert mesh2.shape == {"data": 4, "model": 2, "seq": 1}
     with pytest.raises(ValueError, match="not divisible"):
         make_mesh(MeshConfig(data=-1, model=3))
+
+
+def _write_big_split(tmp_path, n=5000, row_group=512):
+    uri = str(tmp_path / "examples")
+    table = pa.table({
+        "x": np.arange(n, dtype=np.int64),
+        "y": np.arange(n, dtype=np.float32) * 0.5,
+    })
+    examples_io.write_split(uri, "train", table, row_group_size=row_group)
+    return uri
+
+
+def test_streaming_iterator_covers_every_row_once(tmp_path):
+    """Split larger than the reader budget streams row groups; one epoch
+    must yield each row exactly once (minus the drop_remainder tail)."""
+    n = 5000
+    uri = _write_big_split(tmp_path, n=n)
+    cfg = InputConfig(
+        batch_size=64, shuffle=True, seed=3, num_epochs=1,
+        max_in_memory_rows=1000,        # force streaming: 5000 > 1000
+        shuffle_buffer_rows=700, drop_remainder=False,
+    )
+    it = BatchIterator(uri, "train", cfg)
+    assert it.streaming
+    assert it.num_examples == n
+    seen = np.concatenate([b["x"] for b in it])
+    assert len(seen) == n
+    assert sorted(seen.tolist()) == list(range(n))
+    # Shuffled: not in file order.
+    assert seen.tolist() != list(range(n))
+
+
+def test_streaming_iterator_drop_remainder_and_shapes(tmp_path):
+    n = 5000
+    uri = _write_big_split(tmp_path, n=n)
+    cfg = InputConfig(
+        batch_size=128, shuffle=True, seed=0, num_epochs=1,
+        max_in_memory_rows=1000, shuffle_buffer_rows=512,
+    )
+    batches = list(BatchIterator(uri, "train", cfg))
+    assert all(len(b["x"]) == 128 for b in batches)
+    total = sum(len(b["x"]) for b in batches)
+    assert total == (n // 128) * 128
+
+
+def test_streaming_iterator_sharding_partitions_rows(tmp_path):
+    n = 3000
+    uri = _write_big_split(tmp_path, n=n)
+    shards = []
+    for idx in range(2):
+        cfg = InputConfig(
+            batch_size=32, shuffle=False, num_epochs=1,
+            max_in_memory_rows=1000, shuffle_buffer_rows=256,
+            drop_remainder=False, shard_index=idx, num_shards=2,
+        )
+        it = BatchIterator(uri, "train", cfg)
+        assert it.num_examples == 1500
+        shards.append(np.concatenate([b["x"] for b in it]))
+    merged = np.concatenate(shards)
+    assert sorted(merged.tolist()) == list(range(n))
+    assert set(shards[0] % 2) == {0} and set(shards[1] % 2) == {1}
+
+
+def test_in_memory_mode_unchanged_for_small_splits(tmp_path):
+    uri = _write_big_split(tmp_path, n=500)
+    cfg = InputConfig(batch_size=50, shuffle=True, seed=1, num_epochs=2)
+    it = BatchIterator(uri, "train", cfg)
+    assert not it.streaming
+    batches = list(it)
+    assert len(batches) == 20  # 2 epochs x 10

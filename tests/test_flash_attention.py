@@ -57,6 +57,54 @@ def test_flash_grad_matches_dense():
                                    rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grad_matches_dense_with_mask(causal):
+    q, k, v = _qkv(l=32)
+    rng = np.random.default_rng(2)
+    mask = (rng.random((2, 32)) > 0.3).astype(np.int32)
+    mask[:, 0] = 1
+    mask = jnp.asarray(mask)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(FLASH(q, k, v, causal=causal, kv_mask=mask) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(
+            dense_attention(q, k, v, causal=causal, kv_mask=mask) ** 2
+        )
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="memory analysis needs the real TPU compiler")
+def test_flash_training_memory_beats_dense_at_long_seq():
+    """At L=2048 the flash fwd+bwd path must need less live memory than
+    dense (which materializes [b,h,L,L] scores in both passes)."""
+    b, l, h, d = 2, 2048, 4, 64
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(
+        rng.normal(size=(b, l, h, d)).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+
+    def peak(fn):
+        lowered = jax.jit(
+            lambda q, k, v: jax.grad(
+                lambda q, k, v: jnp.sum(fn(q, k, v) ** 2),
+                argnums=(0, 1, 2),
+            )(q, k, v)
+        ).lower(q, k, v)
+        return lowered.compile().memory_analysis().temp_size_in_bytes
+
+    flash_peak = peak(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    dense_peak = peak(lambda q, k, v: dense_attention(q, k, v, causal=True))
+    assert flash_peak < dense_peak / 2, (flash_peak, dense_peak)
+
+
 def test_flash_bf16_and_jit():
     q, k, v = _qkv()
     qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))

@@ -1,0 +1,109 @@
+"""Observability CLI: ``python -m tpu_pipelines inspect ...``.
+
+The MLMD-UI / KFP-UI equivalent surface (SURVEY.md §5 metrics/observability):
+the metadata store is the observability backbone — every artifact, execution,
+lineage edge, and per-node wall-clock is recorded there — and this CLI is the
+user-facing way to read it back:
+
+    python -m tpu_pipelines inspect runs <pipeline> --metadata md.sqlite
+    python -m tpu_pipelines inspect lineage <artifact-id> --metadata md.sqlite
+    python -m tpu_pipelines inspect artifacts [--type Model] --metadata md.sqlite
+
+Reads the shared SQLite schema directly (works on stores written by either
+the python or the native C++ backend).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tpu_pipelines.metadata.store import MetadataStore
+
+
+def _fmt_props(props: dict, keys=None) -> str:
+    items = [
+        (k, v) for k, v in sorted(props.items())
+        if keys is None or k in keys
+    ]
+    return " ".join(f"{k}={v}" for k, v in items)
+
+
+def cmd_runs(store: MetadataStore, pipeline: str) -> int:
+    prefix = f"{pipeline}."
+    runs = [
+        c for c in store.get_contexts("pipeline_run")
+        if c.name.startswith(prefix)
+    ]
+    if not runs:
+        print(f"no runs recorded for pipeline {pipeline!r}", file=sys.stderr)
+        return 1
+    for ctx in runs:
+        print(f"run {ctx.name[len(prefix):]}  (context #{ctx.id})")
+        for ex in store.get_executions_by_context(ctx.id):
+            wall = ex.properties.get("wall_clock_s", "")
+            wall_s = f"  {wall}s" if wall != "" else ""
+            extra = _fmt_props(
+                ex.properties,
+                keys=(
+                    "examples_per_sec_per_chip", "retries", "cache_hit",
+                    "error",
+                ),
+            )
+            print(
+                f"  {ex.node_id or ex.type_name:<24} [{ex.state.value}]"
+                f"{wall_s}  {extra}".rstrip()
+            )
+    return 0
+
+
+def cmd_lineage(store: MetadataStore, artifact_id: int) -> int:
+    text = store.format_lineage(artifact_id)
+    print(text)
+    return 1 if text.startswith("<no artifact") else 0
+
+
+def cmd_artifacts(store: MetadataStore, type_name: str) -> int:
+    arts = store.get_artifacts(type_name=type_name or None)
+    if not arts:
+        print("no artifacts", file=sys.stderr)
+        return 1
+    for a in arts:
+        print(f"#{a.id:<5} {a.type_name:<16} [{a.state.value}] {a.uri}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpu_pipelines", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    inspect = sub.add_parser("inspect", help="read the metadata store")
+    inspect.add_argument("--metadata", required=True,
+                         help="path to the pipeline's metadata sqlite")
+    isub = inspect.add_subparsers(dest="what", required=True)
+
+    p_runs = isub.add_parser("runs", help="runs + per-node wall-clocks")
+    p_runs.add_argument("pipeline", help="pipeline name")
+
+    p_lin = isub.add_parser("lineage", help="provenance chain of an artifact")
+    p_lin.add_argument("artifact_id", type=int)
+
+    p_art = isub.add_parser("artifacts", help="list artifacts")
+    p_art.add_argument("--type", default="", help="filter by artifact type")
+
+    args = parser.parse_args(argv)
+    store = MetadataStore(args.metadata)
+    try:
+        if args.what == "runs":
+            return cmd_runs(store, args.pipeline)
+        if args.what == "lineage":
+            return cmd_lineage(store, args.artifact_id)
+        return cmd_artifacts(store, args.type)
+    finally:
+        store.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

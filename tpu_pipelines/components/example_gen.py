@@ -10,38 +10,39 @@ is vectorized over a string join of the row.
 
 from __future__ import annotations
 
-import hashlib
-import json
 import os
 from typing import Dict
 
 import numpy as np
 import pyarrow as pa
+import pyarrow.compute as pc
 import pyarrow.csv as pacsv
 
 from tpu_pipelines.data import examples_io
 from tpu_pipelines.dsl.component import Parameter, component
+from tpu_pipelines.utils.hashing import hash_buckets
 
 DEFAULT_SPLITS = {"train": 2, "eval": 1}
 
 
 def _row_hash_buckets(table: pa.Table, num_buckets: int) -> np.ndarray:
-    """Stable per-row bucket: blake2 of the stringified row, mod buckets."""
+    """Stable per-row bucket: vectorized FNV of the joined stringified row.
+
+    Arrow compute stringifies and joins the columns; utils/hashing does the
+    columnwise-vectorized hash — no per-row Python loop anywhere.
+    """
     cols = []
     for name in table.column_names:
         col = table.column(name)
         if pa.types.is_nested(col.type):
-            cols.append([str(v) for v in col.to_pylist()])
+            # Rare path (list columns): stringify via python.
+            cols.append(pa.array([str(v) for v in col.to_pylist()]))
         else:
-            cols.append(col.cast(pa.string()).to_pylist())
-    out = np.empty(table.num_rows, dtype=np.int64)
-    for i, row in enumerate(zip(*cols)):
-        h = hashlib.blake2b(
-            "\x1f".join("" if v is None else v for v in row).encode("utf-8"),
-            digest_size=8,
-        ).digest()
-        out[i] = int.from_bytes(h, "little") % num_buckets
-    return out
+            cols.append(pc.fill_null(col.cast(pa.string()), ""))
+    joined = pc.binary_join_element_wise(*cols, "\x1f")
+    return hash_buckets(
+        joined.to_numpy(zero_copy_only=False), num_buckets
+    )
 
 
 def _split_and_write(table: pa.Table, uri: str, splits: Dict[str, int]) -> Dict[str, int]:

@@ -20,6 +20,10 @@ import pyarrow.parquet as pq
 
 SPLIT_PREFIX = "Split-"
 DATA_FILE = "data.parquet"
+# Row-group size for written splits: the unit of streaming reads.  Small
+# enough that a handful of groups fit comfortably in RAM, large enough that
+# columnar decode stays vectorized.
+DEFAULT_ROW_GROUP = 16384
 
 
 def split_dir(uri: str, split: str) -> str:
@@ -37,12 +41,50 @@ def split_names(uri: str) -> List[str]:
     )
 
 
-def write_split(uri: str, split: str, table: pa.Table) -> str:
+def write_split(
+    uri: str, split: str, table: pa.Table,
+    row_group_size: int = DEFAULT_ROW_GROUP,
+) -> str:
     d = split_dir(uri, split)
     os.makedirs(d, exist_ok=True)
     path = os.path.join(d, DATA_FILE)
-    pq.write_table(table, path)
+    pq.write_table(table, path, row_group_size=row_group_size)
     return path
+
+
+def open_split_writer(
+    uri: str, split: str, schema: pa.Schema,
+) -> pq.ParquetWriter:
+    """Incremental split writer (chunked materialization path)."""
+    d = split_dir(uri, split)
+    os.makedirs(d, exist_ok=True)
+    return pq.ParquetWriter(os.path.join(d, DATA_FILE), schema)
+
+
+def iter_column_chunks(
+    uri: str,
+    split: str,
+    columns: Optional[List[str]] = None,
+    rows: int = DEFAULT_ROW_GROUP,
+):
+    """Stream a split as dict-of-numpy chunks of ~``rows`` rows each.
+
+    The whole split is never resident: pyarrow reads row groups lazily, so
+    peak memory is O(rows), independent of split size — the streaming
+    contract ExampleGen's row-group layout (write_split) is tuned for.
+    """
+    path = os.path.join(split_dir(uri, split), DATA_FILE)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"Examples artifact at {uri!r} has no split {split!r} "
+            f"(available: {split_names(uri)})"
+        )
+    pf = pq.ParquetFile(path)
+    try:
+        for rb in pf.iter_batches(batch_size=rows, columns=columns):
+            yield columns_from_table(pa.Table.from_batches([rb]))
+    finally:
+        pf.close()
 
 
 def read_split_table(

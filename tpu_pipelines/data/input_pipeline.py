@@ -6,8 +6,10 @@ shuffling, per-host sharding for multi-host data parallelism (each process
 reads rows ``i % num_shards == shard_index``, the Grain convention), and a
 ``shard_batch`` device_put at the infeed boundary.
 
-Datasets at workshop scale fit in host RAM as numpy columns; larger data can
-stream Parquet row groups through the same iterator contract.
+Two reader modes behind one iterator contract: splits within the
+``max_in_memory_rows`` budget load as numpy columns (fast exact-permutation
+shuffling); larger splits stream Parquet row groups through a shuffle buffer
+(ImageNet-scale inputs, out-of-core).
 """
 
 from __future__ import annotations
@@ -32,6 +34,13 @@ class InputConfig:
     num_epochs: Optional[int] = None  # None = loop forever
     shard_index: int = 0             # this host's shard (multi-host DP)
     num_shards: int = 1
+    # Reader budget: splits larger than this many rows stream Parquet row
+    # groups through a shuffle buffer instead of materializing in RAM
+    # (ImageNet-scale inputs; the tf.data/Beam streaming equivalent).
+    max_in_memory_rows: int = 2_000_000
+    # Shuffle-buffer rows for the streaming path (within-buffer shuffling —
+    # the standard approximate shuffle of streaming input pipelines).
+    shuffle_buffer_rows: int = 65536
 
 
 class BatchIterator:
@@ -51,15 +60,23 @@ class BatchIterator:
     ):
         self.config = config
         self.transform = transform
-        data = examples_io.read_split(uri, split, columns)
-        if not data:
-            raise ValueError(f"empty split {split!r} at {uri}")
-        n = len(next(iter(data.values())))
+        self._uri, self._split, self._columns = uri, split, columns
+        n_total = examples_io.num_rows(uri, split)
         # Per-host shard: strided rows, the Grain sharding convention.
-        idx = np.arange(config.shard_index, n, config.num_shards)
-        self._data = data
-        self._indices = idx
-        self._n = len(idx)
+        shard_n = len(range(config.shard_index, n_total, config.num_shards))
+        self.streaming = n_total > config.max_in_memory_rows
+        if self.streaming:
+            self._data = None
+            self._indices = None
+        else:
+            data = examples_io.read_split(uri, split, columns)
+            if not data:
+                raise ValueError(f"empty split {split!r} at {uri}")
+            self._data = data
+            self._indices = np.arange(
+                config.shard_index, n_total, config.num_shards
+            )
+        self._n = shard_n
         if self._n < config.batch_size and config.drop_remainder:
             raise ValueError(
                 f"split {split!r}: shard has {self._n} rows < batch_size "
@@ -79,22 +96,83 @@ class BatchIterator:
         cfg = self.config
         epoch = 0
         while cfg.num_epochs is None or epoch < cfg.num_epochs:
-            order = self._indices
-            if cfg.shuffle:
-                rng = np.random.default_rng((cfg.seed, epoch))
-                order = rng.permutation(order)
-            limit = (
-                (self._n // cfg.batch_size) * cfg.batch_size
-                if cfg.drop_remainder
-                else self._n
+            it = (
+                self._stream_epoch(epoch) if self.streaming
+                else self._memory_epoch(epoch)
             )
-            for start in range(0, limit, cfg.batch_size):
-                rows = order[start : start + cfg.batch_size]
-                batch = {k: v[rows] for k, v in self._data.items()}
+            for batch in it:
                 if self.transform is not None:
                     batch = self.transform(batch)
                 yield batch
             epoch += 1
+
+    def _memory_epoch(self, epoch: int) -> Iterator[Batch]:
+        cfg = self.config
+        order = self._indices
+        if cfg.shuffle:
+            rng = np.random.default_rng((cfg.seed, epoch))
+            order = rng.permutation(order)
+        limit = (
+            (self._n // cfg.batch_size) * cfg.batch_size
+            if cfg.drop_remainder
+            else self._n
+        )
+        for start in range(0, limit, cfg.batch_size):
+            rows = order[start : start + cfg.batch_size]
+            yield {k: v[rows] for k, v in self._data.items()}
+
+    def _stream_epoch(self, epoch: int) -> Iterator[Batch]:
+        """One pass over the split via row-group streaming + shuffle buffer.
+
+        Every shard row is yielded exactly once per epoch (modulo the
+        drop_remainder tail); shuffling is within-buffer, the standard
+        approximation for out-of-core inputs.
+        """
+        cfg = self.config
+        rng = np.random.default_rng((cfg.seed, epoch, 1))
+        buffer_rows = max(cfg.batch_size, cfg.shuffle_buffer_rows)
+        pending: Optional[Batch] = None
+        offset = 0
+
+        def rows_in(pool: Batch) -> int:
+            return len(next(iter(pool.values())))
+
+        def drain(pool: Batch, flush: bool):
+            """(batches, leftover_pool): full batches out of a shuffled pool;
+            non-emitted rows (the permutation tail) carry to the next fill."""
+            n = rows_in(pool)
+            order = rng.permutation(n) if cfg.shuffle else np.arange(n)
+            usable = n if flush else (n // cfg.batch_size) * cfg.batch_size
+            batches = []
+            for start in range(0, usable, cfg.batch_size):
+                rows = order[start:start + cfg.batch_size]
+                if len(rows) < cfg.batch_size and cfg.drop_remainder:
+                    break
+                batches.append({k: v[rows] for k, v in pool.items()})
+            leftover = order[usable:]
+            return batches, {k: v[leftover] for k, v in pool.items()}
+
+        for chunk in examples_io.iter_column_chunks(
+            self._uri, self._split, self._columns
+        ):
+            n = rows_in(chunk)
+            take = (
+                np.arange(offset, offset + n) % cfg.num_shards
+            ) == cfg.shard_index
+            offset += n
+            if not take.all():
+                chunk = {k: v[take] for k, v in chunk.items()}
+            if rows_in(chunk) == 0:
+                continue
+            pending = chunk if pending is None else {
+                k: np.concatenate([pending[k], chunk[k]]) for k in pending
+            }
+            if rows_in(pending) >= buffer_rows:
+                batches, pending = drain(pending, flush=False)
+                yield from batches
+        if pending is not None and rows_in(pending):
+            batches, _ = drain(pending, flush=True)
+            yield from batches
 
 
 def sharded_batches(

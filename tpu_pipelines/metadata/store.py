@@ -283,6 +283,26 @@ class MetadataStore:
             self._commit()
             return context.id
 
+    def get_contexts(self, type_name: Optional[str] = None) -> List[Context]:
+        """All contexts, optionally filtered by type (e.g. "pipeline_run")."""
+        q, args = (
+            "SELECT id, type_name, name, properties, create_time FROM contexts",
+            [],
+        )
+        if type_name:
+            q += " WHERE type_name=?"
+            args.append(type_name)
+        q += " ORDER BY id"
+        out = []
+        for row in self._conn.execute(q, args):
+            ctx = Context(
+                type_name=row[1], name=row[2], properties=json.loads(row[3]),
+                create_time=row[4],
+            )
+            ctx.id = row[0]
+            out.append(ctx)
+        return out
+
     def get_context(self, type_name: str, name: str) -> Optional[Context]:
         row = self._conn.execute(
             "SELECT id, type_name, name, properties, create_time FROM contexts "

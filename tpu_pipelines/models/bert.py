@@ -138,7 +138,7 @@ DEFAULT_HPARAMS = {
     "type_vocab_size": 2,
     "dropout_rate": 0.1,
     "num_classes": 2,
-    "attn_impl": "dense",
+    "attn_impl": "auto",
     "learning_rate": 3e-5,
     "batch_size": 64,
     "head": "classifier",     # or "mlm"

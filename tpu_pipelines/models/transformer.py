@@ -27,6 +27,10 @@ from tpu_pipelines.parallel.ring_attention import dense_attention, ring_attentio
 
 Dtype = Any
 
+# "auto" attn_impl switchover: below this sequence length plain XLA dense
+# attention outruns the Pallas kernel (measured on v5e, BERT-base).
+FLASH_MIN_SEQ_LEN = 512
+
 
 class MlpBlock(nn.Module):
     d_ff: int
@@ -50,8 +54,12 @@ class MultiHeadAttention(nn.Module):
     ``attn_impl``:
       - "dense": plain XLA attention (any mask/bias/cross).
       - "ring":  sequence-parallel ring attention over the mesh ``seq`` axis.
-      - "flash": the Pallas blockwise kernel (ops/flash_attention.py) — the
-        single-chip hot path; no O(L²) score tensor in HBM.
+      - "flash": the Pallas blockwise kernel (ops/flash_attention.py) — no
+        O(L²) score tensor in HBM, fwd and bwd.
+      - "auto":  dense below FLASH_MIN_SEQ_LEN, flash at/above it.  Measured
+        on v5e: at L=128 dense is ~30% faster (one KV block makes the
+        blockwise kernel pure overhead), while flash wins once the score
+        tensor stops fitting fused in VMEM.
     Ring/flash require self-attention without an additive bias; cross
     attention and biased attention (T5 relative positions) always take the
     dense path.
@@ -84,15 +92,20 @@ class MultiHeadAttention(nn.Module):
         k = proj("key")(x_kv)
         v = proj("value")(x_kv)
 
+        impl = self.attn_impl
+        if impl == "auto":
+            impl = (
+                "flash" if x_q.shape[1] >= FLASH_MIN_SEQ_LEN else "dense"
+            )
         use_ring = (
-            self.attn_impl == "ring"
+            impl == "ring"
             and is_self
             and bias is None
             and self.mesh is not None
             and self.mesh.shape.get("seq", 1) > 1
         )
         use_flash = (
-            self.attn_impl == "flash" and is_self and bias is None
+            impl == "flash" and is_self and bias is None
         )
         if use_ring:
             out = ring_attention(

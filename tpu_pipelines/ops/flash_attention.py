@@ -1,17 +1,25 @@
-"""Flash attention: Pallas TPU kernel for the dense-attention hot path.
+"""Flash attention: Pallas TPU kernels for the dense-attention hot path.
 
-Blockwise online-softmax attention (the flash-attention recurrence): the
-kernel streams K/V blocks through VMEM against one Q block, carrying the
-running max/denominator/accumulator — the [L, L] score matrix never
-materializes in HBM, so memory is O(block_q · block_k) instead of O(L²) and
-the two matmuls per block land on the MXU back to back.
+Blockwise online-softmax attention (the flash-attention recurrence), forward
+AND backward as Pallas kernels:
 
-Scope: forward pass as a kernel; the backward pass recomputes attention with
-the standard XLA ops (``jax.custom_vjp`` below) — activation memory still
-drops because no O(L²) tensor is saved as a residual, which is where the
-flash trick pays on TPU.  Used by models/transformer.py when
-``attn_impl="flash"``; ring attention (parallel/ring_attention.py) handles
-the sequence-parallel regime and composes the same math across chips.
+  - forward: grid (batch*heads, q-blocks, kv-blocks) streams K/V blocks from
+    HBM through VMEM against one resident Q block, carrying the running
+    max/denominator/accumulator in VMEM scratch across the sequential kv grid
+    dimension — the [L, L] score matrix never materializes and VMEM holds
+    O(block_q · block_k) regardless of L.  The forward also emits the
+    per-row logsumexp (LSE) used by the backward.
+  - backward: two kernels recompute scores blockwise from the saved
+    (q, k, v, lse) — dQ over grid (bh, q-blocks, kv-blocks), dK/dV over
+    grid (bh, kv-blocks, q-blocks) — so the backward is O(block²) memory
+    too; nothing O(L²) is ever saved or rebuilt (the round-1 version
+    recomputed a dense [b,h,L,L] attention inside the VJP).
+
+Both matmuls per block land on the MXU back to back; row statistics are kept
+as (block_q, 128) lane-replicated tiles to satisfy TPU tiling.  Used by
+models/transformer.py when ``attn_impl="flash"``; ring attention
+(parallel/ring_attention.py) handles the sequence-parallel regime and
+composes the same math across chips.
 """
 
 from __future__ import annotations
@@ -24,120 +32,311 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
+LANES = 128
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, block_k: int,
-                  causal: bool, block_q: int, scale: float):
-    """One (batch*head, q-block) grid cell: stream all K/V blocks."""
+def _block_mask(kmask, qi, kj, block_q, block_k, causal):
+    """[bq, bk] bool: allowed (key-visible and causal-visible) positions."""
+    allowed = jnp.broadcast_to(kmask[None, :] > 0, (block_q, block_k))
+    if causal:
+        qpos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        kpos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        allowed = jnp.logical_and(allowed, qpos >= kpos)
+    return allowed
+
+
+def _causal_live(qi, kj, block_q, block_k):
+    """False iff the whole KV block sits strictly above the causal diagonal."""
+    return kj * block_k <= qi * block_q + block_q - 1
+
+
+# --------------------------------------------------------------------- fwd
+
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, causal, block_q, block_k, scale):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale            # [bq, d]
-    seq_len = k_ref.shape[1]
-    n_kv = seq_len // block_k
+    kj = pl.program_id(2)
+    n_kv = pl.num_programs(2)
 
-    def body(j, carry):
-        acc, m, l = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(                        # [bq, bk] on the MXU
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    live = _causal_live(qi, kj, block_q, block_k) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale            # [bq, d]
+        k = k_ref[0].astype(jnp.float32)                    # [bk, d]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(                            # [bq, bk] on MXU
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        # mask is [b*h, 1, l]: the (1, 1, l) block equals the array's last
-        # two dims, satisfying TPU tiling, with no dynamic sublane index.
-        kmask = mask_ref[0, 0, pl.ds(j * block_k, block_k)]
-        s = jnp.where(kmask[None, :] > 0, s, NEG_INF)
-        if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            kpos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
-        s_max = jnp.max(s, axis=1)                      # [bq]
-        m_new = jnp.maximum(m, s_max)
-        p = jnp.exp(s - m_new[:, None])
-        p = jnp.where(s > NEG_INF * 0.5, p, 0.0)        # fully-masked blocks
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=1)
-        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+        allowed = _block_mask(mask_ref[0, 0], qi, kj, block_q, block_k, causal)
+        s = jnp.where(allowed, s, NEG_INF)
+        m_prev = m_ref[:, 0:1]                              # [bq, 1]
+        s_max = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, s_max)
+        p = jnp.where(allowed, jnp.exp(s - m_new), 0.0)     # [bq, bk]
+        corr = jnp.exp(m_prev - m_new)                      # [bq, 1]
+        l_new = l_ref[:, 0:1] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return acc_new, m_new, l_new
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    d = q_ref.shape[-1]
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    if causal:
-        # Stop after the KV block containing the last allowed key position,
-        # key index (qi+1)*block_q - 1 — blocks past it are fully masked.
-        n_used = jnp.minimum(n_kv, ((qi + 1) * block_q - 1) // block_k + 1)
-    else:
-        n_used = n_kv
-    acc, m, l = jax.lax.fori_loop(0, n_used, body, (acc0, m0, l0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    @pl.when(kj == n_kv - 1)
+    def _final():
+        l_fin = l_ref[:, 0:1]
+        o_ref[0] = (acc_ref[...] / jnp.maximum(l_fin, 1e-30)).astype(o_ref.dtype)
+        # Rows with an empty allowed key set keep lse = NEG_INF-ish; the
+        # backward's `allowed` guard zeroes them regardless.  lse is laid out
+        # [bh, L, 1] (TPU block tiling wants the block's trailing dims to
+        # divide (8, 128) or equal the array's).
+        lse_ref[0] = m_ref[:, 0:1] + jnp.log(jnp.maximum(l_ref[:, 0:1], 1e-30))
 
 
 def _flash_forward(q, k, v, kv_mask, *, causal, block_q, block_k, interpret):
+    """Returns (out [b,l,h,d], lse [b*h, l]) from folded blockwise kernels."""
+    from jax.experimental.pallas import tpu as pltpu
+
     b, l, h, d = q.shape
     scale = d ** -0.5
-    # [b, l, h, d] -> [b*h, l, d]: one grid row per (batch, head)
+
     def fold(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, l, d)
 
     qf, kf, vf = fold(q), fold(k), fold(v)
-    maskf = jnp.repeat(kv_mask, h, axis=0)[:, None, :]  # [b*h, 1, l]
+    maskf = jnp.repeat(kv_mask, h, axis=0)[:, None, :]      # [b*h, 1, l]
 
-    grid = (b * h, l // block_q)
-    out = pl.pallas_call(
+    grid = (b * h, l // block_q, l // block_k)
+    out, lse = pl.pallas_call(
         functools.partial(
-            _flash_kernel, block_k=block_k, causal=causal,
-            block_q=block_q, scale=scale,
+            _fwd_kernel, causal=causal, block_q=block_q, block_k=block_k,
+            scale=scale,
         ),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, l, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, l, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, 1, l), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda bh, i, j: (bh, 0, j)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, l, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, l, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, l, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+        ],
         interpret=interpret,
     )(qf, kf, vf, maskf)
-    return out.reshape(b, h, l, d).transpose(0, 2, 1, 3)
+    return out.reshape(b, h, l, d).transpose(0, 2, 1, 3), lse
 
+
+# --------------------------------------------------------------------- bwd
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref, mask_ref,
+               dq_ref, acc_ref, *, causal, block_q, block_k, scale):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    live = _causal_live(qi, kj, block_q, block_k) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        allowed = _block_mask(mask_ref[0, 0], qi, kj, block_q, block_k, causal)
+        p = jnp.where(allowed, jnp.exp(s - lse_ref[0]), 0.0)
+        dp = jax.lax.dot_general(                            # dO V^T [bq, bk]
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dvec_ref[0])                          # [bq, bk]
+        acc_ref[...] += scale * jax.lax.dot_general(         # dS K [bq, d]
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kj == n_kv - 1)
+    def _final():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref, mask_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, causal, block_q, block_k,
+                scale):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    live = _causal_live(qi, kj, block_q, block_k) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        allowed = _block_mask(mask_ref[0, 0], qi, kj, block_q, block_k, causal)
+        p = jnp.where(allowed, jnp.exp(s - lse_ref[0]), 0.0)
+        dv_acc[...] += jax.lax.dot_general(                  # P^T dO [bk, d]
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dvec_ref[0])
+        dk_acc[...] += scale * jax.lax.dot_general(          # dS^T Q [bk, d]
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == n_q - 1)
+    def _final():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, kv_mask, o, lse, g, *, causal, block_q, block_k,
+                    interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, l, h, d = q.shape
+    scale = d ** -0.5
+
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, l, d)
+
+    qf, kf, vf, of, gf = fold(q), fold(k), fold(v), fold(o), fold(g)
+    maskf = jnp.repeat(kv_mask, h, axis=0)[:, None, :]
+    # D_i = rowsum(dO · O): the softmax-jacobian correction term.
+    # [bh, L, 1] column layout, matching lse (see _fwd_kernel final note).
+    dvec = jnp.sum(
+        gf.astype(jnp.float32) * of.astype(jnp.float32), axis=-1, keepdims=True
+    )
+
+    qkv_spec = lambda which: {
+        "q": pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        "k": pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+    }[which]
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda bh, i, j: (bh, i, 0))
+    mask_spec = pl.BlockSpec((1, 1, block_k), lambda bh, i, j: (bh, 0, j))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, causal=causal, block_q=block_q, block_k=block_k,
+            scale=scale,
+        ),
+        grid=(b * h, l // block_q, l // block_k),
+        in_specs=[
+            qkv_spec("q"), qkv_spec("k"), qkv_spec("k"), qkv_spec("q"),
+            row_spec, row_spec, mask_spec,
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, l, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, dvec, maskf)
+
+    # dK/dV: kv blocks own the (sequential) second grid dim, q streams third.
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0))
+    row_spec2 = pl.BlockSpec((1, block_q, 1), lambda bh, j, i: (bh, i, 0))
+    mask_spec2 = pl.BlockSpec((1, 1, block_k), lambda bh, j, i: (bh, 0, j))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, causal=causal, block_q=block_q, block_k=block_k,
+            scale=scale,
+        ),
+        grid=(b * h, l // block_k, l // block_q),
+        in_specs=[
+            q_spec, kv_spec, kv_spec, q_spec, row_spec2, row_spec2, mask_spec2,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, l, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, l, d), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, dvec, maskf)
+
+    def unfold(x):
+        return x.reshape(b, h, l, d).transpose(0, 2, 1, 3)
+
+    return unfold(dq), unfold(dk), unfold(dv)
+
+
+# ------------------------------------------------------------------ custom_vjp
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _flash(q, k, v, kv_mask, causal, block_q, block_k, interpret):
-    return _flash_forward(
+    out, _ = _flash_forward(
         q, k, v, kv_mask, causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret,
     )
+    return out
 
 
 def _flash_fwd(q, k, v, kv_mask, causal, block_q, block_k, interpret):
-    out = _flash_forward(
+    out, lse = _flash_forward(
         q, k, v, kv_mask, causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret,
     )
-    return out, (q, k, v, kv_mask)
+    return out, (q, k, v, kv_mask, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
-    # Recompute-based backward: XLA re-derives attention and differentiates;
-    # nothing O(L²) was saved from the forward.
-    from tpu_pipelines.parallel.ring_attention import dense_attention
-
-    q, k, v, kv_mask = residuals
-
-    def ref(q, k, v):
-        return dense_attention(q, k, v, causal=causal, kv_mask=kv_mask)
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    dq, dk, dv = vjp(g)
+    q, k, v, kv_mask, o, lse = residuals
+    dq, dk, dv = _flash_backward(
+        q, k, v, kv_mask, o, lse, g, causal=causal, block_q=block_q,
+        block_k=block_k, interpret=interpret,
+    )
     # int mask gets a float0 cotangent (JAX's "no gradient" for int inputs)
     import numpy as np
 
@@ -159,7 +358,7 @@ def flash_attention(
     block_k: int = 128,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
-    """Self-attention over [batch, len, heads, head_dim] via the kernel.
+    """Self-attention over [batch, len, heads, head_dim] via the kernels.
 
     Numerically equals ``dense_attention`` (same masking semantics, modulo
     rows whose whole allowed key set is empty: dense leaves them uniform,

@@ -69,11 +69,9 @@ def _schema_dtypes(schema: Schema) -> Dict[str, str]:
 
 
 def _stable_hash_strings(values: np.ndarray, buckets: int) -> np.ndarray:
-    out = np.empty(len(values), dtype=np.int32)
-    for i, v in enumerate(values):
-        h = hashlib.blake2b(str(v).encode("utf-8"), digest_size=8).digest()
-        out[i] = int.from_bytes(h, "little") % buckets
-    return out
+    from tpu_pipelines.utils.hashing import hash_buckets
+
+    return hash_buckets(values, buckets).astype(np.int32)
 
 
 class TransformGraph:
@@ -118,26 +116,125 @@ class TransformGraph:
     # ------------------------------------------------------------ analysis
 
     def analyze(self, data: Dict[str, np.ndarray]) -> None:
-        """One topological full pass; resolves every analyzer's state.
+        """Full-pass analysis of an in-memory dataset (single chunk)."""
+        self.analyze_chunks(lambda: iter([data]))
 
-        Nested analyzers (z-score of a bucketized column, ...) resolve in the
-        same pass because evaluation is node-by-node over full columns —
-        the tf.Transform multi-phase problem disappears.
+    def analyze_chunks(
+        self,
+        chunks_fn: Callable[[], Any],
+        on_chip: Optional[bool] = None,
+    ) -> None:
+        """Resolve every analyzer by streaming chunks — the Beam-less
+        full pass (SURVEY.md §3.4): per-chunk partial states accumulate and
+        merge, so no column is ever materialized whole.
+
+        ``chunks_fn()`` returns a fresh iterator of dict-of-numpy chunks per
+        pass.  Nested analyzers (z-score of a bucketized column) resolve in
+        multiple passes: pass k handles analyzers whose upstream analyzers
+        resolved in passes < k — the tf.Transform phase structure.
+
+        ``on_chip``: numeric accumulators (moments, min/max) run as jitted
+        reductions on the default jax device; None = auto (on when a TPU
+        backend is present), False = pure numpy.
         """
-        self._eval(data, np, analyzing=True)
+        if on_chip is None:
+            on_chip = _tpu_present()
+        upstream_analyzers = self._upstream_analyzers()
+        guard = 0
+        while True:
+            unresolved = [
+                n for n in self.nodes
+                if n.op in OPS and OPS[n.op].is_analyzer
+                and n.id not in self.state
+            ]
+            if not unresolved:
+                break
+            ready = [
+                n for n in unresolved
+                if all(
+                    a in self.state for a in upstream_analyzers[n.id]
+                    if a != n.id
+                )
+            ]
+            if not ready:
+                raise RuntimeError(
+                    "analyzer dependency cycle: "
+                    f"{[n.op for n in unresolved]}"
+                )
+            # Analyzers whose state is derivable without data (vocab files).
+            pending = []
+            for node in ready:
+                st = _finalize_dataless(node)
+                if st is not None:
+                    self.state[node.id] = st
+                else:
+                    pending.append(node)
+            if not pending:
+                guard += 1
+                if guard > len(self.nodes) + 1:
+                    raise RuntimeError("analysis did not converge")
+                continue
+            # One streaming pass accumulating all pending-ready analyzers.
+            accs = {n.id: _acc_init(n) for n in pending}
+            needed = [n.id for n in pending]
+            for chunk in chunks_fn():
+                vals = self._eval_available(chunk, needed)
+                for node in pending:
+                    arg = vals[ref_id(node.inputs[0])]
+                    accs[node.id] = _acc_update(
+                        node, accs[node.id], arg, on_chip
+                    )
+            for node in pending:
+                self.state[node.id] = _acc_finalize(node, accs[node.id])
+
+    def _upstream_analyzers(self) -> Dict[int, set]:
+        """Per node: ids of analyzer nodes among its ancestors (and itself's
+        direct analyzer inputs) — the phase-ordering relation."""
+        up: Dict[int, set] = {}
+        for node in self.nodes:  # nodes are already topologically ordered
+            s: set = set()
+            for a in node.inputs:
+                if is_ref(a):
+                    aid = ref_id(a)
+                    s |= up[aid]
+                    if OPS.get(self.nodes[aid].op) and OPS[self.nodes[aid].op].is_analyzer:
+                        s.add(aid)
+            up[node.id] = s
+        return up
+
+    def _eval_available(
+        self, data: Dict[str, Any], target_ids: List[int]
+    ) -> Dict[int, Any]:
+        """Evaluate just the nodes feeding ``target_ids``'s inputs, using
+        resolved analyzer states only (callers guarantee reachability)."""
+        need: set = set()
+        stack = [
+            ref_id(a)
+            for t in target_ids
+            for a in self.nodes[t].inputs if is_ref(a)
+        ]
+        while stack:
+            nid = stack.pop()
+            if nid in need:
+                continue
+            need.add(nid)
+            stack.extend(
+                ref_id(a) for a in self.nodes[nid].inputs if is_ref(a)
+            )
+        subset = [n.id for n in self.nodes if n.id in need]
+        return self._eval(data, np, subset=subset)
 
     # ---------------------------------------------------------- evaluation
 
     def apply_host(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         """Vectorized numpy evaluation (materialization / host fallback)."""
-        vals = self._eval(batch, np, analyzing=False)
+        vals = self._eval(batch, np)
         return {name: vals[nid] for name, nid in self.outputs.items()}
 
     def _eval(
         self,
         data: Dict[str, Any],
         xp,
-        analyzing: bool,
         subset: Optional[List[int]] = None,
         preset: Optional[Dict[int, Any]] = None,
     ) -> Dict[int, Any]:
@@ -162,12 +259,10 @@ class TransformGraph:
             opdef = OPS[node.op]
             if opdef.is_analyzer:
                 if node.id not in self.state:
-                    if not analyzing:
-                        raise RuntimeError(
-                            f"analyzer node #{node.id} ({node.op}) has no "
-                            "state; run analyze() first"
-                        )
-                    self.state[node.id] = _compute_state(node, args[0])
+                    raise RuntimeError(
+                        f"analyzer node #{node.id} ({node.op}) has no "
+                        "state; run analyze() first"
+                    )
                 vals[node.id] = _apply_analyzer(
                     node, self.state[node.id], args[0], xp
                 )
@@ -237,7 +332,7 @@ class TransformGraph:
 
             preset = {nid: iface[f"c{nid}"] for nid in iface_ids}
             vals = self._eval(
-                {}, jnp, analyzing=False, subset=device_subset, preset=preset
+                {}, jnp, subset=device_subset, preset=preset
             )
             return {name: vals[nid] for name, nid in self.outputs.items()}
 
@@ -362,29 +457,182 @@ class TransformGraph:
 # ---------------------------------------------------------------- operators
 
 
-def _compute_state(node: Node, col: np.ndarray) -> Dict[str, Any]:
-    """Full-pass analyzer state from a materialized column."""
+def _tpu_present() -> bool:
+    try:
+        import jax
+
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+_MOMENTS_JIT = None
+_MINMAX_JIT = None
+
+
+def _moments_chunk(col, on_chip: bool):
+    """(count, sum, sum_sq) over non-NaN values of one chunk.
+
+    On-chip: one jitted tree-reduction (sum/sum-of-squares on the device —
+    the SURVEY §3.4 "analyzers as jitted reductions"); numpy in f64 otherwise.
+    """
+    if on_chip:
+        global _MOMENTS_JIT
+        import jax
+        import jax.numpy as jnp
+
+        if _MOMENTS_JIT is None:
+            @jax.jit
+            def _kernel(x):
+                ok = ~jnp.isnan(x)
+                xz = jnp.where(ok, x, 0.0)
+                return (
+                    jnp.sum(ok.astype(jnp.float32)),
+                    jnp.sum(xz),
+                    jnp.sum(xz * xz),
+                )
+
+            _MOMENTS_JIT = _kernel
+        c, s, ss = _MOMENTS_JIT(
+            jnp.asarray(np.asarray(col, np.float32).ravel())
+        )
+        return float(c), float(s), float(ss)
+    x = np.asarray(col, np.float64).ravel()
+    x = x[~np.isnan(x)]
+    return float(len(x)), float(x.sum()), float((x * x).sum())
+
+
+def _minmax_chunk(col, on_chip: bool):
+    """(count, min, max) over non-NaN values of one chunk."""
+    if on_chip:
+        global _MINMAX_JIT
+        import jax
+        import jax.numpy as jnp
+
+        if _MINMAX_JIT is None:
+            @jax.jit
+            def _kernel(x):
+                ok = ~jnp.isnan(x)
+                return (
+                    jnp.sum(ok.astype(jnp.float32)),
+                    jnp.min(jnp.where(ok, x, jnp.inf)),
+                    jnp.max(jnp.where(ok, x, -jnp.inf)),
+                )
+
+            _MINMAX_JIT = _kernel
+        c, lo, hi = _MINMAX_JIT(
+            jnp.asarray(np.asarray(col, np.float32).ravel())
+        )
+        return float(c), float(lo), float(hi)
+    x = np.asarray(col, np.float64).ravel()
+    x = x[~np.isnan(x)]
+    if not len(x):
+        return 0.0, np.inf, -np.inf
+    return float(len(x)), float(x.min()), float(x.max())
+
+
+# Mergeable quantile summary for bucketize: raw values accumulate until the
+# buffer exceeds _SKETCH_COMPRESS, then compress to _SKETCH_SIZE weighted
+# quantile points.  Uncompressed summaries finalize through np.quantile
+# exactly, so small datasets match the in-memory semantics bit-for-bit.
+_SKETCH_SIZE = 2048
+_SKETCH_COMPRESS = 8192
+
+
+def _weighted_quantile(values, weights, qs):
+    order = np.argsort(values, kind="stable")
+    v, w = values[order], weights[order]
+    cw = (np.cumsum(w) - 0.5 * w) / w.sum()
+    return np.interp(qs, cw, v)
+
+
+def _sketch_add(sk: Dict[str, Any], vals: np.ndarray) -> Dict[str, Any]:
+    if len(vals):
+        sk["values"] = np.concatenate([sk["values"], vals])
+        sk["weights"] = np.concatenate(
+            [sk["weights"], np.ones(len(vals), np.float64)]
+        )
+    if len(sk["values"]) > _SKETCH_COMPRESS:
+        total = sk["weights"].sum()
+        qs = (np.arange(_SKETCH_SIZE) + 0.5) / _SKETCH_SIZE
+        sk["values"] = _weighted_quantile(sk["values"], sk["weights"], qs)
+        sk["weights"] = np.full(
+            _SKETCH_SIZE, total / _SKETCH_SIZE, np.float64
+        )
+        sk["compressed"] = True
+    return sk
+
+
+def _acc_init(node: Node) -> Dict[str, Any]:
     if node.op == "z_score":
-        vals = np.asarray(col, dtype=np.float64)
-        vals = vals[~np.isnan(vals)]
-        std = float(np.std(vals)) if len(vals) else 1.0
-        return {
-            "mean": float(np.mean(vals)) if len(vals) else 0.0,
-            "std": std if std > 0 else 1.0,
-        }
+        return {"count": 0.0, "sum": 0.0, "sumsq": 0.0}
     if node.op == "scale_to_0_1":
-        vals = np.asarray(col, dtype=np.float64)
-        vals = vals[~np.isnan(vals)]
-        lo = float(np.min(vals)) if len(vals) else 0.0
-        hi = float(np.max(vals)) if len(vals) else 1.0
+        return {"count": 0.0, "min": np.inf, "max": -np.inf}
+    if node.op in ("vocab_apply", "tokenize"):
+        return {"counts": {}}
+    if node.op == "bucketize":
+        return {
+            "values": np.zeros(0, np.float64),
+            "weights": np.zeros(0, np.float64),
+            "compressed": False,
+        }
+    raise ValueError(f"unknown analyzer {node.op!r}")
+
+
+def _acc_update(
+    node: Node, acc: Dict[str, Any], col, on_chip: bool
+) -> Dict[str, Any]:
+    if node.op == "z_score":
+        c, s, ss = _moments_chunk(col, on_chip)
+        acc["count"] += c
+        acc["sum"] += s
+        acc["sumsq"] += ss
+        return acc
+    if node.op == "scale_to_0_1":
+        c, lo, hi = _minmax_chunk(col, on_chip)
+        acc["count"] += c
+        acc["min"] = min(acc["min"], lo)
+        acc["max"] = max(acc["max"], hi)
+        return acc
+    if node.op == "vocab_apply":
+        uniq, counts = np.unique(_stringify_column(col), return_counts=True)
+        merged = acc["counts"]
+        for term, cnt in zip(uniq, counts):
+            merged[str(term)] = merged.get(str(term), 0) + int(cnt)
+        return acc
+    if node.op == "bucketize":
+        vals = np.asarray(col, np.float64).ravel()
+        _sketch_add(acc, vals[~np.isnan(vals)])
+        return acc
+    if node.op == "tokenize":
+        counts = acc["counts"]
+        lowercase = node.params.get("lowercase", True)
+        for text in col:
+            for tok in _pretokenize(text, lowercase):
+                counts[tok] = counts.get(tok, 0) + 1
+        return acc
+    raise ValueError(f"unknown analyzer {node.op!r}")
+
+
+def _acc_finalize(node: Node, acc: Dict[str, Any]) -> Dict[str, Any]:
+    p = node.params
+    if node.op == "z_score":
+        c = acc["count"]
+        if not c:
+            return {"mean": 0.0, "std": 1.0}
+        mean = acc["sum"] / c
+        var = max(0.0, acc["sumsq"] / c - mean * mean)
+        std = var ** 0.5
+        return {"mean": mean, "std": std if std > 0 else 1.0}
+    if node.op == "scale_to_0_1":
+        if not acc["count"]:
+            return {"min": 0.0, "max": 1.0}
+        lo, hi = acc["min"], acc["max"]
         return {"min": lo, "max": hi if hi > lo else lo + 1.0}
     if node.op == "vocab_apply":
-        p = node.params
-        if col.dtype == object or col.dtype.kind in ("U", "S"):
-            strs = np.asarray([str(v) for v in col])
-        else:
-            strs = np.asarray([str(int(v)) for v in np.asarray(col).ravel()])
-        uniq, counts = np.unique(strs, return_counts=True)
+        terms = acc["counts"]
+        uniq = np.asarray(sorted(terms), dtype=object)
+        counts = np.asarray([terms[t] for t in uniq], np.int64)
         if p.get("frequency_threshold", 0):
             keep = counts >= p["frequency_threshold"]
             uniq, counts = uniq[keep], counts[keep]
@@ -395,34 +643,47 @@ def _compute_state(node: Node, col: np.ndarray) -> Dict[str, Any]:
             vocab = vocab[: p["top_k"]]
         return {"vocab": vocab}
     if node.op == "bucketize":
-        num_buckets = node.params["num_buckets"]
-        vals = np.asarray(col, dtype=np.float64)
-        vals = vals[~np.isnan(vals)]
-        qs = np.linspace(0, 1, num_buckets + 1)[1:-1]
-        boundaries = np.quantile(vals, qs) if len(vals) else np.zeros(0)
+        qs = np.linspace(0, 1, p["num_buckets"] + 1)[1:-1]
+        if not len(acc["values"]):
+            return {"boundaries": np.zeros(0)}
+        if acc["compressed"]:
+            boundaries = _weighted_quantile(
+                acc["values"], acc["weights"], qs
+            )
+        else:
+            boundaries = np.quantile(acc["values"], qs)
         return {"boundaries": np.unique(boundaries)}
     if node.op == "tokenize":
-        p = node.params
-        if p.get("vocab_file"):
-            with open(p["vocab_file"]) as f:
-                vocab = [line.rstrip("\n") for line in f if line.rstrip("\n")]
-            missing = [t for t in SPECIAL_TOKENS if t not in vocab]
-            if missing:
-                raise ValueError(
-                    f"tokenize vocab_file {p['vocab_file']!r} lacks special "
-                    f"tokens {missing}; the ids-0-3 = [PAD]/[UNK]/[CLS]/[SEP] "
-                    "contract requires them"
-                )
-            return {"vocab": vocab}
-        counts: Dict[str, int] = {}
-        for text in col:
-            for tok in _pretokenize(text, p.get("lowercase", True)):
-                counts[tok] = counts.get(tok, 0) + 1
+        counts = acc["counts"]
         # descending frequency, then lexical — deterministic
         terms = sorted(counts, key=lambda t: (-counts[t], t))
         budget = max(0, int(p.get("vocab_size", 8000)) - len(SPECIAL_TOKENS))
         return {"vocab": list(SPECIAL_TOKENS) + terms[:budget]}
     raise ValueError(f"unknown analyzer {node.op!r}")
+
+
+def _finalize_dataless(node: Node) -> Optional[Dict[str, Any]]:
+    """State derivable without a data pass (tokenize with a fixed vocab)."""
+    if node.op == "tokenize" and node.params.get("vocab_file"):
+        with open(node.params["vocab_file"]) as f:
+            vocab = [line.rstrip("\n") for line in f if line.rstrip("\n")]
+        missing = [t for t in SPECIAL_TOKENS if t not in vocab]
+        if missing:
+            raise ValueError(
+                f"tokenize vocab_file {node.params['vocab_file']!r} lacks "
+                f"special tokens {missing}; the ids-0-3 = "
+                "[PAD]/[UNK]/[CLS]/[SEP] contract requires them"
+            )
+        return {"vocab": vocab}
+    return None
+
+
+def _stringify_column(col) -> np.ndarray:
+    """Column → unicode array, vectorized (ints stringify like str(int))."""
+    col = np.asarray(col)
+    if col.dtype == object or col.dtype.kind in ("U", "S"):
+        return np.asarray(col, dtype="U")
+    return col.ravel().astype(np.int64).astype("U")
 
 
 SPECIAL_TOKENS = ("[PAD]", "[UNK]", "[CLS]", "[SEP]")
@@ -464,23 +725,18 @@ def _wordpiece(tok: str, table: Dict[str, int], unk: int) -> List[int]:
     return ids
 
 
-def _apply_tokenize(node: Node, state: Dict[str, Any], col) -> np.ndarray:
-    p = node.params
-    vocab = state["vocab"]
-    # Memoized on the state dict: predict() re-enters here per batch.
-    table = state.get("_table")
-    if table is None:
-        table = state["_table"] = {v: i for i, v in enumerate(vocab)}
-        state["_has_wordpiece"] = any(v.startswith("##") for v in vocab)
-    has_wordpiece = state["_has_wordpiece"]
+def _tokenize_core(
+    col, params: Dict[str, Any], table: Dict[str, int], has_wordpiece: bool
+) -> np.ndarray:
     unk = table.get("[UNK]", 1)
     cls_id = table.get("[CLS]", 2)
     sep_id = table.get("[SEP]", 3)
-    max_len = int(p["max_len"])
+    max_len = int(params["max_len"])
+    lowercase = params.get("lowercase", True)
     out = np.zeros((len(col), max_len), dtype=np.int32)  # 0 = [PAD]
     for i, text in enumerate(col):
         ids = [cls_id]
-        for tok in _pretokenize(text, p.get("lowercase", True)):
+        for tok in _pretokenize(text, lowercase):
             if has_wordpiece:
                 ids.extend(_wordpiece(tok, table, unk))
             else:
@@ -492,6 +748,56 @@ def _apply_tokenize(node: Node, state: Dict[str, Any], col) -> np.ndarray:
     return out
 
 
+# Worker-process state for pool-parallel tokenization: the vocab table ships
+# once per worker (pool initializer), chunks ship only their rows.
+_TOK_CTX: Optional[Tuple[Dict[str, Any], Dict[str, int], bool]] = None
+_TOK_MIN_PARALLEL_ROWS = 4096
+_TOK_MAX_WORKERS = 8
+
+
+def _tok_init(params: Dict[str, Any], vocab: List[str]) -> None:
+    global _TOK_CTX
+    table = {v: i for i, v in enumerate(vocab)}
+    _TOK_CTX = (params, table, any(v.startswith("##") for v in vocab))
+
+
+def _tok_chunk(rows) -> np.ndarray:
+    params, table, has_wordpiece = _TOK_CTX
+    return _tokenize_core(rows, params, table, has_wordpiece)
+
+
+def _apply_tokenize(node: Node, state: Dict[str, Any], col) -> np.ndarray:
+    """Tokenize a column; large columns fan out over a process pool.
+
+    The wordpiece loop is irreducibly per-row Python, which is exactly what
+    the reference ran embarrassingly-parallel under Beam (SURVEY.md §2b) —
+    here a ProcessPoolExecutor plays that role for the host stage.
+    """
+    p = node.params
+    vocab = state["vocab"]
+    # Memoized on the state dict: predict() re-enters here per batch.
+    table = state.get("_table")
+    if table is None:
+        table = state["_table"] = {v: i for i, v in enumerate(vocab)}
+        state["_has_wordpiece"] = any(v.startswith("##") for v in vocab)
+    has_wordpiece = state["_has_wordpiece"]
+
+    import os as _os
+
+    workers = min(_os.cpu_count() or 1, _TOK_MAX_WORKERS)
+    if len(col) >= _TOK_MIN_PARALLEL_ROWS and workers > 1:
+        from concurrent.futures import ProcessPoolExecutor
+
+        chunks = [c for c in np.array_split(col, workers * 4) if len(c)]
+        with ProcessPoolExecutor(
+            max_workers=workers, initializer=_tok_init,
+            initargs=(dict(p), list(vocab)),
+        ) as ex:
+            parts = list(ex.map(_tok_chunk, chunks))
+        return np.concatenate(parts, axis=0)
+    return _tokenize_core(col, p, table, has_wordpiece)
+
+
 def _apply_analyzer(node: Node, state: Dict[str, Any], col, xp):
     if node.op == "z_score":
         x = xp.asarray(col, dtype=xp.float32)
@@ -501,26 +807,32 @@ def _apply_analyzer(node: Node, state: Dict[str, Any], col, xp):
         lo, hi = float(state["min"]), float(state["max"])
         return (x - lo) / (hi - lo)
     if node.op == "vocab_apply":
-        # Host-only (consumes strings / stringified ints).
+        # Host-only (consumes strings / stringified ints).  Vectorized:
+        # binary search over the sorted vocab, FNV bucketing for OOV rows —
+        # no per-row Python loop (the Beam-parallelism replacement).
         assert xp is np, "vocab_apply must run host-side"
         vocab = state["vocab"]
-        table = {v: i for i, v in enumerate(vocab)}
         num_oov = node.params.get("num_oov_buckets", 1) or 0
-        col = np.asarray(col)
-        if col.dtype == object or col.dtype.kind in ("U", "S"):
-            strs = [str(v) for v in col]
-        else:
-            strs = [str(int(v)) for v in col.ravel()]
-        out = np.empty(len(strs), dtype=np.int32)
-        for i, s in enumerate(strs):
-            idx = table.get(s)
-            if idx is None:
-                if num_oov > 0:
-                    h = hashlib.blake2b(s.encode(), digest_size=8).digest()
-                    idx = len(vocab) + int.from_bytes(h, "little") % num_oov
-                else:
-                    idx = -1
-            out[i] = idx
+        strs = _stringify_column(col)
+        sorted_vocab = state.get("_sorted_vocab")
+        if sorted_vocab is None:
+            vocab_arr = np.asarray(vocab, dtype="U")
+            order = np.argsort(vocab_arr, kind="stable")
+            sorted_vocab = state["_sorted_vocab"] = vocab_arr[order]
+            state["_sorted_order"] = order
+        order = state["_sorted_order"]
+        pos = np.searchsorted(sorted_vocab, strs)
+        pos_c = np.minimum(pos, len(sorted_vocab) - 1)
+        found = (
+            (sorted_vocab[pos_c] == strs) if len(sorted_vocab)
+            else np.zeros(len(strs), bool)
+        )
+        out = np.where(found, order[pos_c], -1).astype(np.int32)
+        if num_oov > 0 and not found.all():
+            from tpu_pipelines.utils.hashing import hash_buckets
+
+            oov = hash_buckets(strs[~found], num_oov) + len(vocab)
+            out[~found] = oov.astype(np.int32)
         return out
     if node.op == "bucketize":
         boundaries = xp.asarray(state["boundaries"], dtype=xp.float32)
