@@ -241,3 +241,73 @@ def test_multihost_bootstrap_two_processes(tmp_path):
         out, err = proc.communicate(timeout=180)
         assert proc.returncode == 0, f"worker {pid}: {err[-2000:]}"
         assert f"worker {pid} OK" in out
+
+
+def _tuner_fanout_module(tmp_path):
+    csv = tmp_path / "data.csv"
+    csv.write_text("a,b\n" + "\n".join(f"{i},{i*2}" for i in range(12)) + "\n")
+    trainer_mod = tmp_path / "toy_tuner_trainer.py"
+    trainer_mod.write_text(textwrap.dedent("""
+        from tpu_pipelines.trainer.fn_args import TrainResult
+        def run_fn(fn_args):
+            x = fn_args.hyperparameters["x"]
+            return TrainResult(final_metrics={"loss": float(x * x)})
+    """))
+    mod = tmp_path / "tuner_pipeline.py"
+    mod.write_text(textwrap.dedent(f"""
+        from tpu_pipelines.components import CsvExampleGen, Tuner
+        from tpu_pipelines.dsl.pipeline import Pipeline
+
+        def create_pipeline():
+            gen = CsvExampleGen(input_path={str(csv)!r})
+            tuner = Tuner(
+                examples=gen.outputs["examples"],
+                module_file={str(trainer_mod)!r},
+                search_space={{"x": [1, 2, 3, 4, 5, 6]}},
+                train_steps=1,
+                trial_shards=3,
+            )
+            return Pipeline(
+                "tuner-fanout", [tuner],
+                pipeline_root="/pipeline/root",
+                metadata_path="/pipeline/md.sqlite",
+            )
+    """))
+    return str(mod)
+
+
+def test_tuner_trial_shards_in_workflow(tmp_path):
+    """trial_shards=k emits k trial pods between upstreams and the merge node."""
+    from tpu_pipelines.orchestration import TPUJobRunner, TPUJobRunnerConfig
+    from tpu_pipelines.utils.module_loader import load_fn
+
+    mod = _tuner_fanout_module(tmp_path)
+    pipeline = load_fn(mod, "create_pipeline")()
+    out = TPUJobRunner(TPUJobRunnerConfig(
+        image="img:latest",
+        pipeline_module="/app/tuner_pipeline.py",
+        output_dir=str(tmp_path / "manifests"),
+        shared_volume_claim="shared-pvc",
+    )).run(pipeline)
+
+    with open(out["workflow"]) as f:
+        wf = yaml.safe_load(f)
+    templates = {t["name"]: t for t in wf["spec"]["templates"]}
+    tasks = {t["name"]: t for t in templates["pipeline-dag"]["dag"]["tasks"]}
+
+    trial_names = [f"tuner-trial-{i}" for i in range(3)]
+    for i, tn in enumerate(trial_names):
+        # DAG: each trial runs after the tuner's upstreams...
+        assert tasks[tn]["dependencies"] == ["csvexamplegen"]
+        cmd = templates[tn]["container"]["command"]
+        assert cmd[:4] == ["python", "-m", "tpu_pipelines.components.tuner_trial", "shard"]
+        assert f"{i}/3" in cmd
+        assert "--node-id" in cmd and "Tuner" in cmd
+        assert "/pipeline/root/.tuner_shards/Tuner" in cmd
+        # trials train: TPU nodes, shared volume mounted
+        assert templates[tn]["nodeSelector"]
+        assert templates[tn]["container"]["volumeMounts"]
+    # ...and the merging tuner node runs after every trial.
+    assert tasks["tuner"]["dependencies"] == sorted(["csvexamplegen"] + trial_names)
+    env = {e["name"]: e["value"] for e in templates["tuner"]["container"]["env"]}
+    assert env["TPP_TUNER_SHARD_DIR"] == "/pipeline/root/.tuner_shards/Tuner"

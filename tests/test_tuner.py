@@ -121,3 +121,173 @@ def test_tuner_feeds_trainer(tmp_path):
     with open(os.path.join(model_uri, "hp.json")) as f:
         seen = json.load(f)
     assert seen["x"] == 3
+
+
+def _timed_module(tmp_path, sleep_s=5.0):
+    """run_fn that records start/end stamps, sleeps, and hard-crashes on x=13."""
+    mod = tmp_path / "timed_trainer.py"
+    mod.write_text(
+        "import os, time\n"
+        "from tpu_pipelines.trainer.fn_args import TrainResult\n"
+        "def run_fn(fn_args):\n"
+        "    hp = fn_args.hyperparameters\n"
+        "    if hp['x'] == 13:\n"
+        "        os._exit(17)  # simulated OOM/segfault: no cleanup, no trace\n"
+        "    d = os.path.dirname(fn_args.serving_model_dir)\n"
+        "    os.makedirs(d, exist_ok=True)\n"
+        "    with open(os.path.join(d, 'start.txt'), 'w') as f:\n"
+        "        f.write(repr(time.time()))\n"
+        f"    time.sleep({sleep_s})\n"
+        "    with open(os.path.join(d, 'end.txt'), 'w') as f:\n"
+        "        f.write(repr(time.time()))\n"
+        "    return TrainResult(final_metrics={'loss': float((hp['x'] - 3) ** 2)},\n"
+        "                       steps_completed=1)\n"
+    )
+    return str(mod)
+
+
+def test_parallel_trials_overlap_and_crash_isolation(tmp_path):
+    """N subprocess trials overlap; one hard-crashing trial fails alone."""
+    from tpu_pipelines.components import Tuner
+    from tpu_pipelines.dsl.pipeline import Pipeline
+    from tpu_pipelines.orchestration import LocalDagRunner
+
+    tuner = Tuner(
+        examples=_examples_gen(tmp_path).outputs["examples"],
+        module_file=_timed_module(tmp_path),
+        search_space={"x": [3, 5, 13]},
+        train_steps=1,
+        parallel_trials=3,
+    )
+    p = Pipeline(
+        "tune-par", [tuner],
+        pipeline_root=str(tmp_path / "root"),
+        metadata_path=str(tmp_path / "md.sqlite"),
+    )
+    result = LocalDagRunner().run(p)
+    assert result.succeeded
+
+    hp_uri = result.outputs_of("Tuner", "best_hyperparameters")[0].uri
+    with open(os.path.join(hp_uri, "trials.json")) as f:
+        trials = json.load(f)
+    assert len(trials) == 3
+    by_x = {t["hyperparameters"]["x"]: t for t in trials}
+    assert by_x[13]["status"] == "failed"
+    assert "rc=17" in by_x[13]["error"]
+    assert by_x[3]["status"] == by_x[5]["status"] == "ok"
+    with open(os.path.join(hp_uri, "best_hyperparameters.json")) as f:
+        assert json.load(f) == {"x": 3}
+
+    # Concurrency proof: both surviving trials' [start, end] windows overlap
+    # (each sleeps far longer than subprocess startup skew).
+    stamps = {}
+    for t in (0, 1):
+        d = os.path.join(hp_uri, "trials", str(t))
+        with open(os.path.join(d, "start.txt")) as f:
+            start = float(f.read())
+        with open(os.path.join(d, "end.txt")) as f:
+            end = float(f.read())
+        stamps[t] = (start, end)
+    assert max(s for s, _ in stamps.values()) < min(e for _, e in stamps.values())
+
+
+def _counting_pipeline_module(tmp_path, trial_shards=2):
+    """create_pipeline() module: ExampleGen -> Tuner over a counting run_fn."""
+    csv = tmp_path / "data.csv"
+    csv.write_text("a,b\n" + "\n".join(f"{i},{i * 2}" for i in range(12)) + "\n")
+    counter = tmp_path / "invocations.log"
+    trainer = tmp_path / "count_trainer.py"
+    trainer.write_text(
+        "import os\n"
+        "from tpu_pipelines.trainer.fn_args import TrainResult\n"
+        "def run_fn(fn_args):\n"
+        "    hp = fn_args.hyperparameters\n"
+        f"    with open({str(counter)!r}, 'a') as f:\n"
+        "        f.write(f\"{hp['x']}\\n\")\n"
+        "    return TrainResult(final_metrics={'loss': float((hp['x'] - 3) ** 2)},\n"
+        "                       steps_completed=1)\n"
+    )
+    mod = tmp_path / "tune_pipeline.py"
+    mod.write_text(
+        "from tpu_pipelines.components import CsvExampleGen, Tuner\n"
+        "from tpu_pipelines.dsl.pipeline import Pipeline\n"
+        "def create_pipeline():\n"
+        f"    gen = CsvExampleGen(input_path={str(csv)!r})\n"
+        "    tuner = Tuner(\n"
+        "        examples=gen.outputs['examples'],\n"
+        f"        module_file={str(trainer)!r},\n"
+        "        search_space={'x': [0, 2, 3, 5]},\n"
+        "        train_steps=1,\n"
+        f"        trial_shards={trial_shards},\n"
+        "    )\n"
+        "    return Pipeline(\n"
+        "        'tune-shards', [tuner],\n"
+        f"        pipeline_root={str(tmp_path / 'root')!r},\n"
+        f"        metadata_path={str(tmp_path / 'md.sqlite')!r},\n"
+        "    )\n"
+    )
+    return str(mod), str(counter)
+
+
+def test_shard_fanout_then_merge(tmp_path, monkeypatch):
+    """Cluster trial-shard protocol: shard CLIs score candidates[i::k] from
+    the shared store, the Tuner node merges without re-running any trial."""
+    from tpu_pipelines.components.tuner_trial import main as trial_main
+    from tpu_pipelines.orchestration import LocalDagRunner
+    from tpu_pipelines.utils.module_loader import load_fn
+
+    mod, counter = _counting_pipeline_module(tmp_path)
+    # 1. upstream publishes Examples to the shared store (Argo dependency).
+    pipeline = load_fn(mod, "create_pipeline")()
+    LocalDagRunner().run(pipeline, to_nodes=["CsvExampleGen"])
+
+    # 2. two shard pods score their slices.
+    shard_dir = str(tmp_path / "shards")
+    for shard in ("0/2", "1/2"):
+        assert trial_main([
+            "shard", "--pipeline-module", mod, "--node-id", "Tuner",
+            "--shard", shard, "--shard-dir", shard_dir,
+        ]) == 0
+    with open(counter) as f:
+        assert sorted(f.read().split()) == ["0", "2", "3", "5"]
+
+    # 3. the tuner node merges shard scores; zero trials re-run.
+    monkeypatch.setenv("TPP_TUNER_SHARD_DIR", shard_dir)
+    pipeline2 = load_fn(mod, "create_pipeline")()
+    result = LocalDagRunner().run(pipeline2)
+    assert result.succeeded
+    with open(counter) as f:
+        assert len(f.read().split()) == 4  # unchanged: all reused
+
+    hp_uri = result.outputs_of("Tuner", "best_hyperparameters")[0].uri
+    with open(os.path.join(hp_uri, "best_hyperparameters.json")) as f:
+        assert json.load(f) == {"x": 3}
+    with open(os.path.join(hp_uri, "trials.json")) as f:
+        trials = json.load(f)
+    assert len(trials) == 4 and all(t["status"] == "ok" for t in trials)
+
+
+def test_load_shard_results_rejects_stale_shards(tmp_path):
+    """Leftover shard files from a prior run (other data / other fan-out
+    degree) must not leak scores into the merge."""
+    from tpu_pipelines.components.tuner import (
+        _outcome, load_shard_results, write_shard_results,
+    )
+
+    d = str(tmp_path / "shards")
+    write_shard_results(
+        d, 0, 2, [_outcome(0, {"x": 1}, metrics={"loss": 1.0})],
+        examples_uri="uri-new",
+    )
+    # Stale: same candidate scored on OLD data, and an old 3-way fan-out.
+    write_shard_results(
+        d, 1, 2, [_outcome(1, {"x": 2}, metrics={"loss": 999.0})],
+        examples_uri="uri-old",
+    )
+    write_shard_results(
+        d, 2, 3, [_outcome(2, {"x": 3}, metrics={"loss": 999.0})],
+        examples_uri="uri-new",
+    )
+    got = load_shard_results(d, examples_uri="uri-new", num_shards=2)
+    assert set(got) == {'{"x": 1}'}
+    assert got['{"x": 1}']["metrics"]["loss"] == 1.0

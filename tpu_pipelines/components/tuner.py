@@ -6,26 +6,57 @@ Trainer uses — no separate tuning API — with grid or random candidate
 generation, and the winner is emitted as a ``HyperParameters`` artifact whose
 ``best_hyperparameters.json`` the Trainer merges over its own defaults.
 
-On-chip efficiency note: trials run sequentially in-process, each a fresh
-jit; identical shapes across trials hit XLA's compilation cache, so later
-trials pay only run time.  (Katib's parallel-pod fan-out belongs to the
-cluster runner; the emitted spec can schedule trials as separate TPUJobs.)
+Trial execution modes (the Katib parallel-pod equivalent):
+
+  - in-process sequential (``parallel_trials=1``, default): each trial a
+    fresh jit; identical shapes across trials hit XLA's compilation cache, so
+    later trials pay only run time.
+  - subprocess-isolated (``parallel_trials>1`` or ``isolate_trials=True``):
+    each trial is ``python -m tpu_pipelines.components.tuner_trial`` on a
+    JSON spec, up to ``parallel_trials`` concurrently.  A trial that OOMs or
+    crashes fails *that trial* — the component keeps going and picks the best
+    of the survivors (it only fails when every trial failed).  Concurrency is
+    host-level: on a single TPU chip keep 1 (or give trials
+    ``custom_config`` platform overrides); on CPU or across pods it overlaps.
+  - cluster fan-out (``trial_shards=k``): the TPUJobRunner emits one pod per
+    shard running ``tuner_trial shard --shard i/k`` (candidates[i::k]) into a
+    shared ``--shard-dir``, then the Tuner node itself runs with
+    ``TPP_TUNER_SHARD_DIR`` set, reuses every shard-computed score, runs any
+    stragglers locally, and publishes the merged result — so the metadata
+    store sees exactly one Tuner execution, Katib-style fan-out included.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import glob
 import itertools
 import json
+import logging
 import os
 import random
-from typing import Any, Dict, List
+import subprocess
+import sys
+from typing import Any, Dict, List, Optional
 
 from tpu_pipelines.dsl.component import Parameter, component
-from tpu_pipelines.trainer.fn_args import TrainResult, resolve_fn_args
+from tpu_pipelines.trainer.fn_args import (
+    FnArgs,
+    TrainResult,
+    ctx_data_uris,
+    make_fn_args,
+)
 from tpu_pipelines.utils.module_loader import load_fn, load_module
+
+logger = logging.getLogger(__name__)
 
 BEST_FILE = "best_hyperparameters.json"
 TRIALS_FILE = "trials.json"
+ENV_SHARD_DIR = "TPP_TUNER_SHARD_DIR"
+
+SPEC_FILE = "spec.json"
+RESULT_FILE = "result.json"
+ERROR_FILE = "error.log"
 
 
 def _grid(space: Dict[str, List[Any]]) -> List[Dict[str, Any]]:
@@ -61,6 +92,242 @@ def _space_size(space: Dict[str, List[Any]]) -> int:
     return size
 
 
+def candidate_key(cand: Dict[str, Any]) -> str:
+    return json.dumps(cand, sort_keys=True, default=str)
+
+
+def resolve_search_space(
+    exec_properties: Dict[str, Any], module_file: str
+) -> Dict[str, List[Any]]:
+    space = exec_properties.get("search_space")
+    if not space:
+        space = getattr(load_module(module_file), "SEARCH_SPACE", None)
+    if not space:
+        raise ValueError(
+            "Tuner needs a search_space parameter or a SEARCH_SPACE dict in "
+            f"the module file {module_file!r}"
+        )
+    space = {k: list(v) for k, v in space.items()}
+    empty = sorted(k for k, v in space.items() if not v)
+    if empty:
+        raise ValueError(f"search_space entries have no candidates: {empty}")
+    return space
+
+
+def enumerate_candidates(
+    exec_properties: Dict[str, Any], module_file: str
+) -> List[Dict[str, Any]]:
+    """Deterministic candidate list — identical in every shard/merge process."""
+    space = resolve_search_space(exec_properties, module_file)
+    algorithm = exec_properties.get("algorithm", "grid")
+    max_trials = exec_properties.get("max_trials", 0)
+    if algorithm == "grid":
+        candidates = _grid(space)
+        if max_trials:
+            candidates = candidates[:max_trials]
+    elif algorithm == "random":
+        n = max_trials or min(10, _space_size(space))
+        candidates = _random(space, n, exec_properties.get("seed", 0))
+    else:
+        raise ValueError(f"unknown tuner algorithm {algorithm!r}")
+    if not candidates:
+        raise ValueError(
+            f"tuner produced no candidates (space={space}, "
+            f"max_trials={max_trials})"
+        )
+    return candidates
+
+
+def build_trial_fn_args(
+    *,
+    examples_uri: str,
+    transform_graph_uri: str,
+    schema_uri: str,
+    trial_dir: str,
+    hyperparameters: Dict[str, Any],
+    exec_properties: Dict[str, Any],
+) -> FnArgs:
+    """One trial's FnArgs — shared by the executor and the shard CLI so the
+    run_fn contract cannot drift between local and fanned-out trials."""
+    return make_fn_args(
+        examples_uri=examples_uri,
+        transform_graph_uri=transform_graph_uri,
+        schema_uri=schema_uri,
+        serving_model_dir=os.path.join(trial_dir, "model"),
+        model_run_dir=os.path.join(trial_dir, "model_run"),
+        train_steps=exec_properties.get("train_steps", 100),
+        eval_steps=exec_properties.get("eval_steps", 0),
+        hyperparameters=hyperparameters,
+        mesh=exec_properties.get("mesh"),
+        custom_config=exec_properties.get("custom_config"),
+    )
+
+
+def run_trial(module_file: str, fn_args: FnArgs) -> Dict[str, float]:
+    """Execute one trial in the current process; returns final metrics."""
+    run_fn = load_fn(module_file, "run_fn")
+    result = run_fn(fn_args)
+    if not isinstance(result, TrainResult):
+        raise TypeError(
+            "run_fn must return TrainResult for tuning, got "
+            f"{type(result).__name__}"
+        )
+    return {k: float(v) for k, v in result.final_metrics.items()}
+
+
+# ------------------------------------------------------------ trial outcomes
+
+def _outcome(trial: int, cand: Dict[str, Any], *, metrics=None, error=None):
+    out: Dict[str, Any] = {
+        "trial": trial,
+        "hyperparameters": cand,
+        "status": "ok" if error is None else "failed",
+    }
+    if metrics is not None:
+        out["metrics"] = metrics
+    if error is not None:
+        out["error"] = str(error)[:2000]
+    return out
+
+
+def _run_trials_inprocess(
+    todo: List[int], candidates, module_file, make_fn_args, isolate: bool,
+) -> Dict[int, Dict[str, Any]]:
+    outcomes: Dict[int, Dict[str, Any]] = {}
+    for i in todo:
+        fn_args = make_fn_args(i)
+        if isolate:
+            outcomes[i] = _run_trial_subprocess(
+                i, candidates[i], module_file, fn_args
+            )
+            continue
+        # In-process: a trial crash propagates (legacy strict mode) — the
+        # isolation story lives in the subprocess path.
+        metrics = run_trial(module_file, fn_args)
+        outcomes[i] = _outcome(i, candidates[i], metrics=metrics)
+    return outcomes
+
+
+def _run_trial_subprocess(
+    trial: int, cand: Dict[str, Any], module_file: str, fn_args: FnArgs
+) -> Dict[str, Any]:
+    trial_dir = os.path.dirname(fn_args.serving_model_dir)
+    os.makedirs(trial_dir, exist_ok=True)
+    spec_path = os.path.join(trial_dir, SPEC_FILE)
+    result_path = os.path.join(trial_dir, RESULT_FILE)
+    with open(spec_path, "w") as f:
+        json.dump(
+            {
+                "module_file": module_file,
+                "fn_args": dataclasses.asdict(fn_args),
+                "trial": trial,
+                "result_path": result_path,
+            },
+            f, indent=2, default=str,
+        )
+    with open(os.path.join(trial_dir, ERROR_FILE), "w") as errf:
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_pipelines.components.tuner_trial",
+             "trial", "--spec", spec_path],
+            stdout=errf, stderr=subprocess.STDOUT,
+        )
+    if proc.returncode != 0 or not os.path.exists(result_path):
+        tail = ""
+        try:
+            with open(os.path.join(trial_dir, ERROR_FILE)) as f:
+                tail = f.read()[-2000:]
+        except OSError:
+            pass
+        logger.warning("tuner trial %d failed (rc=%d)", trial, proc.returncode)
+        return _outcome(
+            trial, cand,
+            error=f"subprocess rc={proc.returncode}: {tail or 'no output'}",
+        )
+    with open(result_path) as f:
+        metrics = json.load(f)["final_metrics"]
+    return _outcome(trial, cand, metrics=metrics)
+
+
+def _run_trials_parallel(
+    todo: List[int], candidates, module_file, make_fn_args, parallel: int
+) -> Dict[int, Dict[str, Any]]:
+    """Up to ``parallel`` concurrent subprocess trials (threads just babysit
+    the subprocesses, so the GIL is irrelevant here)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=parallel) as pool:
+        futs = {
+            i: pool.submit(
+                _run_trial_subprocess, i, candidates[i], module_file,
+                make_fn_args(i),
+            )
+            for i in todo
+        }
+        return {i: fut.result() for i, fut in futs.items()}
+
+
+# ------------------------------------------------------------ shard files
+
+def shard_file_path(shard_dir: str, shard: int, num_shards: int) -> str:
+    return os.path.join(shard_dir, f"shard_{shard}_of_{num_shards}.json")
+
+
+def write_shard_results(
+    shard_dir: str, shard: int, num_shards: int,
+    outcomes: List[Dict[str, Any]], *, examples_uri: str = "",
+) -> str:
+    os.makedirs(shard_dir, exist_ok=True)
+    path = shard_file_path(shard_dir, shard, num_shards)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"shard": shard, "num_shards": num_shards,
+                   "examples_uri": examples_uri,
+                   "outcomes": outcomes}, f, indent=2, default=str)
+    os.replace(tmp, path)  # atomic: mergers never see half a shard
+    return path
+
+
+def load_shard_results(
+    shard_dir: str, *, examples_uri: str = "", num_shards: int = 0,
+) -> Dict[str, Dict[str, Any]]:
+    """{candidate_key: outcome} from every *matching* shard file.  Keyed by
+    hyperparameter content, not index, so a shard/merge enumeration mismatch
+    degrades to re-running a trial instead of mis-scoring it.
+
+    The shard dir is a fixed path under pipeline_root, so files from earlier
+    runs (different data, different fan-out degree) can survive there: a
+    shard is reused only when its recorded examples_uri matches this run's
+    resolved Examples artifact (output uris are execution-unique, so changed
+    data means a changed uri) and, when ``num_shards`` is given, its fan-out
+    degree matches.  Mismatches are skipped with a warning — the trials
+    simply re-run locally."""
+    merged: Dict[str, Dict[str, Any]] = {}
+    for path in sorted(glob.glob(os.path.join(shard_dir, "shard_*.json"))):
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            logger.warning("ignoring unreadable tuner shard %s: %s", path, e)
+            continue
+        if num_shards and payload.get("num_shards") != num_shards:
+            logger.warning(
+                "ignoring stale tuner shard %s (fan-out %s, want %d)",
+                path, payload.get("num_shards"), num_shards,
+            )
+            continue
+        if examples_uri and payload.get("examples_uri") != examples_uri:
+            logger.warning(
+                "ignoring stale tuner shard %s (examples %r, want %r)",
+                path, payload.get("examples_uri"), examples_uri,
+            )
+            continue
+        for outcome in payload.get("outcomes", []):
+            merged[candidate_key(outcome["hyperparameters"])] = outcome
+    return merged
+
+
+# ------------------------------------------------------------ component
+
 @component(
     inputs={
         "examples": "Examples",
@@ -85,42 +352,19 @@ def _space_size(space: Dict[str, List[Any]]) -> int:
         "mesh": Parameter(type=dict, default=None),
         "custom_config": Parameter(type=dict, default=None),
         "seed": Parameter(type=int, default=0),
+        # Concurrent subprocess trials (1 = in-process sequential).
+        "parallel_trials": Parameter(type=int, default=1),
+        # Subprocess-isolate even when sequential (crash tolerance).
+        "isolate_trials": Parameter(type=bool, default=False),
+        # Cluster fan-out hint: TPUJobRunner emits this many shard pods
+        # (0 = none).  The executor itself only consumes their results.
+        "trial_shards": Parameter(type=int, default=0),
     },
     external_input_parameters=("module_file",),
 )
 def Tuner(ctx):
     module_file = ctx.exec_properties["module_file"]
-    run_fn = load_fn(module_file, "run_fn")
-
-    space = ctx.exec_properties["search_space"]
-    if not space:
-        space = getattr(load_module(module_file), "SEARCH_SPACE", None)
-    if not space:
-        raise ValueError(
-            "Tuner needs a search_space parameter or a SEARCH_SPACE dict in "
-            f"the module file {module_file!r}"
-        )
-    space = {k: list(v) for k, v in space.items()}
-    empty = sorted(k for k, v in space.items() if not v)
-    if empty:
-        raise ValueError(f"search_space entries have no candidates: {empty}")
-
-    algorithm = ctx.exec_properties["algorithm"]
-    max_trials = ctx.exec_properties["max_trials"]
-    if algorithm == "grid":
-        candidates = _grid(space)
-        if max_trials:
-            candidates = candidates[:max_trials]
-    elif algorithm == "random":
-        n = max_trials or min(10, _space_size(space))
-        candidates = _random(space, n, ctx.exec_properties["seed"])
-    else:
-        raise ValueError(f"unknown tuner algorithm {algorithm!r}")
-    if not candidates:
-        raise ValueError(
-            f"tuner produced no candidates (space={space}, "
-            f"max_trials={max_trials})"
-        )
+    candidates = enumerate_candidates(ctx.exec_properties, module_file)
 
     direction = ctx.exec_properties["direction"]
     if direction not in ("min", "max"):
@@ -129,41 +373,84 @@ def Tuner(ctx):
     base_hp = dict(ctx.exec_properties["base_hyperparameters"] or {})
     out = ctx.output("best_hyperparameters")
 
+    uris = ctx_data_uris(ctx)
+
+    def trial_fn_args(i: int) -> FnArgs:
+        return build_trial_fn_args(
+            **uris,
+            trial_dir=os.path.join(out.uri, "trials", str(i)),
+            hyperparameters={**base_hp, **candidates[i]},
+            exec_properties=ctx.exec_properties,
+        )
+
+    # Results precomputed by cluster shard pods (Katib-style fan-out),
+    # validated against this run's data and fan-out degree.
+    shard_dir = os.environ.get(ENV_SHARD_DIR, "")
+    precomputed = load_shard_results(
+        shard_dir,
+        examples_uri=uris["examples_uri"],
+        num_shards=int(ctx.exec_properties["trial_shards"] or 0),
+    ) if shard_dir else {}
+    outcomes: Dict[int, Dict[str, Any]] = {}
+    todo: List[int] = []
+    for i, cand in enumerate(candidates):
+        pre = precomputed.get(candidate_key({**base_hp, **cand}))
+        if pre is None:
+            pre = precomputed.get(candidate_key(cand))
+        if pre is not None:
+            outcomes[i] = {**pre, "trial": i}
+        else:
+            todo.append(i)
+    if precomputed:
+        logger.info(
+            "tuner: %d/%d trials reused from shards in %s",
+            len(outcomes), len(candidates), shard_dir,
+        )
+
+    parallel = max(1, int(ctx.exec_properties["parallel_trials"]))
+    isolate = bool(ctx.exec_properties["isolate_trials"]) or parallel > 1
+    if isolate:
+        # Subprocess trials are a single-controller mechanism: under
+        # multi-host SPMD every host process would race on the same spec/
+        # result files and the subprocesses would never join the coordination
+        # service.  Multi-host fan-out is what trial_shards is for.
+        import jax
+
+        if jax.process_count() > 1:
+            raise ValueError(
+                "parallel_trials/isolate_trials cannot run under multi-host "
+                "SPMD (every host would spawn colliding trial subprocesses); "
+                "use trial_shards for cluster fan-out instead"
+            )
+    if todo and parallel > 1:
+        outcomes.update(_run_trials_parallel(
+            todo, candidates, module_file, trial_fn_args, parallel
+        ))
+    elif todo:
+        outcomes.update(_run_trials_inprocess(
+            todo, candidates, module_file, trial_fn_args, isolate,
+        ))
+
+    # One objective for ALL trials — resolved from the first success when
+    # unset; never compare across metrics.
+    obj = objective
     trials: List[Dict[str, Any]] = []
     best_idx = -1
-    best_score = None
-    obj = objective  # resolved from the first trial's metrics when unset
-    for i, cand in enumerate(candidates):
-        trial_dir = os.path.join(out.uri, "trials", str(i))
-        fn_args = resolve_fn_args(
-            ctx,
-            serving_model_dir=os.path.join(trial_dir, "model"),
-            model_run_dir=os.path.join(trial_dir, "model_run"),
-            hyperparameters={**base_hp, **cand},
-            train_steps=ctx.exec_properties["train_steps"],
-            eval_steps=ctx.exec_properties["eval_steps"],
-            mesh=ctx.exec_properties["mesh"],
-            custom_config=ctx.exec_properties["custom_config"],
-        )
-        result = run_fn(fn_args)
-        if not isinstance(result, TrainResult):
-            raise TypeError(
-                "run_fn must return TrainResult for tuning, got "
-                f"{type(result).__name__}"
-            )
-        metrics = result.final_metrics
+    best_score: Optional[float] = None
+    for i in range(len(candidates)):
+        o = outcomes[i]
+        if o["status"] != "ok":
+            trials.append(o)
+            continue
+        metrics = o["metrics"]
         if not obj:
-            # One objective for ALL trials — never compare across metrics.
             obj = "eval_loss" if "eval_loss" in metrics else "loss"
         if obj not in metrics:
             raise KeyError(
                 f"objective {obj!r} not in trial metrics {sorted(metrics)}"
             )
         score = float(metrics[obj])
-        trials.append({
-            "trial": i, "hyperparameters": cand, "objective": obj,
-            "score": score, "metrics": metrics,
-        })
+        trials.append({**o, "objective": obj, "score": score})
         better = (
             best_score is None
             or (direction == "min" and score < best_score)
@@ -171,6 +458,18 @@ def Tuner(ctx):
         )
         if better:
             best_score, best_idx = score, i
+
+    n_failed = sum(1 for t in trials if t["status"] != "ok")
+    if best_idx < 0:
+        raise RuntimeError(
+            f"all {len(trials)} tuner trials failed; see trial error logs "
+            f"under {out.uri}/trials/"
+        )
+    if n_failed:
+        logger.warning(
+            "tuner: %d/%d trials failed; best of the %d survivors wins",
+            n_failed, len(trials), len(trials) - n_failed,
+        )
 
     os.makedirs(out.uri, exist_ok=True)
     best = {**base_hp, **candidates[best_idx]}
@@ -186,10 +485,12 @@ def Tuner(ctx):
         with open(os.path.join(out.uri, TRIALS_FILE), "w") as f:
             json.dump(trials, f, indent=2, sort_keys=True, default=str)
     out.properties["num_trials"] = len(trials)
+    out.properties["failed_trials"] = n_failed
     out.properties["best_trial"] = best_idx
     out.properties["best_score"] = best_score
     return {
         "num_trials": len(trials),
+        "failed_trials": n_failed,
         "best_trial": best_idx,
         "best_score": best_score,
     }

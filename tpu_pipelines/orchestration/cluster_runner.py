@@ -40,7 +40,7 @@ def _yaml():
 
     return yaml
 
-from tpu_pipelines.dsl.compiler import Compiler, PipelineIR
+from tpu_pipelines.dsl.compiler import Compiler, PipelineIR, is_runtime_param
 from tpu_pipelines.dsl.pipeline import Pipeline
 from tpu_pipelines.parallel.distributed import (
     DEFAULT_PORT,
@@ -133,6 +133,38 @@ class TPUJobRunner:
             and self.config.num_hosts > 1
         )
 
+    # ------------------------------------------------- tuner trial fan-out
+
+    @staticmethod
+    def _tuner_shards(node) -> int:
+        """Katib-style fan-out degree for a Tuner node (0 = no fan-out)."""
+        if node.component_type != "Tuner":
+            return 0
+        v = node.exec_properties.get("trial_shards", 0)
+        if is_runtime_param(v):
+            v = v.get("default") or 0
+        try:
+            v = int(v)
+        except (TypeError, ValueError):
+            return 0
+        return v if v > 1 else 0
+
+    @staticmethod
+    def _tuner_shard_dir(ir: PipelineIR, node_id: str) -> str:
+        # Under pipeline_root: the one filesystem every pod shares.
+        return "/".join((ir.pipeline_root.rstrip("/"), ".tuner_shards", node_id))
+
+    def _tuner_trial_command(
+        self, ir: PipelineIR, node_id: str, shard: int, num_shards: int
+    ) -> List[str]:
+        return [
+            "python", "-m", "tpu_pipelines.components.tuner_trial", "shard",
+            "--pipeline-module", self.config.pipeline_module,
+            "--node-id", node_id,
+            "--shard", f"{shard}/{num_shards}",
+            "--shard-dir", self._tuner_shard_dir(ir, node_id),
+        ]
+
     def _workflow(self, ir: PipelineIR) -> Dict[str, Any]:
         cfg = self.config
         name = k8s_name(cfg.workflow_name or ir.name)
@@ -142,15 +174,46 @@ class TPUJobRunner:
                 "name": k8s_name(node.id),
                 "template": k8s_name(node.id),
             }
-            if node.upstream:
-                task["dependencies"] = sorted(
-                    k8s_name(u) for u in node.upstream
-                )
+            deps = sorted(k8s_name(u) for u in node.upstream)
+            shards = self._tuner_shards(node)
+            if shards:
+                # Katib-style fan-out: one pod per trial shard between the
+                # tuner's upstreams and the (merging) tuner node itself.
+                trial_names = [
+                    k8s_name(f"{node.id}-trial-{i}") for i in range(shards)
+                ]
+                for tn in trial_names:
+                    t: Dict[str, Any] = {"name": tn, "template": tn}
+                    if deps:
+                        t["dependencies"] = deps
+                    tasks.append(t)
+                task["dependencies"] = sorted(set(deps) | set(trial_names))
+            elif deps:
+                task["dependencies"] = deps
             tasks.append(task)
         templates: List[Dict[str, Any]] = [
             {"name": "pipeline-dag", "dag": {"tasks": tasks}}
         ]
         for node in ir.nodes:
+            shards = self._tuner_shards(node)
+            for i in range(shards):
+                trial_tpl: Dict[str, Any] = {
+                    "name": k8s_name(f"{node.id}-trial-{i}"),
+                    "retryStrategy": {"limit": 2},
+                    "container": {
+                        "image": cfg.image,
+                        "command": self._tuner_trial_command(
+                            ir, node.id, i, shards
+                        ),
+                        "resources": self._node_resources(node.component_type),
+                    },
+                    "nodeSelector": self._tpu_node_selector(),
+                }
+                if cfg.shared_volume_claim:
+                    trial_tpl["container"]["volumeMounts"] = (
+                        self._volume_mounts()
+                    )
+                templates.append(trial_tpl)
             tpl: Dict[str, Any] = {
                 "name": k8s_name(node.id),
                 "retryStrategy": {"limit": 2},
@@ -172,6 +235,13 @@ class TPUJobRunner:
                     "command": self._node_command(node.id),
                     "resources": self._node_resources(node.component_type),
                 }
+                if shards:
+                    # The tuner node merges the shard pods' scores and is the
+                    # single execution MLMD records for the fan-out.
+                    tpl["container"]["env"] = [{
+                        "name": "TPP_TUNER_SHARD_DIR",
+                        "value": self._tuner_shard_dir(ir, node.id),
+                    }]
                 if cfg.shared_volume_claim:
                     tpl["container"]["volumeMounts"] = self._volume_mounts()
                 if self._is_tpu_node(node.component_type):
@@ -209,6 +279,11 @@ class TPUJobRunner:
             # process id comes from the completion index injected by the Job
             # controller; parallel/distributed.py reads it as the fallback.
         ]
+        if self._tuner_shards(ir.node(node_id)):
+            env.append({
+                "name": "TPP_TUNER_SHARD_DIR",
+                "value": self._tuner_shard_dir(ir, node_id),
+            })
         container = {
             "name": "worker",
             "image": cfg.image,

@@ -33,6 +33,52 @@ class FnArgs:
     custom_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
+def make_fn_args(
+    *,
+    examples_uri: str,
+    transform_graph_uri: str,
+    schema_uri: str,
+    serving_model_dir: str,
+    model_run_dir: str,
+    hyperparameters: Dict[str, Any],
+    train_steps: int,
+    eval_steps: int,
+    mesh: Optional[Dict[str, int]] = None,
+    custom_config: Optional[Dict[str, Any]] = None,
+) -> "FnArgs":
+    """The one place FnArgs fields are assembled — every caller (Trainer,
+    Tuner in-process/subprocess/shard) routes here so the run_fn contract
+    cannot drift between execution modes."""
+    return FnArgs(
+        train_examples_uri=examples_uri,
+        eval_examples_uri=examples_uri,
+        transform_graph_uri=transform_graph_uri,
+        schema_uri=schema_uri,
+        serving_model_dir=serving_model_dir,
+        model_run_dir=model_run_dir,
+        train_steps=train_steps,
+        eval_steps=eval_steps,
+        hyperparameters=hyperparameters,
+        mesh_config=dict(mesh or {}),
+        custom_config=dict(custom_config or {}),
+    )
+
+
+def ctx_data_uris(ctx) -> Dict[str, str]:
+    """Resolve the (examples, optional transform_graph/schema) input uris
+    from an executor context — shared by Trainer and Tuner."""
+    return {
+        "examples_uri": ctx.input("examples").uri,
+        "transform_graph_uri": (
+            ctx.input("transform_graph").uri
+            if ctx.inputs.get("transform_graph") else ""
+        ),
+        "schema_uri": (
+            ctx.input("schema").uri if ctx.inputs.get("schema") else ""
+        ),
+    }
+
+
 def resolve_fn_args(
     ctx,
     *,
@@ -44,29 +90,16 @@ def resolve_fn_args(
     mesh: Optional[Dict[str, int]] = None,
     custom_config: Optional[Dict[str, Any]] = None,
 ) -> "FnArgs":
-    """Build FnArgs from an executor context's resolved artifacts.
-
-    Shared by Trainer and Tuner so the run_fn contract (optional
-    transform_graph/schema wiring, custom_config passthrough) cannot drift
-    between them.
-    """
-    return FnArgs(
-        train_examples_uri=ctx.input("examples").uri,
-        eval_examples_uri=ctx.input("examples").uri,
-        transform_graph_uri=(
-            ctx.input("transform_graph").uri
-            if ctx.inputs.get("transform_graph") else ""
-        ),
-        schema_uri=(
-            ctx.input("schema").uri if ctx.inputs.get("schema") else ""
-        ),
+    """Build FnArgs from an executor context's resolved artifacts."""
+    return make_fn_args(
+        **ctx_data_uris(ctx),
         serving_model_dir=serving_model_dir,
         model_run_dir=model_run_dir,
         train_steps=train_steps,
         eval_steps=eval_steps,
         hyperparameters=hyperparameters,
-        mesh_config=dict(mesh or {}),
-        custom_config=dict(custom_config or {}),
+        mesh=mesh,
+        custom_config=custom_config,
     )
 
 
