@@ -238,3 +238,41 @@ def test_check_thresholds():
         change_thresholds={"loss": {"higher_is_better": False}},
     )
     assert ok
+
+
+def test_infra_validator_latency_smoke(dag_result):
+    """Blessing carries p50/p95 latency from the canary (serving smoke #10)."""
+    result, _, _ = dag_result
+    blessing = result.outputs_of("InfraValidator", "blessing")[0]
+    p50 = blessing.properties.get("latency_p50_ms")
+    p95 = blessing.properties.get("latency_p95_ms")
+    assert p50 is not None and p95 is not None
+    assert 0 < p50 <= p95
+
+
+def test_infra_validator_latency_gate_blocks(dag_result, tmp_path):
+    """An impossible max_latency_ms fails validation with a latency error."""
+    result, _, _ = dag_result
+    from tpu_pipelines.dsl.component import ExecutorContext
+    from tpu_pipelines.metadata.types import Artifact
+    from tpu_pipelines.components.infra_validator import InfraValidator as IV
+
+    blessing_dir = tmp_path / "gate_blessing"
+    ctx = ExecutorContext(
+        node_id="InfraValidator",
+        inputs={
+            "model": [result.outputs_of("Trainer", "model")[0]],
+            "examples": [result.outputs_of("CsvExampleGen", "examples")[0]],
+        },
+        outputs={"blessing": [
+            Artifact(type_name="InfraBlessing", uri=str(blessing_dir))
+        ]},
+        exec_properties={
+            "split": "eval", "num_examples": 4, "raw_examples": True,
+            "max_latency_ms": 1e-9,  # nothing real beats a nanosecond
+        },
+    )
+    out = IV.EXECUTOR(ctx)
+    assert out["blessed"] is False
+    assert "latency" in out["error"]
+    assert os.path.exists(blessing_dir / "NOT_BLESSED")

@@ -136,3 +136,74 @@ def test_linf_distance():
     s = compute_split_statistics("train", table)
     assert linf_categorical_distance(s, s, "payment_type") == 0.0
     assert linf_categorical_distance(s, s, "fare") is None  # numeric
+
+
+def test_streaming_stats_match_single_pass():
+    """Chunked accumulation equals whole-table stats (exact under reservoir)."""
+    import numpy as np
+    import pyarrow as pa
+    import pytest
+
+    from tpu_pipelines.data.statistics import (
+        SplitStatsAccumulator, compute_split_statistics,
+    )
+
+    rng = np.random.default_rng(7)
+    n = 5000
+    vals = rng.normal(3.0, 2.0, n)
+    vals[::97] = np.nan  # arrow nulls after from-pandas-style conversion
+    cats = rng.choice(["a", "bb", "ccc", "dddd"], n, p=[0.5, 0.3, 0.15, 0.05])
+    table = pa.table({
+        "x": pa.array(vals),
+        "c": pa.array(cats),
+    })
+    # arrow: NaN != null; rebuild x with real nulls
+    table = table.set_column(
+        0, "x", pa.array([None if np.isnan(v) else v for v in vals])
+    )
+
+    whole = compute_split_statistics("train", table)
+    acc = SplitStatsAccumulator("train")
+    for lo in range(0, n, 617):  # deliberately awkward chunk size
+        acc.update(table.slice(lo, 617))
+    chunked = acc.finalize()
+
+    assert chunked.num_examples == whole.num_examples == n
+    wx, cx = whole.features["x"], chunked.features["x"]
+    assert cx.num_missing == wx.num_missing > 0
+    assert cx.numeric.mean == pytest.approx(wx.numeric.mean, rel=1e-12)
+    assert cx.numeric.std_dev == pytest.approx(wx.numeric.std_dev, rel=1e-9)
+    assert cx.numeric.min == wx.numeric.min
+    assert cx.numeric.max == wx.numeric.max
+    assert cx.numeric.median == pytest.approx(wx.numeric.median)
+    assert cx.numeric.num_zeros == wx.numeric.num_zeros
+    assert cx.numeric.histogram_counts == wx.numeric.histogram_counts
+    wc, cc = whole.features["c"], chunked.features["c"]
+    assert cc.string.unique == wc.string.unique == 4
+    assert cc.string.top_values == wc.string.top_values
+    assert cc.string.avg_length == pytest.approx(wc.string.avg_length)
+
+
+def test_streaming_stats_reservoir_beyond_capacity():
+    """Past the reservoir the exact stats stay exact and the order stats are
+    close; histogram counts rescale to the full count."""
+    import numpy as np
+    import pyarrow as pa
+    import pytest
+
+    from tpu_pipelines.data.statistics import SplitStatsAccumulator
+
+    rng = np.random.default_rng(3)
+    acc = SplitStatsAccumulator("train", reservoir_size=1000)
+    n = 50_000
+    total = 0.0
+    for lo in range(0, n, 4096):
+        m = min(4096, n - lo)
+        chunk = rng.uniform(0.0, 10.0, m)
+        total += chunk.sum()
+        acc.update(pa.table({"x": pa.array(chunk)}))
+    s = acc.finalize().features["x"].numeric
+    assert s.mean == pytest.approx(total / n, rel=1e-12)      # exact
+    assert 0.0 <= s.min < 0.01 and 9.99 < s.max <= 10.0       # exact
+    assert s.median == pytest.approx(5.0, abs=0.5)            # sampled
+    assert sum(s.histogram_counts) == pytest.approx(n, rel=0.02)  # rescaled

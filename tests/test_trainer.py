@@ -200,3 +200,57 @@ def test_model_parallel_param_and_optstate_sharding():
     assert result.steps_completed == 3
     w_shard = params["w"].sharding
     assert w_shard.spec == P(None, "model")
+
+
+def test_goodput_badput_breakdown(tmp_path):
+    """train_loop reports the real ml_goodput_measurement breakdown and
+    mirrors the entry log next to the checkpoints."""
+    loss_fn, init_fn = _linreg_pieces()
+    _, result = train_loop(
+        loss_fn=loss_fn,
+        init_params_fn=init_fn,
+        optimizer=optax.adam(0.1),
+        train_iter=_synthetic_iter(),
+        config=TrainLoopConfig(train_steps=30, batch_size=32, log_every=10),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    assert result.goodput_source == "ml_goodput_measurement"
+    assert 0.0 < result.goodput <= 1.0
+    assert result.badput, "expected a non-empty badput breakdown"
+    # Known badput kinds only, fractions, and accounting roughly closes.
+    known = {
+        "tpu_initialization", "training_prep", "program_startup",
+        "data_loading_sync", "data_loading_async", "other",
+        "unproductive_checkpoint_save_time",
+        "unproductive_checkpoint_restore_time",
+        "wasted_progress_from_disruption",
+        "infrastructure_recovery_from_disruption", "custom_badput_events",
+    }
+    assert set(result.badput) <= known, result.badput
+    total = result.goodput + sum(result.badput.values())
+    assert total == pytest.approx(1.0, abs=0.05), (result.goodput, result.badput)
+    # JSONL mirror exists and holds step entries.
+    log_file = tmp_path / "ckpt" / "goodput_log.jsonl"
+    assert log_file.exists()
+    lines = log_file.read_text().strip().splitlines()
+    assert any("step_start_time" in ln for ln in lines)
+
+
+def test_goodput_tracker_disabled_is_noop(monkeypatch):
+    """Without the library the tracker no-ops and summary() is empty."""
+    import builtins
+
+    real_import = builtins.__import__
+
+    def fake_import(name, *a, **k):
+        if name.startswith("ml_goodput_measurement"):
+            raise ImportError("simulated absence")
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", fake_import)
+    from tpu_pipelines.trainer.goodput import GoodputTracker
+
+    t = GoodputTracker("x")
+    assert not t.enabled
+    t.job_start(); t.step_start(0); t.job_end()
+    assert t.summary() == {}

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 
 import numpy as np
 
@@ -36,6 +37,11 @@ NOT_BLESSED_FILE = "NOT_BLESSED"
         # REST surface — the closest local equivalent of the reference's
         # serving-container canary.
         "serving_binary": Parameter(type=str, default="inprocess"),
+        # Latency smoke: after one warmup, time this many repeat predicts on
+        # the same batch and record p50/p95 (ms) into the blessing.
+        "latency_probes": Parameter(type=int, default=5),
+        # 0 = no gate; otherwise p95 above this many ms fails validation.
+        "max_latency_ms": Parameter(type=float, default=0.0),
     },
 )
 def InfraValidator(ctx):
@@ -43,47 +49,86 @@ def InfraValidator(ctx):
     os.makedirs(blessing.uri, exist_ok=True)
     n = ctx.exec_properties["num_examples"]
     split = ctx.exec_properties["split"]
+    # .get: hand-built ExecutorContexts (tests, embedding users) may omit
+    # optional params the runner would have defaulted.
+    probes = max(0, ctx.exec_properties.get("latency_probes", 5))
     error = ""
+    latency_p50 = latency_p95 = None
     try:
         data = examples_io.read_split(ctx.input("examples").uri, split)
         batch = {k: v[:n] for k, v in data.items()}
-        if ctx.exec_properties["serving_binary"] == "http":
-            preds = _predict_over_http(
-                ctx.input("model").uri, batch,
+        if ctx.exec_properties.get("serving_binary", "inprocess") == "http":
+            predict = _http_canary(
+                ctx.input("model").uri,
                 raw=ctx.exec_properties["raw_examples"],
             )
         else:
             loaded = load_exported_model(ctx.input("model").uri)
-            predict = (
+            raw_fn = (
                 loaded.predict if ctx.exec_properties["raw_examples"]
                 else loaded.predict_transformed
             )
-            preds = np.asarray(predict(batch))
-        if len(preds) != len(next(iter(batch.values()))):
-            error = f"prediction count {len(preds)} != batch size"
-        elif not np.isfinite(np.asarray(preds, dtype=np.float64)).all():
-            error = "non-finite predictions"
+            predict = lambda b: np.asarray(raw_fn(b))  # noqa: E731
+        try:
+            preds = predict(batch)  # smoke-infer doubles as warmup
+            if len(preds) != len(next(iter(batch.values()))):
+                error = f"prediction count {len(preds)} != batch size"
+            elif not np.isfinite(np.asarray(preds, dtype=np.float64)).all():
+                error = "non-finite predictions"
+            if not error and probes:
+                lat_ms = []
+                for _ in range(probes):
+                    t0 = time.perf_counter()
+                    predict(batch)
+                    lat_ms.append((time.perf_counter() - t0) * 1000.0)
+                latency_p50 = round(float(np.percentile(lat_ms, 50)), 3)
+                latency_p95 = round(float(np.percentile(lat_ms, 95)), 3)
+                gate = ctx.exec_properties.get("max_latency_ms", 0.0)
+                if gate and latency_p95 > gate:
+                    error = (
+                        f"latency p95 {latency_p95}ms exceeds "
+                        f"max_latency_ms={gate}"
+                    )
+        finally:
+            closer = getattr(predict, "close", None)
+            if closer:
+                closer()
     except Exception as e:  # the canary's entire job is catching these
         error = f"{type(e).__name__}: {e}"
 
     marker = NOT_BLESSED_FILE if error else BLESSING_FILE
     with open(os.path.join(blessing.uri, marker), "w") as f:
-        json.dump({"error": error}, f)
+        json.dump({
+            "error": error,
+            "latency_p50_ms": latency_p50,
+            "latency_p95_ms": latency_p95,
+        }, f)
     blessing.properties["blessed"] = not error
+    if latency_p50 is not None:
+        blessing.properties["latency_p50_ms"] = latency_p50
+        blessing.properties["latency_p95_ms"] = latency_p95
+    props = {"blessed": not error}
+    if latency_p50 is not None:
+        props["latency_p50_ms"] = latency_p50
+        props["latency_p95_ms"] = latency_p95
     if error:
-        return {"blessed": False, "error": error}
-    return {"blessed": True}
+        props["error"] = error
+    return props
 
 
-def _predict_over_http(model_uri: str, batch, raw: bool = True) -> np.ndarray:
-    """Canary through the REST surface on a loopback port."""
+def _http_canary(model_uri: str, raw: bool = True):
+    """A reusable predict(batch) callable through the REST surface on a
+    loopback port; ``.close()`` stops the server.  Keeping one server alive
+    across the latency probes means they measure steady-state request cost,
+    not model load."""
     import urllib.request
 
     from tpu_pipelines.serving import ModelServer
 
     server = ModelServer("canary", model_uri, raw=raw)
     port = server.start()
-    try:
+
+    def predict(batch) -> np.ndarray:
         instances = [
             {k: np.asarray(v[i]).tolist() for k, v in batch.items()}
             for i in range(len(next(iter(batch.values()))))
@@ -95,5 +140,6 @@ def _predict_over_http(model_uri: str, batch, raw: bool = True) -> np.ndarray:
         )
         with urllib.request.urlopen(req, timeout=60) as r:
             return np.asarray(json.load(r)["predictions"])
-    finally:
-        server.stop()
+
+    predict.close = server.stop
+    return predict

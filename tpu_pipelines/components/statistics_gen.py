@@ -8,25 +8,37 @@ from __future__ import annotations
 
 from tpu_pipelines.data import examples_io
 from tpu_pipelines.data.statistics import (
-    compute_split_statistics,
+    SplitStatsAccumulator,
     save_statistics,
 )
-from tpu_pipelines.dsl.component import component
+from tpu_pipelines.dsl.component import Parameter, component
 
 
 @component(
     inputs={"examples": "Examples"},
     outputs={"statistics": "ExampleStatistics"},
+    parameters={
+        # Rows per streamed chunk; peak host memory is O(chunk + reservoir),
+        # never O(split).  0 = the Parquet row-group size.
+        "chunk_rows": Parameter(type=int, default=0),
+    },
 )
 def StatisticsGen(ctx):
     examples = ctx.input("examples")
     splits = examples_io.split_names(examples.uri)
     if not splits:
         raise ValueError(f"Examples artifact at {examples.uri} has no splits")
+    chunk_rows = (
+        ctx.exec_properties.get("chunk_rows") or examples_io.DEFAULT_ROW_GROUP
+    )
     stats = {}
     for split in splits:
-        table = examples_io.read_split_table(examples.uri, split)
-        stats[split] = compute_split_statistics(split, table)
+        acc = SplitStatsAccumulator(split)
+        for table in examples_io.iter_table_chunks(
+            examples.uri, split, rows=chunk_rows
+        ):
+            acc.update(table)
+        stats[split] = acc.finalize()
     out = ctx.output("statistics")
     save_statistics(out.uri, stats)
     out.properties["split_names"] = splits

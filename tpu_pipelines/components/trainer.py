@@ -84,7 +84,10 @@ def Trainer(ctx):
         "examples_per_sec_per_chip": result.examples_per_sec_per_chip,
         "steps_completed": result.steps_completed,
         "resumed_from_step": result.resumed_from_step,
+        "goodput": result.goodput,
+        "goodput_source": result.goodput_source,
     }
+    props.update({f"badput_{k}": v for k, v in result.badput.items()})
     props.update(
         {f"final_{k}": v for k, v in result.final_metrics.items()}
     )

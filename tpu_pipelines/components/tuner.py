@@ -215,16 +215,26 @@ def _run_trial_subprocess(
     os.makedirs(trial_dir, exist_ok=True)
     spec_path = os.path.join(trial_dir, SPEC_FILE)
     result_path = os.path.join(trial_dir, RESULT_FILE)
+    spec = {
+        "module_file": module_file,
+        "fn_args": dataclasses.asdict(fn_args),
+        "trial": trial,
+        "result_path": result_path,
+    }
+    try:
+        # Strict (no default=str): silently stringifying a tuple/ndarray in
+        # custom_config would hand subprocess trials different inputs than
+        # in-process trials get — the contract drift make_fn_args exists to
+        # prevent.
+        spec_json = json.dumps(spec, indent=2)
+    except TypeError as e:
+        raise ValueError(
+            "subprocess trial modes (parallel_trials/isolate_trials/"
+            "trial_shards) need JSON-serializable hyperparameters and "
+            f"custom_config; trial {trial} spec is not: {e}"
+        ) from e
     with open(spec_path, "w") as f:
-        json.dump(
-            {
-                "module_file": module_file,
-                "fn_args": dataclasses.asdict(fn_args),
-                "trial": trial,
-                "result_path": result_path,
-            },
-            f, indent=2, default=str,
-        )
+        f.write(spec_json)
     with open(os.path.join(trial_dir, ERROR_FILE), "w") as errf:
         proc = subprocess.run(
             [sys.executable, "-m", "tpu_pipelines.components.tuner_trial",
@@ -414,9 +424,13 @@ def Tuner(ctx):
         # multi-host SPMD every host process would race on the same spec/
         # result files and the subprocesses would never join the coordination
         # service.  Multi-host fan-out is what trial_shards is for.
-        import jax
+        # Detected from the bootstrap env (parallel/distributed.py), NOT via
+        # jax.process_count(): touching jax here would initialize the TPU
+        # backend in the parent and lock the chips away from every trial
+        # subprocess this mode exists to spawn.
+        from tpu_pipelines.parallel.distributed import ENV_NUM_PROCESSES
 
-        if jax.process_count() > 1:
+        if int(os.environ.get(ENV_NUM_PROCESSES, "1") or 1) > 1:
             raise ValueError(
                 "parallel_trials/isolate_trials cannot run under multi-host "
                 "SPMD (every host would spawn colliding trial subprocesses); "

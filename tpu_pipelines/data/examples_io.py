@@ -87,6 +87,28 @@ def iter_column_chunks(
         pf.close()
 
 
+def iter_table_chunks(
+    uri: str,
+    split: str,
+    columns: Optional[List[str]] = None,
+    rows: int = DEFAULT_ROW_GROUP,
+):
+    """Stream a split as Arrow tables of ~``rows`` rows (null semantics
+    intact — what the statistics accumulator consumes); peak memory O(rows)."""
+    path = os.path.join(split_dir(uri, split), DATA_FILE)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"Examples artifact at {uri!r} has no split {split!r} "
+            f"(available: {split_names(uri)})"
+        )
+    pf = pq.ParquetFile(path)
+    try:
+        for rb in pf.iter_batches(batch_size=rows, columns=columns):
+            yield pa.Table.from_batches([rb])
+    finally:
+        pf.close()
+
+
 def read_split_table(
     uri: str, split: str, columns: Optional[List[str]] = None
 ) -> pa.Table:

@@ -126,42 +126,166 @@ def infer_feature_type(arr_type: pa.DataType) -> FeatureType:
     return FeatureType.BYTES
 
 
-def compute_split_statistics(split: str, table: pa.Table) -> SplitStatistics:
-    n = table.num_rows
-    features: Dict[str, FeatureStats] = {}
-    for name in table.column_names:
-        col = table.column(name).combine_chunks()
-        ftype = infer_feature_type(col.type)
-        num_missing = col.null_count
-        fs = FeatureStats(
-            name=name, type=ftype.value, num_examples=n, num_missing=num_missing
+class _NumericFeatureAcc:
+    """Exact streaming moments/min/max/zeros + a uniform reservoir for the
+    order statistics (median, histogram).  With fewer values than the
+    reservoir size — every workshop-scale dataset — the reservoir holds the
+    entire column and median/histogram are exact; beyond that they are the
+    standard reservoir-sample approximation (TFDV's quantile sketches play
+    the same role) with histogram counts scaled back up to the full count."""
+
+    def __init__(self, reservoir_size: int, rng: np.random.Generator):
+        self.count = 0
+        self.total = 0.0
+        self.total_sq = 0.0
+        self.min = np.inf
+        self.max = -np.inf
+        self.zeros = 0
+        self._rng = rng
+        self._reservoir = np.empty(reservoir_size, np.float64)
+        self._filled = 0
+
+    def update(self, vals: np.ndarray) -> None:
+        if not len(vals):
+            return
+        self.total += float(np.sum(vals))
+        self.total_sq += float(np.sum(vals * vals))
+        self.min = min(self.min, float(np.min(vals)))
+        self.max = max(self.max, float(np.max(vals)))
+        self.zeros += int(np.count_nonzero(vals == 0))
+        cap = len(self._reservoir)
+        room = cap - self._filled
+        take = min(room, len(vals))
+        if take:
+            self._reservoir[self._filled:self._filled + take] = vals[:take]
+            self._filled += take
+        rest = vals[take:]
+        if len(rest):
+            # Vectorized algorithm-R step: value j (0-based among the rest,
+            # arriving as overall item count+take+j+1) replaces a random slot
+            # with probability cap / items_seen.
+            seen = self.count + take + 1 + np.arange(len(rest))
+            slots = (self._rng.random(len(rest)) * seen).astype(np.int64)
+            mask = slots < cap
+            self._reservoir[slots[mask]] = rest[mask]
+        self.count += len(vals)
+
+    def finalize(self) -> Optional[NumericStats]:
+        if not self.count:
+            return None
+        sample = self._reservoir[:self._filled]
+        counts, edges = np.histogram(sample, bins=_HIST_BUCKETS)
+        scale = self.count / max(1, len(sample))
+        mean = self.total / self.count
+        var = max(0.0, self.total_sq / self.count - mean * mean)
+        return NumericStats(
+            mean=float(mean),
+            std_dev=float(np.sqrt(var)),
+            min=float(self.min),
+            max=float(self.max),
+            median=float(np.median(sample)),
+            num_zeros=self.zeros,
+            histogram_edges=[float(e) for e in edges],
+            histogram_counts=[int(round(c * scale)) for c in counts],
         )
-        if ftype in (FeatureType.INT, FeatureType.FLOAT):
-            vals = col.drop_null().to_numpy(zero_copy_only=False).astype(np.float64)
-            if len(vals):
-                counts, edges = np.histogram(vals, bins=_HIST_BUCKETS)
-                fs.numeric = NumericStats(
-                    mean=float(np.mean(vals)),
-                    std_dev=float(np.std(vals)),
-                    min=float(np.min(vals)),
-                    max=float(np.max(vals)),
-                    median=float(np.median(vals)),
-                    num_zeros=int(np.count_nonzero(vals == 0)),
-                    histogram_edges=[float(e) for e in edges],
-                    histogram_counts=[int(c) for c in counts],
+
+
+class _StringFeatureAcc:
+    """Exact value counts (the TFDV top-k/uniques equivalent; cardinality is
+    bounded by the vocabulary, not the dataset)."""
+
+    def __init__(self):
+        self.counts: Dict[str, int] = {}
+        self.total_len = 0
+        self.n = 0
+
+    def update(self, vals: np.ndarray) -> None:
+        svals = vals.astype(str)
+        uniq, counts = np.unique(svals, return_counts=True)
+        for v, c in zip(uniq, counts):
+            self.counts[v] = self.counts.get(v, 0) + int(c)
+        self.total_len += int(sum(len(v) for v in svals))
+        self.n += len(svals)
+
+    def finalize(self) -> Optional[StringStats]:
+        if not self.n:
+            return None
+        # Sorted-unique then stable argsort(-counts): byte-identical ordering
+        # to the previous single-pass np.unique implementation.
+        uniq = np.asarray(sorted(self.counts))
+        counts = np.asarray([self.counts[v] for v in uniq])
+        order = np.argsort(-counts, kind="stable")
+        return StringStats(
+            unique=int(len(uniq)),
+            avg_length=self.total_len / self.n,
+            top_values=[
+                [str(uniq[i]), int(counts[i])] for i in order[:_TOP_K]
+            ],
+        )
+
+
+class SplitStatsAccumulator:
+    """Single-pass streaming statistics over Arrow table chunks — the Beam
+    ``CombineFn`` accumulate/merge/extract cycle (SURVEY.md §2a StatisticsGen
+    row) without Beam: feed ``update(table)`` row-group-sized chunks and
+    ``finalize()``; peak host memory is O(chunk + reservoir), never O(split)."""
+
+    def __init__(self, split: str, reservoir_size: int = 1 << 17, seed: int = 0):
+        self.split = split
+        self.num_rows = 0
+        self.reservoir_size = reservoir_size
+        self._rng = np.random.default_rng(seed)
+        self._numeric: Dict[str, _NumericFeatureAcc] = {}
+        self._string: Dict[str, _StringFeatureAcc] = {}
+        self._missing: Dict[str, int] = {}
+        self._types: Dict[str, FeatureType] = {}
+        self._order: List[str] = []
+
+    def update(self, table: pa.Table) -> None:
+        self.num_rows += table.num_rows
+        for name in table.column_names:
+            col = table.column(name).combine_chunks()
+            if name not in self._types:
+                self._types[name] = infer_feature_type(col.type)
+                self._missing[name] = 0
+                self._order.append(name)
+            self._missing[name] += col.null_count
+            ftype = self._types[name]
+            if ftype in (FeatureType.INT, FeatureType.FLOAT):
+                vals = col.drop_null().to_numpy(
+                    zero_copy_only=False
+                ).astype(np.float64)
+                acc = self._numeric.setdefault(
+                    name,
+                    _NumericFeatureAcc(self.reservoir_size, self._rng),
                 )
-        else:
-            vals = np.asarray(col.drop_null().to_pylist(), dtype=object)
-            if len(vals):
-                uniq, counts = np.unique(vals.astype(str), return_counts=True)
-                order = np.argsort(-counts)
-                top = [
-                    [str(uniq[i]), int(counts[i])] for i in order[:_TOP_K]
-                ]
-                fs.string = StringStats(
-                    unique=int(len(uniq)),
-                    avg_length=float(np.mean([len(v) for v in vals.astype(str)])),
-                    top_values=top,
-                )
-        features[name] = fs
-    return SplitStatistics(split=split, num_examples=n, features=features)
+                acc.update(vals)
+            else:
+                vals = np.asarray(col.drop_null().to_pylist(), dtype=object)
+                self._string.setdefault(name, _StringFeatureAcc()).update(vals)
+
+    def finalize(self) -> SplitStatistics:
+        features: Dict[str, FeatureStats] = {}
+        for name in self._order:
+            fs = FeatureStats(
+                name=name,
+                type=self._types[name].value,
+                num_examples=self.num_rows,
+                num_missing=self._missing[name],
+            )
+            if name in self._numeric:
+                fs.numeric = self._numeric[name].finalize()
+            elif name in self._string:
+                fs.string = self._string[name].finalize()
+            features[name] = fs
+        return SplitStatistics(
+            split=self.split, num_examples=self.num_rows, features=features
+        )
+
+
+def compute_split_statistics(split: str, table: pa.Table) -> SplitStatistics:
+    """Whole-table statistics: one accumulator update (shared code path with
+    streaming, so in-memory and chunked runs cannot drift)."""
+    acc = SplitStatsAccumulator(split)
+    acc.update(table)
+    return acc.finalize()

@@ -187,7 +187,18 @@ class TPUJobRunner:
                     if deps:
                         t["dependencies"] = deps
                     tasks.append(t)
-                task["dependencies"] = sorted(set(deps) | set(trial_names))
+                # `depends`, not `dependencies`: upstreams must succeed, but
+                # trial pods only need to FINISH — the merge re-runs any
+                # shard's missing trials locally (load_shard_results +
+                # incremental shard writes), so a preempted shard degrades
+                # to local re-runs instead of failing the workflow.
+                task["depends"] = " && ".join(
+                    [f"{d}.Succeeded" for d in deps]
+                    + [
+                        f"({t}.Succeeded || {t}.Failed || {t}.Errored)"
+                        for t in trial_names
+                    ]
+                )
             elif deps:
                 task["dependencies"] = deps
             tasks.append(task)

@@ -1,0 +1,204 @@
+"""Request micro-batching for the serving path.
+
+TF Serving's ``BatchingSession`` equivalent (SURVEY.md §3.5), designed for
+the XLA serving reality rather than ported: a jitted predict function
+recompiles per input *shape*, so serving raw per-request row counts would
+compile once per distinct batch size and dispatch once per request.  The
+batcher fixes both:
+
+  - concurrent requests coalesce into one device call (dispatch amortized,
+    MXU fed bigger matmuls);
+  - the coalesced batch is padded by row-repetition up to a fixed bucket
+    size (powers of two up to ``max_batch_size``), so jit sees a handful of
+    shapes ever — after warmup, no request pays a compile.
+
+Rows are padded with copies of the batch's first row (always a valid feature
+row, unlike zeros which may violate vocab/string constraints) and the pad
+tail is sliced off before replies fan back out.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+Batch = Dict[str, np.ndarray]
+
+
+def bucket_sizes(max_batch_size: int) -> List[int]:
+    """[1, 2, 4, ..., max_batch_size] — the shapes jit will ever see."""
+    sizes = []
+    b = 1
+    while b < max_batch_size:
+        sizes.append(b)
+        b *= 2
+    sizes.append(max_batch_size)
+    return sizes
+
+
+def pad_to_bucket(batch: Batch, n_rows: int, buckets: Sequence[int]) -> Batch:
+    """Pad every feature to the smallest bucket >= n_rows by repeating row 0.
+
+    A request larger than the top bucket passes through unpadded (it runs
+    alone, unsplit — its shape is the caller's to manage)."""
+    target = next((b for b in buckets if b >= n_rows), n_rows)
+    if target == n_rows:
+        return batch
+    pad = target - n_rows
+
+    def _pad(v: np.ndarray) -> np.ndarray:
+        reps = np.repeat(v[:1], pad, axis=0)
+        return np.concatenate([v, reps], axis=0)
+
+    return {k: _pad(np.asarray(v)) for k, v in batch.items()}
+
+
+class RequestBatcher:
+    """Coalesces concurrent ``submit`` calls into padded device batches.
+
+    One daemon worker drains the queue: it blocks for the first pending
+    request, then gathers more for up to ``batch_timeout_s`` (or until
+    ``max_batch_size`` rows), concatenates, pads to a bucket, runs
+    ``predict_fn`` ONCE, and distributes row slices back to each caller's
+    future.  A request bigger than ``max_batch_size`` runs alone, unsplit.
+    """
+
+    def __init__(
+        self,
+        predict_fn: Callable[[Batch], Any],
+        *,
+        max_batch_size: int = 64,
+        batch_timeout_s: float = 0.005,
+    ):
+        if max_batch_size < 1:
+            raise ValueError(f"max_batch_size must be >= 1, got {max_batch_size}")
+        self.predict_fn = predict_fn
+        self.max_batch_size = max_batch_size
+        self.batch_timeout_s = batch_timeout_s
+        self.buckets = bucket_sizes(max_batch_size)
+        self.batches_run = 0          # observability: device calls issued
+        self.requests_served = 0
+        self._queue: "queue.Queue" = queue.Queue()
+        self._closed = False
+        self._close_lock = threading.Lock()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    # ------------------------------------------------------------- client
+
+    def submit(
+        self, batch: Batch, n_rows: int, timeout_s: float = 300.0
+    ) -> np.ndarray:
+        """Blocking predict for one request's feature batch (n_rows rows).
+
+        ``timeout_s`` bounds the wait (covers first-bucket XLA compiles with
+        room to spare); a closed batcher raises immediately."""
+        fut: "Future[np.ndarray]" = Future()
+        with self._close_lock:
+            # Checked under the close lock: a submit racing close() must
+            # either enqueue before the worker's final drain or raise — never
+            # land in a queue nobody services.
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            self._queue.put((batch, n_rows, fut))
+        return fut.result(timeout=timeout_s)
+
+    def close(self) -> None:
+        with self._close_lock:
+            self._closed = True
+            self._queue.put(None)  # wake the worker
+        self._worker.join(timeout=5)
+        self._drain_failures("batcher closed")  # anything the worker missed
+
+    # ------------------------------------------------------------- worker
+
+    @staticmethod
+    def _signature(batch: Batch):
+        """Feature names + per-row shapes + dtype kinds: what must agree for
+        requests to share one concatenated device batch."""
+        return tuple(sorted(
+            (k, np.asarray(v).shape[1:], np.asarray(v).dtype.kind)
+            for k, v in batch.items()
+        ))
+
+    def _run(self) -> None:
+        carry = None  # request popped but deferred to keep batches in budget
+        while True:
+            item = carry if carry is not None else self._queue.get()
+            carry = None
+            if item is None:
+                self._drain_failures("batcher closed")
+                return
+            group = [item]
+            rows = item[1]
+            sig = self._signature(item[0])
+            # Gather more requests within the timeout window / size budget.
+            t_end = time.monotonic() + self.batch_timeout_s
+            while rows < self.max_batch_size:
+                remaining = t_end - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._queue.put(None)  # re-post the close sentinel
+                    break
+                if (
+                    rows + nxt[1] > self.max_batch_size
+                    or self._signature(nxt[0]) != sig
+                ):
+                    # Over budget or schema-incompatible (a malformed request
+                    # must not poison whoever it queued next to): defer it to
+                    # open the next group.
+                    carry = nxt
+                    break
+                group.append(nxt)
+                rows += nxt[1]
+            self._execute(group)
+
+    def _predict_group(self, group) -> None:
+        merged = {
+            k: np.concatenate(
+                [np.asarray(b[k])[:n] for b, n, _ in group], axis=0
+            )
+            for k in group[0][0]
+        }
+        total = sum(n for _, n, _ in group)
+        padded = pad_to_bucket(merged, total, self.buckets)
+        preds = np.asarray(self.predict_fn(padded))[:total]
+        self.batches_run += 1
+        self.requests_served += len(group)
+        offset = 0
+        for _, n, fut in group:
+            fut.set_result(preds[offset:offset + n])
+            offset += n
+
+    def _execute(self, group) -> None:
+        try:
+            self._predict_group(group)
+        except Exception:  # noqa: BLE001 — isolate, then fail only the culprit
+            # Same-signature requests can still differ in value validity
+            # (vocab misses, NaNs the transform rejects): retry one-by-one so
+            # a bad request fails alone, TF-Serving style.
+            for entry in group:
+                try:
+                    self._predict_group([entry])
+                except Exception as e:  # noqa: BLE001
+                    if not entry[2].done():
+                        entry[2].set_exception(e)
+
+    def _drain_failures(self, msg: str) -> None:
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if item is not None:
+                item[2].set_exception(RuntimeError(msg))

@@ -10,8 +10,10 @@ jitted function — trainer/export.py), and answers TF-Serving-style REST:
          body: {"instances": [{feature: value, ...}, ...]}
          or    {"inputs": {feature: [values...], ...}}
 
-Implementation is stdlib ``http.server`` with a thread pool of one — the
-jitted predict path is already batched and single-stream; this server exists
+Implementation is stdlib ``ThreadingHTTPServer``; concurrent requests are
+safe (jax dispatch is thread-safe) and, with ``batching=True``, coalesce
+through a micro-batcher into padded fixed-bucket device calls
+(serving/batching.py) — the BatchingSession equivalent.  This server exists
 for InfraValidator canaries, e2e tests, and small deployments.  High-QPS
 serving exports a SavedModel (serving/saved_model.py) into TF Serving.
 """
@@ -53,7 +55,16 @@ class ModelServer:
     for callers sending already-materialized features.
     """
 
-    def __init__(self, model_name: str, base_dir: str, *, raw: bool = True):
+    def __init__(
+        self,
+        model_name: str,
+        base_dir: str,
+        *,
+        raw: bool = True,
+        batching: bool = False,
+        max_batch_size: int = 64,
+        batch_timeout_s: float = 0.005,
+    ):
         self.model_name = model_name
         self.base_dir = base_dir
         self.raw = raw
@@ -62,6 +73,18 @@ class ModelServer:
         self._loaded_version: Optional[str] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # Micro-batching (serving/batching.py): coalesce concurrent requests
+        # into padded fixed-bucket device calls.  The batcher resolves the
+        # current model at call time, so hot-swaps apply to queued requests.
+        self._batcher = None
+        if batching:
+            from tpu_pipelines.serving.batching import RequestBatcher
+
+            self._batcher = RequestBatcher(
+                lambda b: np.asarray(self._predict_fn()(b)),
+                max_batch_size=max_batch_size,
+                batch_timeout_s=batch_timeout_s,
+            )
         self.reload()
 
     # ----------------------------------------------------------- lifecycle
@@ -97,12 +120,15 @@ class ModelServer:
 
     # ------------------------------------------------------------- predict
 
-    def predict(self, payload: Dict[str, Any]) -> Dict[str, Any]:
-        """TF-Serving REST semantics: 'instances' (row) or 'inputs' (column)."""
+    def _predict_fn(self):
         with self._lock:
             loaded = self._loaded
         if loaded is None:
             raise RuntimeError("no model loaded")
+        return loaded.predict if self.raw else loaded.predict_transformed
+
+    def predict(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """TF-Serving REST semantics: 'instances' (row) or 'inputs' (column)."""
         if "instances" in payload:
             rows = payload["instances"]
             if not rows:
@@ -115,8 +141,11 @@ class ModelServer:
             batch = {k: np.asarray(v) for k, v in payload["inputs"].items()}
         else:
             raise ValueError("request needs 'instances' or 'inputs'")
-        predict = loaded.predict if self.raw else loaded.predict_transformed
-        preds = np.asarray(predict(batch))
+        n_rows = len(next(iter(batch.values())))
+        if self._batcher is not None:
+            preds = self._batcher.submit(batch, n_rows)
+        else:
+            preds = np.asarray(self._predict_fn()(batch))
         return {"predictions": preds.tolist()}
 
     # ---------------------------------------------------------------- HTTP
@@ -164,7 +193,13 @@ class ModelServer:
                 except Exception as e:
                     self._reply(400, {"error": f"{type(e).__name__}: {e}"})
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        class Httpd(ThreadingHTTPServer):
+            # socketserver's default listen backlog is 5; a concurrent-client
+            # burst on a loaded host overflows it into connection resets.
+            request_queue_size = 128
+            daemon_threads = True
+
+        self._httpd = Httpd((host, port), Handler)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
         )
@@ -179,3 +214,6 @@ class ModelServer:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if self._batcher is not None:
+            self._batcher.close()
+            self._batcher = None

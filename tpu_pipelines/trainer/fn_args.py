@@ -112,7 +112,12 @@ class TrainResult:
     examples_per_sec_per_chip: float = 0.0
     steps_completed: int = 0
     resumed_from_step: int = 0
-    # Fraction of post-compile wall-clock not spent in host-side input work.
-    # A lower bound on device goodput (host input can overlap async device
-    # execution); 1.0 when the run was too short to measure.
+    # Productive fraction of job wall-clock.  Source "ml_goodput_measurement"
+    # = the real badput algebra (init/prep/compile count against it); source
+    # "host_input_wait_proxy" = 1 - host-input-wait/elapsed, a lower bound on
+    # device goodput (1.0 when the run was too short to measure).
     goodput: float = 0.0
+    goodput_source: str = "host_input_wait_proxy"
+    # {badput_kind: fraction of job wall-clock}, e.g. {"tpu_initialization":
+    # 0.02, "training_prep": 0.01, "data_loading_sync": 0.05, "other": ...}.
+    badput: Dict[str, float] = dataclasses.field(default_factory=dict)

@@ -34,6 +34,7 @@ from tpu_pipelines.parallel.mesh import (
     replicate,
 )
 from tpu_pipelines.trainer.fn_args import TrainResult
+from tpu_pipelines.trainer.goodput import GoodputTracker
 
 log = logging.getLogger("tpu_pipelines.trainer")
 
@@ -172,13 +173,29 @@ def train_loop(
            -> (loss, (metrics, new_model_state))``
     and the returned "final params" is ``(params, model_state)``.
     """
+    # Badput accounting (SURVEY.md §5): the real ml_goodput_measurement
+    # algebra over a local logger; falls back to the host-input-wait proxy
+    # when the library is absent (tracker no-ops, summary() == {}).
+    tracker = GoodputTracker(
+        job_name="train_loop",
+        jsonl_path=(
+            os.path.join(checkpoint_dir, "goodput_log.jsonl")
+            if checkpoint_dir else ""
+        ),
+    )
+    tracker.job_start()
+    tracker.tpu_init_start()
     if mesh is None:
         mesh = make_mesh(config.mesh_config)
     n_devices = mesh.devices.size
+    tracker.tpu_init_end()
 
     train_it = iter(train_iter)
+    tracker.data_loading_start()
     first_batch = next(train_it)
+    tracker.data_loading_end()
 
+    tracker.training_prep_start()
     rng = (
         jax.random.key(config.seed, impl=config.prng_impl)
         if config.prng_impl else jax.random.key(config.seed)
@@ -319,6 +336,7 @@ def train_loop(
             )
             start_step = int(latest)
             log.info("resumed from checkpoint step %d", start_step)
+    tracker.training_prep_end()
 
     # ---- the loop
     def put_batch(b):
@@ -338,6 +356,7 @@ def train_loop(
         if config.profile_dir and not profiling and step - start_step == config.profile_from:
             jax.profiler.start_trace(config.profile_dir)
             profiling = True
+        tracker.step_start(step)
         t_in = time.perf_counter()
         device_batch = put_batch(batch)
         if t_start is not None:  # only measure the post-compile window
@@ -382,7 +401,13 @@ def train_loop(
             break
         try:
             t_in = time.perf_counter()
-            batch = next(train_it)
+            tracker.data_loading_start()
+            try:
+                batch = next(train_it)
+            finally:
+                # On StopIteration too — an open-ended data-loading interval
+                # would misattribute everything through job_end as badput.
+                tracker.data_loading_end()
             if t_start is not None:
                 input_wait_s += time.perf_counter() - t_in
         except StopIteration:
@@ -414,21 +439,26 @@ def train_loop(
             mngr.save(step, args=_ocp_save_args(state), force=True)
         mngr.wait_until_finished()
 
+    tracker.job_end()
+    gsum = tracker.summary()
+    # The proxy stays the reported floor when the library is absent; when
+    # present, the library's number is the real (stricter) figure — it counts
+    # init/prep/compile windows as badput, so short runs read lower.
+    proxy_goodput = (
+        round(max(0.0, 1.0 - input_wait_s / elapsed), 4)
+        if examples_after_t0 else 1.0
+    )
     result = TrainResult(
         final_metrics=final_metrics,
         examples_per_sec=round(eps, 2),
         examples_per_sec_per_chip=round(eps / n_devices, 2),
         steps_completed=step,
         resumed_from_step=start_step,
-        # Goodput proxy (SURVEY.md §5 failure/goodput accounting): fraction
-        # of post-compile wall-clock not spent in host-side input work.
-        # Host input may overlap async device execution, so this is a LOWER
-        # bound on true device goodput; 1.0 when too few post-compile steps
-        # ran to measure anything.
-        goodput=(
-            round(max(0.0, 1.0 - input_wait_s / elapsed), 4)
-            if examples_after_t0 else 1.0
+        goodput=gsum.get("goodput", proxy_goodput),
+        goodput_source=(
+            "ml_goodput_measurement" if gsum else "host_input_wait_proxy"
         ),
+        badput=gsum.get("badput", {}),
     )
     final = (
         (state.params, state.model_state) if has_model_state
