@@ -7,6 +7,8 @@ the framework's jitted mesh-sharded train loop, then exports a self-contained
 serving payload (params + module + transform graph).
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import optax
@@ -63,6 +65,7 @@ def run_fn(fn_args):
             checkpoint_every=max(1, fn_args.train_steps // 4),
             log_every=max(1, fn_args.train_steps // 10),
             mesh_config=mesh_cfg,
+            tensorboard_dir=os.path.join(fn_args.model_run_dir, "tensorboard"),
         ),
         checkpoint_dir=fn_args.model_run_dir,
     )

@@ -272,3 +272,91 @@ def test_request_batcher_closed_raises(tmp_path):
     b.close()
     with pytest.raises(RuntimeError, match="closed"):
         b.submit({"x": np.ones((1, 2), np.float32)}, 1)
+
+
+def test_serving_cli_boot_hotswap_and_shutdown(tmp_path):
+    """python -m tpu_pipelines.serving serves, hot-swaps versions, stops."""
+    import subprocess
+    import sys
+    import time
+
+    base = tmp_path / "versions"
+    base.mkdir()
+    _export(tmp_path, "versions/1", scale=1.0)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_pipelines.serving",
+         "--model-name", "m", "--base-dir", str(base),
+         "--port", "0", "--host", "127.0.0.1", "--poll-seconds", "0.2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    # Port 0 binds ephemerally; read the bound port from the log line.
+    port = None
+    deadline = time.time() + 60
+    lines = []
+    try:
+        while time.time() < deadline and port is None:
+            line = proc.stdout.readline()
+            lines.append(line)
+            if "serving 'm'" in line and "127.0.0.1:" in line:
+                port = int(line.rsplit(":", 1)[1])
+        assert port, lines
+
+        def status():
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/models/m", timeout=10
+            ) as r:
+                return json.load(r)["model_version_status"][0]["version"]
+
+        def predict():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/models/m:predict",
+                data=json.dumps({"inputs": {"x": [[1.0, 0.0, 0.0]]}}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.load(r)["predictions"]
+
+        assert status() == "1"
+        assert predict()[0][0] == pytest.approx(1.0)
+
+        # Push version 2 (doubled weights): the watcher must hot-swap.
+        _export(tmp_path, "versions/2", scale=2.0)
+        deadline = time.time() + 30
+        while time.time() < deadline and status() != "2":
+            time.sleep(0.2)
+        assert status() == "2"
+        assert predict()[0][0] == pytest.approx(2.0)
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise
+    assert proc.returncode == 0
+
+
+def test_serving_manifest_emission(tmp_path):
+    import yaml
+
+    from tpu_pipelines.orchestration import TPUJobRunner, TPUJobRunnerConfig
+
+    runner = TPUJobRunner(TPUJobRunnerConfig(
+        image="img:1", pipeline_module="/app/p.py",
+        output_dir=str(tmp_path / "m"), shared_volume_claim="pvc",
+    ))
+    path = runner.emit_serving_manifests(
+        "taxi", "/pipeline/serving/taxi", replicas=2
+    )
+    docs = list(yaml.safe_load_all(open(path)))
+    dep, svc = docs
+    assert dep["kind"] == "Deployment" and svc["kind"] == "Service"
+    assert dep["spec"]["replicas"] == 2
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert c["command"][:3] == ["python", "-m", "tpu_pipelines.serving"]
+    assert "--batching" in c["command"]
+    assert "/pipeline/serving/taxi" in c["command"]
+    assert c["readinessProbe"]["httpGet"]["path"] == "/v1/models/taxi"
+    assert c["volumeMounts"]
+    assert svc["spec"]["ports"][0]["port"] == 8501
+    assert dep["spec"]["selector"]["matchLabels"] == svc["spec"]["selector"]

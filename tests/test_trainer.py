@@ -254,3 +254,32 @@ def test_goodput_tracker_disabled_is_noop(monkeypatch):
     assert not t.enabled
     t.job_start(); t.step_start(0); t.job_end()
     assert t.summary() == {}
+
+
+def test_tensorboard_scalar_sink(tmp_path):
+    """tensorboard_dir produces tf.summary event files with the metrics."""
+    pytest.importorskip("clu")
+    pytest.importorskip("tensorboard")
+    loss_fn, init_fn = _linreg_pieces()
+    tb = tmp_path / "tb"
+    train_loop(
+        loss_fn=loss_fn,
+        init_params_fn=init_fn,
+        optimizer=optax.adam(0.1),
+        train_iter=_synthetic_iter(),
+        config=TrainLoopConfig(
+            train_steps=20, batch_size=32, log_every=5,
+            tensorboard_dir=str(tb),
+        ),
+    )
+    events = [f for f in os.listdir(tb) if "tfevents" in f]
+    assert events, os.listdir(tb)
+    # The event file really carries the loss scalar at the logged steps.
+    from tensorboard.backend.event_processing.event_accumulator import (
+        EventAccumulator,
+    )
+
+    acc = EventAccumulator(str(tb))
+    acc.Reload()
+    tags = acc.Tags()["tensors"] + acc.Tags().get("scalars", [])
+    assert any("loss" in t for t in tags), tags

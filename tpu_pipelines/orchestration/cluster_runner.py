@@ -344,6 +344,89 @@ class TPUJobRunner:
             },
         }
 
+    # -------------------------------------------------------- serving
+
+    def emit_serving_manifests(
+        self,
+        model_name: str,
+        model_base_dir: str,
+        *,
+        replicas: int = 1,
+        port: int = 8501,
+        batching: bool = True,
+        on_tpu: bool = False,
+    ) -> str:
+        """Deployment + Service for the standalone model server — the
+        workshop's TF-Serving/KFServing deployment YAML equivalent (SURVEY.md
+        §2d, §3.5).  ``model_base_dir`` is the Pusher destination (versioned
+        layout) on the shared volume; the server's ``--poll-seconds`` watcher
+        hot-swaps each newly pushed version, so pushing IS deploying.
+        ``on_tpu`` schedules serving pods onto TPU nodes for jitted on-chip
+        inference; default is CPU serving (the usual canary/low-QPS shape).
+        """
+        cfg = self.config
+        name = k8s_name(f"{model_name}-serving")
+        labels = {"tpu-pipelines/serving": k8s_name(model_name)}
+        command = [
+            "python", "-m", "tpu_pipelines.serving",
+            "--model-name", model_name,
+            "--base-dir", model_base_dir,
+            "--port", str(port),
+        ]
+        if batching:
+            command.append("--batching")
+        container: Dict[str, Any] = {
+            "name": "model-server",
+            "image": cfg.image,
+            "command": command,
+            "ports": [{"containerPort": port}],
+            "readinessProbe": {
+                "httpGet": {"path": f"/v1/models/{model_name}", "port": port},
+                "initialDelaySeconds": 5,
+                "periodSeconds": 10,
+            },
+            "resources": (
+                self._node_resources("BulkInferrer") if on_tpu
+                else {"requests": {"cpu": "2", "memory": "4Gi"}}
+            ),
+        }
+        if cfg.shared_volume_claim:
+            container["volumeMounts"] = self._volume_mounts()
+        pod_spec: Dict[str, Any] = {"containers": [container]}
+        if cfg.shared_volume_claim:
+            pod_spec["volumes"] = self._volumes()
+        if on_tpu:
+            pod_spec["nodeSelector"] = self._tpu_node_selector()
+        deployment = {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": name, "namespace": cfg.namespace,
+                         "labels": labels},
+            "spec": {
+                "replicas": replicas,
+                "selector": {"matchLabels": labels},
+                "template": {
+                    "metadata": {"labels": labels},
+                    "spec": pod_spec,
+                },
+            },
+        }
+        service = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": name, "namespace": cfg.namespace,
+                         "labels": labels},
+            "spec": {
+                "selector": labels,
+                "ports": [{"port": port, "targetPort": port}],
+            },
+        }
+        os.makedirs(cfg.output_dir, exist_ok=True)
+        path = os.path.join(cfg.output_dir, f"serving_{k8s_name(model_name)}.yaml")
+        with open(path, "w") as f:
+            _yaml().safe_dump_all([deployment, service], f, sort_keys=True)
+        return path
+
     def _volumes(self) -> List[Dict[str, Any]]:
         return [{
             "name": "pipeline-shared",

@@ -1,0 +1,82 @@
+"""Standalone model server: ``python -m tpu_pipelines.serving``.
+
+The ``tensorflow_model_server`` equivalent (SURVEY.md §3.5 / §2b TF Serving
+row) for the framework's payload format: serves Pusher's versioned layout
+over TF-Serving-style REST, watches the base dir for newly pushed versions
+(``--poll-seconds``) and hot-swaps to the highest one, exactly like TF
+Serving's file-system version watcher.  This is the process the emitted
+serving Deployment manifest runs (orchestration/cluster_runner.py).
+
+    python -m tpu_pipelines.serving \
+        --model-name taxi --base-dir /pipeline/serving/taxi --port 8501
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+from tpu_pipelines.serving.server import ModelServer
+
+log = logging.getLogger("tpu_pipelines.serving")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--model-name", required=True)
+    parser.add_argument("--base-dir", required=True,
+                        help="versioned model dir (Pusher destination)")
+    parser.add_argument("--port", type=int, default=8501)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--transformed-inputs", action="store_true",
+                        help="serve predict_transformed (callers send "
+                             "materialized features, not raw examples)")
+    parser.add_argument("--batching", action="store_true",
+                        help="micro-batch concurrent requests (bucketed "
+                             "shapes, one device call per batch)")
+    parser.add_argument("--max-batch-size", type=int, default=64)
+    parser.add_argument("--batch-timeout-ms", type=float, default=5.0)
+    parser.add_argument("--poll-seconds", type=float, default=30.0,
+                        help="version-watch interval; 0 disables hot reload")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    server = ModelServer(
+        args.model_name,
+        args.base_dir,
+        raw=not args.transformed_inputs,
+        batching=args.batching,
+        max_batch_size=args.max_batch_size,
+        batch_timeout_s=args.batch_timeout_ms / 1000.0,
+    )
+    port = server.start(port=args.port, host=args.host)
+    log.info(
+        "serving %r (version %s) on %s:%d",
+        args.model_name, server.version, args.host, port,
+    )
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    try:
+        while not stop.wait(args.poll_seconds or None):
+            if not args.poll_seconds:
+                continue
+            try:
+                before = server.version
+                after = server.reload()
+                if after != before:
+                    log.info("hot-swapped to version %s", after)
+            except Exception as e:  # noqa: BLE001 — keep serving old version
+                log.warning("version rescan failed: %s", e)
+    finally:
+        server.stop()
+        log.info("server stopped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,6 +93,10 @@ class TrainLoopConfig:
     profile_dir: str = ""
     profile_from: int = 2
     profile_to: int = 5
+    # TensorBoard scalar sink (SURVEY.md §5 observability, the Keras
+    # TensorBoard-callback equivalent): when set, train metrics at log_every
+    # cadence + eval metrics land there as tf.summary scalars via clu.
+    tensorboard_dir: str = ""
 
 
 LossFn = Callable[[Any, Dict[str, jax.Array], jax.Array], Tuple[jax.Array, Dict[str, jax.Array]]]
@@ -344,6 +348,29 @@ def train_loop(
             lambda x, s: jax.device_put(np.asarray(x), s), b, batch_shard
         )
 
+    tb_writer = None
+    if config.tensorboard_dir and jax.process_index() == 0:
+        # Process 0 only (multi-host peers would write N duplicate points per
+        # tag into the shared logdir).  Lazy import — clu pulls TensorFlow —
+        # and optional: a missing clu degrades to no sink, not a dead loop.
+        try:
+            from clu import metric_writers
+
+            tb_writer = metric_writers.SummaryWriter(config.tensorboard_dir)
+        except ImportError as e:
+            log.warning("tensorboard_dir set but clu unavailable (%s)", e)
+
+    last_tb = {"train": -1, "eval": -1}
+
+    def tb_write(kind: str, at_step: int, scalars: Dict[str, float]) -> None:
+        if tb_writer is None or not scalars:
+            return
+        tb_writer.write_scalars(at_step, scalars)
+        # Flush per write (log_every cadence, so amortized): a crash mid-run
+        # must not lose the tail of the curve to tf.summary buffering.
+        tb_writer.flush()
+        last_tb[kind] = at_step
+
     metrics_hist: list = []
     metrics = None   # stays None when resume starts at/past train_steps
     t_start = None
@@ -384,6 +411,7 @@ def train_loop(
             metrics_hist.append((step, host_metrics))
             if metrics_cb:
                 metrics_cb(step, host_metrics)
+            tb_write("train", step, host_metrics)
             log.info("step %d: %s", step, host_metrics)
         if mngr is not None and config.checkpoint_every:
             mngr.save(step, args=_ocp_save_args(state))
@@ -396,6 +424,7 @@ def train_loop(
                            has_model_state)
             if metrics_cb:
                 metrics_cb(step, {f"eval_{k}": v for k, v in ev.items()})
+            tb_write("eval", step, {f"eval_{k}": v for k, v in ev.items()})
             log.info("step %d eval: %s", step, ev)
         if step >= config.train_steps:
             break
@@ -433,6 +462,23 @@ def train_loop(
         ev = _run_eval(eval_step, state, eval_iter_fn, config, put_batch,
                        has_model_state)
         final_metrics.update({f"eval_{k}": v for k, v in ev.items()})
+
+    if tb_writer is not None:
+        # Only what the in-loop cadence didn't already emit at this step —
+        # a same-tag/same-step rewrite doubles points in TensorBoard.
+        tail: Dict[str, float] = {}
+        if step != last_tb["train"]:
+            tail.update({
+                k: v for k, v in final_metrics.items()
+                if not k.startswith("eval_")
+            })
+        if step != last_tb["eval"]:
+            tail.update({
+                k: v for k, v in final_metrics.items()
+                if k.startswith("eval_")
+            })
+        tb_write("train", step, tail)
+        tb_writer.close()
 
     if mngr is not None:
         if mngr.latest_step() != step:
