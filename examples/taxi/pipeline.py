@@ -1,0 +1,90 @@
+"""Chicago-Taxi pipeline (BASELINE configs[0] — the reference's canonical
+demo): the full canonical DAG over the bundled taxi sample.
+
+    CsvExampleGen -> StatisticsGen -> SchemaGen -> ExampleValidator
+      -> Transform -> Trainer -> Evaluator -> InfraValidator -> Pusher
+
+``create_pipeline()`` is the contract every runner consumes: run it locally
+with ``python -m tpu_pipelines run --pipeline-module examples/taxi/pipeline.py``
+(or just ``python examples/taxi/pipeline.py``), or hand this file to
+TPUJobRunnerConfig.pipeline_module for cluster manifests.  Output lands under
+``$TPP_PIPELINE_HOME`` (default: ``examples/taxi/_run``).
+"""
+
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(os.path.dirname(HERE))
+DATA_CSV = os.environ.get(
+    "TAXI_DATA_CSV", os.path.join(REPO, "tests", "testdata", "taxi_sample.csv")
+)
+
+
+def create_pipeline(base_dir: str = ""):
+    from tpu_pipelines.components import (
+        CsvExampleGen,
+        Evaluator,
+        ExampleValidator,
+        InfraValidator,
+        Pusher,
+        SchemaGen,
+        StatisticsGen,
+        Trainer,
+        Transform,
+    )
+    from tpu_pipelines.dsl.pipeline import Pipeline
+
+    base = base_dir or os.environ.get(
+        "TPP_PIPELINE_HOME", os.path.join(HERE, "_run")
+    )
+    gen = CsvExampleGen(input_path=DATA_CSV)
+    stats = StatisticsGen(examples=gen.outputs["examples"])
+    schema = SchemaGen(statistics=stats.outputs["statistics"])
+    validator = ExampleValidator(
+        statistics=stats.outputs["statistics"],
+        schema=schema.outputs["schema"],
+    )
+    transform = Transform(
+        examples=gen.outputs["examples"],
+        schema=schema.outputs["schema"],
+        module_file=os.path.join(HERE, "taxi_preprocessing.py"),
+    )
+    trainer = Trainer(
+        examples=transform.outputs["transformed_examples"],
+        transform_graph=transform.outputs["transform_graph"],
+        module_file=os.path.join(HERE, "taxi_trainer_module.py"),
+        train_steps=int(os.environ.get("TAXI_TRAIN_STEPS", "200")),
+        hyperparameters={"batch_size": int(os.environ.get("TAXI_BATCH", "32"))},
+    )
+    evaluator = Evaluator(
+        examples=transform.outputs["transformed_examples"],
+        model=trainer.outputs["model"],
+        label_key="label_big_tip",
+        slice_columns=["hour_bucket"],
+        value_thresholds={"accuracy": {"lower_bound": 0.5}},
+    )
+    infra = InfraValidator(
+        model=trainer.outputs["model"],
+        examples=gen.outputs["examples"],
+    )
+    pusher = Pusher(
+        model=trainer.outputs["model"],
+        blessing=evaluator.outputs["blessing"],
+        infra_blessing=infra.outputs["blessing"],
+        push_destination=os.path.join(base, "serving", "taxi"),
+    )
+    return Pipeline(
+        "chicago-taxi",
+        [gen, stats, schema, validator, transform, trainer, evaluator,
+         infra, pusher],
+        pipeline_root=os.path.join(base, "root"),
+        metadata_path=os.path.join(base, "metadata.sqlite"),
+    )
+
+
+if __name__ == "__main__":
+    from tpu_pipelines.orchestration import LocalDagRunner
+
+    result = LocalDagRunner().run(create_pipeline())
+    for node_id, nr in result.nodes.items():
+        print(f"  {node_id}: {nr.status}")

@@ -1,0 +1,47 @@
+"""Every example pipeline.py runs end-to-end through the `run` CLI contract.
+
+These are the workshop-notebook equivalents (SURVEY.md §2d): one runnable
+module per BASELINE config. Each test shrinks the workload via the module's
+env knobs and runs it twice — the second run must be fully cached.
+"""
+
+import os
+
+import pytest
+
+HERE = os.path.dirname(__file__)
+EXAMPLES = os.path.join(os.path.dirname(HERE), "examples")
+
+
+def _run_cli(monkeypatch, tmp_path, name, env):
+    from tpu_pipelines.__main__ import main
+
+    monkeypatch.setenv("TPP_PIPELINE_HOME", str(tmp_path / "home"))
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    module = os.path.join(EXAMPLES, name, "pipeline.py")
+    assert main(["run", "--pipeline-module", module]) == 0
+    return module
+
+
+@pytest.mark.parametrize("name,env", [
+    ("taxi", {"TAXI_TRAIN_STEPS": "8"}),
+    ("mnist", {"MNIST_TRAIN_STEPS": "4"}),
+    ("resnet", {"RESNET_TRAIN_STEPS": "2", "RESNET_DEPTH": "18",
+                "RESNET_IMAGE_SIZE": "8", "RESNET_BATCH": "8"}),
+    ("bert", {"BERT_TRAIN_STEPS": "4", "BERT_TINY": "1"}),
+    ("t5", {"T5_TRAIN_STEPS": "2", "T5_TINY": "1"}),
+])
+def test_example_pipeline_runs_and_caches(monkeypatch, tmp_path, capsys,
+                                          name, env):
+    module = _run_cli(monkeypatch, tmp_path, name, env)
+    out1 = capsys.readouterr().out
+    assert ": done" in out1 and "FAILED" not in out1
+
+    # Second run: every node must come from the execution cache.
+    from tpu_pipelines.__main__ import main
+
+    assert main(["run", "--pipeline-module", module]) == 0
+    out2 = capsys.readouterr().out
+    assert ": done" not in out2, out2
+    assert ": cached" in out2

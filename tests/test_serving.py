@@ -282,7 +282,8 @@ def test_serving_cli_boot_hotswap_and_shutdown(tmp_path):
 
     base = tmp_path / "versions"
     base.mkdir()
-    _export(tmp_path, "versions/1", scale=1.0)
+    # Deliberately started BEFORE any version exists: the server must wait
+    # for the first push instead of crash-looping.
     proc = subprocess.Popen(
         [sys.executable, "-m", "tpu_pipelines.serving",
          "--model-name", "m", "--base-dir", str(base),
@@ -291,15 +292,20 @@ def test_serving_cli_boot_hotswap_and_shutdown(tmp_path):
     )
     # Port 0 binds ephemerally; read the bound port from the log line.
     port = None
-    deadline = time.time() + 60
+    waited = False
+    deadline = time.time() + 90
     lines = []
     try:
         while time.time() < deadline and port is None:
             line = proc.stdout.readline()
             lines.append(line)
+            if "waiting for the first push" in line and not waited:
+                waited = True
+                _export(tmp_path, "versions/1", scale=1.0)
             if "serving 'm'" in line and "127.0.0.1:" in line:
                 port = int(line.rsplit(":", 1)[1])
         assert port, lines
+        assert waited, "server should have waited for the first version"
 
         def status():
             with urllib.request.urlopen(

@@ -1,9 +1,16 @@
-"""Observability CLI: ``python -m tpu_pipelines inspect ...``.
+"""Framework CLI: ``python -m tpu_pipelines {run,inspect} ...``.
 
-The MLMD-UI / KFP-UI equivalent surface (SURVEY.md §5 metrics/observability):
-the metadata store is the observability backbone — every artifact, execution,
-lineage edge, and per-node wall-clock is recorded there — and this CLI is the
-user-facing way to read it back:
+``run`` — execute a pipeline module locally (the ``tfx run`` /
+LocalDagRunner-notebook equivalent):
+
+    python -m tpu_pipelines run --pipeline-module examples/taxi/pipeline.py
+    python -m tpu_pipelines run --pipeline-module p.py --param steps=500 \
+        --from-node Trainer          # partial run, upstream from cache
+
+``inspect`` — the MLMD-UI / KFP-UI equivalent surface (SURVEY.md §5
+metrics/observability): the metadata store is the observability backbone —
+every artifact, execution, lineage edge, and per-node wall-clock is recorded
+there — and this CLI is the user-facing way to read it back:
 
     python -m tpu_pipelines inspect runs <pipeline> --metadata md.sqlite
     python -m tpu_pipelines inspect lineage <artifact-id> --metadata md.sqlite
@@ -79,21 +86,48 @@ def main(argv=None) -> int:
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
     sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_run = sub.add_parser("run", help="run a pipeline module locally")
+    p_run.add_argument("--pipeline-module", required=True,
+                       help="file defining create_pipeline() -> Pipeline")
+    p_run.add_argument("--param", action="append", default=[],
+                       metavar="NAME=VALUE",
+                       help="runtime parameter override (JSON value or "
+                            "string); repeatable")
+    p_run.add_argument("--from-node", action="append", default=[],
+                       help="partial run: start here, upstreams from store")
+    p_run.add_argument("--to-node", action="append", default=[],
+                       help="partial run: stop here")
+    p_run.add_argument("--max-retries", type=int, default=0)
+
     inspect = sub.add_parser("inspect", help="read the metadata store")
-    inspect.add_argument("--metadata", required=True,
+    # On the parent AND each leaf, so both argument orders work:
+    #   inspect --metadata md.sqlite runs <p>   /   inspect runs <p> --metadata md.sqlite
+    inspect.add_argument("--metadata", default=None,
                          help="path to the pipeline's metadata sqlite")
+    md_parent = argparse.ArgumentParser(add_help=False)
+    # SUPPRESS: a leaf parse without --metadata must not clobber the value
+    # the parent-level option already set.
+    md_parent.add_argument("--metadata", default=argparse.SUPPRESS)
     isub = inspect.add_subparsers(dest="what", required=True)
 
-    p_runs = isub.add_parser("runs", help="runs + per-node wall-clocks")
+    p_runs = isub.add_parser("runs", parents=[md_parent],
+                             help="runs + per-node wall-clocks")
     p_runs.add_argument("pipeline", help="pipeline name")
 
-    p_lin = isub.add_parser("lineage", help="provenance chain of an artifact")
+    p_lin = isub.add_parser("lineage", parents=[md_parent],
+                            help="provenance chain of an artifact")
     p_lin.add_argument("artifact_id", type=int)
 
-    p_art = isub.add_parser("artifacts", help="list artifacts")
+    p_art = isub.add_parser("artifacts", parents=[md_parent],
+                            help="list artifacts")
     p_art.add_argument("--type", default="", help="filter by artifact type")
 
     args = parser.parse_args(argv)
+    if args.cmd == "run":
+        return cmd_run(args)
+    if not args.metadata:
+        inspect.error("the following arguments are required: --metadata")
     store = MetadataStore(args.metadata)
     try:
         if args.what == "runs":
@@ -103,6 +137,45 @@ def main(argv=None) -> int:
         return cmd_artifacts(store, args.type)
     finally:
         store.close()
+
+
+def cmd_run(args) -> int:
+    import json
+    import logging
+
+    from tpu_pipelines.orchestration import LocalDagRunner
+    from tpu_pipelines.utils.module_loader import load_fn
+
+    logging.basicConfig(level=logging.INFO)
+    params = {}
+    for spec in args.param:
+        name, eq, raw = spec.partition("=")
+        if not eq:
+            print(f"--param needs NAME=VALUE, got {spec!r}")
+            return 2
+        try:
+            params[name] = json.loads(raw)
+        except json.JSONDecodeError:
+            params[name] = raw  # plain string value
+    pipeline = load_fn(args.pipeline_module, "create_pipeline")()
+    result = LocalDagRunner(max_retries=args.max_retries).run(
+        pipeline,
+        runtime_parameters=params,
+        from_nodes=args.from_node or None,
+        to_nodes=args.to_node or None,
+        raise_on_failure=False,
+    )
+    print(f"run {result.run_id}: "
+          f"{'OK' if result.succeeded else 'FAILED'}")
+    for node_id, nr in result.nodes.items():
+        mark = {"COMPLETE": "done", "CACHED": "cached"}.get(
+            nr.status, nr.status
+        )
+        wall = f" ({nr.wall_clock_s:.1f}s)" if nr.wall_clock_s else ""
+        err = f"  !! {nr.error}" if nr.error else ""
+        print(f"  {node_id}: {mark}{wall}{err}")
+    print(f"metadata: {pipeline.metadata_path}")
+    return 0 if result.succeeded else 1
 
 
 if __name__ == "__main__":

@@ -44,14 +44,27 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
-    server = ModelServer(
-        args.model_name,
-        args.base_dir,
-        raw=not args.transformed_inputs,
-        batching=args.batching,
-        max_batch_size=args.max_batch_size,
-        batch_timeout_s=args.batch_timeout_ms / 1000.0,
-    )
+    # "Pushing IS deploying": the Deployment may come up before the first
+    # Pusher run, so wait for the first version instead of crash-looping.
+    import time
+
+    while True:
+        try:
+            server = ModelServer(
+                args.model_name,
+                args.base_dir,
+                raw=not args.transformed_inputs,
+                batching=args.batching,
+                max_batch_size=args.max_batch_size,
+                batch_timeout_s=args.batch_timeout_ms / 1000.0,
+            )
+            break
+        except FileNotFoundError:
+            log.info(
+                "no model versions under %r yet; waiting for the first push",
+                args.base_dir,
+            )
+            time.sleep(max(args.poll_seconds, 1.0))
     port = server.start(port=args.port, host=args.host)
     log.info(
         "serving %r (version %s) on %s:%d",
@@ -63,8 +76,6 @@ def main(argv=None) -> int:
         signal.signal(sig, lambda *_: stop.set())
     try:
         while not stop.wait(args.poll_seconds or None):
-            if not args.poll_seconds:
-                continue
             try:
                 before = server.version
                 after = server.reload()
