@@ -16,23 +16,32 @@ import os
 import numpy as np
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-IMAGE_SIZE = int(os.environ.get("RESNET_IMAGE_SIZE", "32"))
-N_CLASSES = int(os.environ.get("RESNET_CLASSES", "10"))
+
+
+def _image_size() -> int:
+    # Read at call time, not import time: load_fn caches modules by path, so
+    # module-level reads would freeze env knobs at first import.
+    return int(os.environ.get("RESNET_IMAGE_SIZE", "32"))
+
+
+def _n_classes() -> int:
+    return int(os.environ.get("RESNET_CLASSES", "10"))
 
 
 def _ensure_data(base: str) -> str:
     given = os.environ.get("RESNET_NPZ", "")
     if given:
         return given
-    path = os.path.join(base, f"images_{IMAGE_SIZE}.npz")
+    image_size, n_classes = _image_size(), _n_classes()
+    path = os.path.join(base, f"images_{image_size}_c{n_classes}.npz")
     if not os.path.exists(path):
         os.makedirs(base, exist_ok=True)
         rng = np.random.default_rng(0)
         n = 2048
-        labels = rng.integers(0, N_CLASSES, size=n)
-        base_img = labels[:, None, None, None] / N_CLASSES
+        labels = rng.integers(0, n_classes, size=n)
+        base_img = labels[:, None, None, None] / n_classes
         images = (
-            base_img + 0.1 * rng.normal(size=(n, IMAGE_SIZE, IMAGE_SIZE, 3))
+            base_img + 0.1 * rng.normal(size=(n, image_size, image_size, 3))
         ).astype(np.float32)
         np.savez(path, image=images.reshape(n, -1),
                  label=labels.astype(np.int64))
@@ -53,8 +62,8 @@ def create_pipeline(base_dir: str = ""):
         train_steps=int(os.environ.get("RESNET_TRAIN_STEPS", "60")),
         hyperparameters={
             "depth": int(os.environ.get("RESNET_DEPTH", "50")),
-            "num_classes": N_CLASSES,
-            "image_size": IMAGE_SIZE,
+            "num_classes": _n_classes(),
+            "image_size": _image_size(),
             "batch_size": int(os.environ.get("RESNET_BATCH", "64")),
         },
     )

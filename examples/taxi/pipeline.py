@@ -15,9 +15,14 @@ import os
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(os.path.dirname(HERE))
-DATA_CSV = os.environ.get(
-    "TAXI_DATA_CSV", os.path.join(REPO, "tests", "testdata", "taxi_sample.csv")
-)
+
+
+def _data_csv() -> str:
+    # Read at call time (load_fn caches modules; see resnet pipeline note).
+    return os.environ.get(
+        "TAXI_DATA_CSV",
+        os.path.join(REPO, "tests", "testdata", "taxi_sample.csv"),
+    )
 
 
 def create_pipeline(base_dir: str = ""):
@@ -37,7 +42,7 @@ def create_pipeline(base_dir: str = ""):
     base = base_dir or os.environ.get(
         "TPP_PIPELINE_HOME", os.path.join(HERE, "_run")
     )
-    gen = CsvExampleGen(input_path=DATA_CSV)
+    gen = CsvExampleGen(input_path=_data_csv())
     stats = StatisticsGen(examples=gen.outputs["examples"])
     schema = SchemaGen(statistics=stats.outputs["statistics"])
     validator = ExampleValidator(

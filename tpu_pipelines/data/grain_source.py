@@ -1,0 +1,145 @@
+"""Grain data source over Examples splits: the multiprocess reader backend.
+
+SURVEY.md §2b (Beam row) names the replacement for the reference's Beam data
+plane as "sharded map over Grain + multiprocessing" — this is that backend:
+a ``RandomAccessDataSource`` over the Parquet row-group layout ExampleGen
+writes, driven by ``grain.python.DataLoader`` with ``worker_count``
+subprocesses.  Each worker re-opens the Parquet file lazily (handles never
+cross the fork/pickle boundary) and caches its last row group, so random
+access under a shuffled ``IndexSampler`` stays row-group-local per worker.
+
+Selected through the ordinary input contract:
+``InputConfig(use_grain=True, grain_workers=N)`` — `BatchIterator` then
+yields the same dict-of-numpy batches from Grain's prefetching workers
+instead of the in-process readers.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from tpu_pipelines.data import examples_io
+
+
+class ParquetRowSource:
+    """Random-access rows of one Examples split (Grain source protocol:
+    ``__len__`` + ``__getitem__``), lazy and per-thread-cached.
+
+    THREAD SAFETY: Grain's per-worker prefetch drives ``__getitem__`` from a
+    ThreadPoolExecutor, and pyarrow's ``ParquetFile.read_row_group`` is not
+    safe on a handle shared across threads (concurrent reads segfault in
+    native code).  Every reader thread therefore gets its own handle and its
+    own last-row-group cache via ``threading.local`` — reads stay lock-free
+    and row-group-local per thread."""
+
+    def __init__(self, uri: str, split: str, columns: Optional[List[str]] = None):
+        self.path = os.path.join(
+            examples_io.split_dir(uri, split), examples_io.DATA_FILE
+        )
+        if not os.path.isfile(self.path):
+            raise FileNotFoundError(
+                f"Examples artifact at {uri!r} has no split {split!r} "
+                f"(available: {examples_io.split_names(uri)})"
+            )
+        self.columns = list(columns) if columns else None
+        import pyarrow.parquet as pq
+
+        self._local = threading.local()
+        pf = pq.ParquetFile(self.path)
+        try:
+            meta = pf.metadata
+            counts = [
+                meta.row_group(i).num_rows for i in range(meta.num_row_groups)
+            ]
+        finally:
+            pf.close()
+        self._group_ends = np.cumsum(counts)
+        self._n = int(self._group_ends[-1]) if counts else 0
+
+    # ---- pickling: workers get path + layout, never open handles/caches
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_local"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._local = threading.local()
+
+    def __len__(self) -> int:
+        return self._n
+
+    def _load_group(self, group: int) -> Dict[str, np.ndarray]:
+        local = self._local
+        cache = getattr(local, "cache", None)
+        if cache is not None and cache[0] == group:
+            return cache[1]
+        pf = getattr(local, "pf", None)
+        if pf is None:
+            import pyarrow.parquet as pq
+
+            pf = local.pf = pq.ParquetFile(self.path)
+        table = pf.read_row_group(group, columns=self.columns)
+        cols = examples_io.columns_from_table(table)
+        local.cache = (group, cols)
+        return cols
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        if not 0 <= idx < self._n:
+            raise IndexError(idx)
+        group = int(np.searchsorted(self._group_ends, idx, side="right"))
+        start = 0 if group == 0 else int(self._group_ends[group - 1])
+        cols = self._load_group(group)
+        row = idx - start
+        return {k: v[row] for k, v in cols.items()}
+
+
+def grain_batches(uri: str, split: str, config, columns=None):
+    """Infinite-or-num_epochs iterator of dict-of-numpy batches via Grain.
+
+    ``config`` is an ``InputConfig``; sharding (shard_index/num_shards),
+    shuffle seed, batch size, and drop_remainder all map onto Grain's
+    sampler/operations, and ``grain_workers`` subprocesses do the reads.
+    (Workers inherit the parent env and this environment preloads jax into
+    every interpreter, but readers never touch jax devices, so no backend
+    initializes in them.)
+
+    One single-epoch loader per epoch, NOT one multi-epoch sampler: Grain
+    would emit a flat index stream whose batches straddle epoch boundaries,
+    breaking the steps_per_epoch()/per-epoch-reshuffle contract the
+    in-process readers keep.  The cost is a worker-pool respawn per epoch —
+    noise next to an epoch of training.
+    """
+    import grain.python as pg
+
+    source = ParquetRowSource(uri, split, columns)
+    epoch = 0
+    while config.num_epochs is None or epoch < config.num_epochs:
+        sampler = pg.IndexSampler(
+            num_records=len(source),
+            shard_options=pg.ShardOptions(
+                shard_index=config.shard_index,
+                shard_count=config.num_shards,
+                drop_remainder=config.drop_remainder,
+            ),
+            shuffle=config.shuffle,
+            num_epochs=1,
+            # Distinct per-epoch reshuffle, deterministic in (seed, epoch).
+            seed=config.seed * 100_003 + epoch,
+        )
+        loader = pg.DataLoader(
+            data_source=source,
+            sampler=sampler,
+            operations=[
+                pg.Batch(
+                    config.batch_size, drop_remainder=config.drop_remainder
+                )
+            ],
+            worker_count=config.grain_workers,
+        )
+        yield from loader
+        epoch += 1

@@ -41,6 +41,11 @@ class InputConfig:
     # Shuffle-buffer rows for the streaming path (within-buffer shuffling —
     # the standard approximate shuffle of streaming input pipelines).
     shuffle_buffer_rows: int = 65536
+    # Grain backend (SURVEY.md §2b Beam row: "sharded map over Grain +
+    # multiprocessing"): route reads through grain.python.DataLoader with
+    # ``grain_workers`` reader subprocesses (0 = in-process Grain).
+    use_grain: bool = False
+    grain_workers: int = 0
 
 
 class BatchIterator:
@@ -62,10 +67,21 @@ class BatchIterator:
         self.transform = transform
         self._uri, self._split, self._columns = uri, split, columns
         n_total = examples_io.num_rows(uri, split)
-        # Per-host shard: strided rows, the Grain sharding convention.
-        shard_n = len(range(config.shard_index, n_total, config.num_shards))
+        if config.use_grain:
+            # Grain's ShardOptions assigns CONTIGUOUS even blocks (with
+            # drop_remainder, exactly floor(n/k) each; without, the first
+            # n%k shards get one extra) — not the strided i%k convention of
+            # the in-process readers.  Count accordingly so
+            # num_examples/steps_per_epoch match what Grain will yield.
+            base, extra = divmod(n_total, config.num_shards)
+            shard_n = base if config.drop_remainder else (
+                base + (1 if config.shard_index < extra else 0)
+            )
+        else:
+            # Per-host shard: strided rows (i % num_shards == shard_index).
+            shard_n = len(range(config.shard_index, n_total, config.num_shards))
         self.streaming = n_total > config.max_in_memory_rows
-        if self.streaming:
+        if self.streaming or config.use_grain:
             self._data = None
             self._indices = None
         else:
@@ -94,6 +110,16 @@ class BatchIterator:
 
     def __iter__(self) -> Iterator[Batch]:
         cfg = self.config
+        if cfg.use_grain:
+            from tpu_pipelines.data.grain_source import grain_batches
+
+            for batch in grain_batches(
+                self._uri, self._split, cfg, self._columns
+            ):
+                if self.transform is not None:
+                    batch = self.transform(batch)
+                yield batch
+            return
         epoch = 0
         while cfg.num_epochs is None or epoch < cfg.num_epochs:
             it = (
