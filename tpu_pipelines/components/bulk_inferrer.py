@@ -10,6 +10,8 @@ passthrough columns.
 
 from __future__ import annotations
 
+import logging
+
 import numpy as np
 
 from tpu_pipelines.data import examples_io
@@ -60,32 +62,51 @@ def BulkInferrer(ctx):
     batch_size = ctx.exec_properties["batch_size"]
 
     total = 0
+    written_splits = set(splits)
     for split in splits:
         it = BatchIterator(
             examples_uri, split,
             InputConfig(batch_size=batch_size, shuffle=False, num_epochs=1,
                         drop_remainder=False),
         )
-        preds_parts = []
-        keep = {c: [] for c in passthrough}
-        for batch in it:
-            preds_parts.append(np.asarray(predict(batch)))
-            for c in passthrough:
-                if c not in batch:
-                    raise KeyError(
-                        f"passthrough column {c!r} not in split {split!r}"
+        # Stream: each batch is predicted and appended to the split's Parquet
+        # writer immediately, so output memory is O(batch), never O(split) —
+        # the Beam-job scaling the reference's BulkInferrer had.
+        writer = None
+        n_split = 0
+        try:
+            for batch in it:
+                preds = np.asarray(predict(batch))
+                cols = {}
+                for c in passthrough:
+                    if c not in batch:
+                        raise KeyError(
+                            f"passthrough column {c!r} not in split {split!r}"
+                        )
+                    cols[c] = batch[c]
+                if preds.ndim == 1:
+                    cols["prediction"] = preds
+                else:
+                    cols["prediction"] = preds.reshape(len(preds), -1)
+                table = examples_io.table_from_columns(cols)
+                if writer is None:
+                    writer = examples_io.open_split_writer(
+                        out.uri, split, table.schema
                     )
-                keep[c].append(batch[c])
-        preds = np.concatenate(preds_parts)
-        cols = {c: np.concatenate(v) for c, v in keep.items()}
-        if preds.ndim == 1:
-            cols["prediction"] = preds
-        else:
-            cols["prediction"] = preds.reshape(len(preds), -1)
-        examples_io.write_split(
-            out.uri, split, examples_io.table_from_columns(cols)
-        )
-        total += len(preds)
+                writer.write_table(table)
+                n_split += len(preds)
+        finally:
+            if writer is not None:
+                writer.close()
+        if writer is None:
+            # Zero batches (hash-split left this split empty): no file was
+            # written, so drop the split from the artifact's listing rather
+            # than publishing a split name downstream reads would 404 on.
+            logging.getLogger(__name__).warning(
+                "BulkInferrer: split %r empty; omitted from output", split
+            )
+            written_splits.discard(split)
+        total += n_split
     out.properties["num_predictions"] = total
-    out.properties["split_names"] = sorted(splits)
+    out.properties["split_names"] = sorted(written_splits)
     return {"num_predictions": total}

@@ -60,33 +60,109 @@ def _split_and_write(table: pa.Table, uri: str, splits: Dict[str, int]) -> Dict[
     return counts
 
 
+def _split_and_write_streaming(
+    batches, uri: str, splits: Dict[str, int], schema: pa.Schema
+) -> Dict[str, int]:
+    """Hash-split a stream of record batches into per-split Parquet writers.
+
+    The out-of-core ingest path (the Beam-pipeline equivalent of SURVEY.md
+    §2a ExampleGen): peak memory is O(read block), never O(file).  Row-hash
+    bucketing is per-row content, so streaming and whole-table ingest assign
+    every row to the identical split.  Every split's writer opens upfront
+    from ``schema``, so empty splits still materialize (as empty Parquet),
+    exactly like the whole-table path.
+    """
+    total = sum(splits.values())
+    counts: Dict[str, int] = {s: 0 for s in splits}
+    writers = {
+        split: examples_io.open_split_writer(uri, split, schema)
+        for split in splits
+    }
+    try:
+        for batch in batches:
+            table = pa.Table.from_batches([batch])
+            buckets = _row_hash_buckets(table, total)
+            lo = 0
+            for split, weight in splits.items():
+                hi = lo + weight
+                mask = (buckets >= lo) & (buckets < hi)
+                lo = hi
+                sub = table.filter(pa.array(mask))
+                if sub.num_rows:
+                    writers[split].write_table(
+                        sub, row_group_size=examples_io.DEFAULT_ROW_GROUP
+                    )
+                counts[split] += sub.num_rows
+    finally:
+        for w in writers.values():
+            w.close()
+    return counts
+
+
+def _convert_options(column_types):
+    if not column_types:
+        return None
+    return pacsv.ConvertOptions(column_types={
+        name: pa.type_for_alias(alias) for name, alias in column_types.items()
+    })
+
+
 @component(
     outputs={"examples": "Examples"},
     parameters={
         "input_path": Parameter(type=str, required=True),
         # {"train": 2, "eval": 1} -> 2/3 train, 1/3 eval by content hash.
         "splits": Parameter(type=dict, default=None),
+        # Files above this many bytes stream through pyarrow's incremental
+        # CSV reader into per-split writers (O(block) memory) instead of
+        # being read whole.  0 = always stream.
+        "streaming_threshold_bytes": Parameter(type=int, default=256 << 20),
+        # Optional {column: arrow-type-alias} (e.g. {"fare": "float64"}).
+        # The streaming reader infers types from its FIRST block only, so
+        # pin any column whose type could shift deeper into a large file
+        # (whole-file inference below the threshold has no such limit).
+        "column_types": Parameter(type=dict, default=None),
     },
     external_input_parameters=("input_path",),
 )
 def CsvExampleGen(ctx):
-    """Read a CSV file (or directory of CSVs), hash-split, write Parquet."""
+    """Read CSV file(s), hash-split, write Parquet — streaming when large."""
     path = ctx.exec_properties["input_path"]
     splits = ctx.exec_properties["splits"] or dict(DEFAULT_SPLITS)
+    threshold = ctx.exec_properties["streaming_threshold_bytes"]
+    convert = _convert_options(ctx.exec_properties["column_types"])
     if os.path.isdir(path):
         files = sorted(
             os.path.join(path, f) for f in os.listdir(path) if f.endswith(".csv")
         )
         if not files:
             raise ValueError(f"no .csv files under {path!r}")
-        table = pa.concat_tables([pacsv.read_csv(f) for f in files])
     else:
-        table = pacsv.read_csv(path)
+        files = [path]
     out = ctx.output("examples")
-    counts = _split_and_write(table, out.uri, splits)
+    total_bytes = sum(os.path.getsize(f) for f in files)
+    if total_bytes > threshold:
+        first = pacsv.open_csv(files[0], convert_options=convert)
+
+        def batches():
+            with first as reader:
+                yield from reader
+            for f in files[1:]:
+                with pacsv.open_csv(f, convert_options=convert) as reader:
+                    yield from reader
+
+        counts = _split_and_write_streaming(
+            batches(), out.uri, splits, first.schema
+        )
+    else:
+        table = pa.concat_tables([
+            pacsv.read_csv(f, convert_options=convert) for f in files
+        ])
+        counts = _split_and_write(table, out.uri, splits)
     out.properties["split_names"] = sorted(counts)
     out.properties["split_counts"] = counts
-    return {"num_examples": table.num_rows, **{f"rows_{k}": v for k, v in counts.items()}}
+    n = sum(counts.values())
+    return {"num_examples": n, **{f"rows_{k}": v for k, v in counts.items()}}
 
 
 @component(

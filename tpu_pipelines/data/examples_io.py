@@ -30,6 +30,17 @@ def split_dir(uri: str, split: str) -> str:
     return os.path.join(uri, f"{SPLIT_PREFIX}{split}")
 
 
+def split_data_path(uri: str, split: str) -> str:
+    """Validated path of a split's data file; raises if the split is absent."""
+    path = os.path.join(split_dir(uri, split), DATA_FILE)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"Examples artifact at {uri!r} has no split {split!r} "
+            f"(available: {split_names(uri)})"
+        )
+    return path
+
+
 def split_names(uri: str) -> List[str]:
     if not os.path.isdir(uri):
         return []
@@ -73,12 +84,7 @@ def iter_column_chunks(
     peak memory is O(rows), independent of split size — the streaming
     contract ExampleGen's row-group layout (write_split) is tuned for.
     """
-    path = os.path.join(split_dir(uri, split), DATA_FILE)
-    if not os.path.isfile(path):
-        raise FileNotFoundError(
-            f"Examples artifact at {uri!r} has no split {split!r} "
-            f"(available: {split_names(uri)})"
-        )
+    path = split_data_path(uri, split)
     pf = pq.ParquetFile(path)
     try:
         for rb in pf.iter_batches(batch_size=rows, columns=columns):
@@ -95,12 +101,7 @@ def iter_table_chunks(
 ):
     """Stream a split as Arrow tables of ~``rows`` rows (null semantics
     intact — what the statistics accumulator consumes); peak memory O(rows)."""
-    path = os.path.join(split_dir(uri, split), DATA_FILE)
-    if not os.path.isfile(path):
-        raise FileNotFoundError(
-            f"Examples artifact at {uri!r} has no split {split!r} "
-            f"(available: {split_names(uri)})"
-        )
+    path = split_data_path(uri, split)
     pf = pq.ParquetFile(path)
     try:
         for rb in pf.iter_batches(batch_size=rows, columns=columns):
@@ -112,12 +113,7 @@ def iter_table_chunks(
 def read_split_table(
     uri: str, split: str, columns: Optional[List[str]] = None
 ) -> pa.Table:
-    path = os.path.join(split_dir(uri, split), DATA_FILE)
-    if not os.path.isfile(path):
-        raise FileNotFoundError(
-            f"Examples artifact at {uri!r} has no split {split!r} "
-            f"(available: {split_names(uri)})"
-        )
+    path = split_data_path(uri, split)
     return pq.read_table(path, columns=columns)
 
 

@@ -37,14 +37,7 @@ class ParquetRowSource:
     and row-group-local per thread."""
 
     def __init__(self, uri: str, split: str, columns: Optional[List[str]] = None):
-        self.path = os.path.join(
-            examples_io.split_dir(uri, split), examples_io.DATA_FILE
-        )
-        if not os.path.isfile(self.path):
-            raise FileNotFoundError(
-                f"Examples artifact at {uri!r} has no split {split!r} "
-                f"(available: {examples_io.split_names(uri)})"
-            )
+        self.path = examples_io.split_data_path(uri, split)
         self.columns = list(columns) if columns else None
         import pyarrow.parquet as pq
 

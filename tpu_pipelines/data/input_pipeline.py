@@ -2,9 +2,11 @@
 
 The TPU-native stand-in for the reference's tf.data feeding loop (SURVEY.md
 §3.3): static batch shapes (XLA compiles once), per-epoch permutation
-shuffling, per-host sharding for multi-host data parallelism (each process
-reads rows ``i % num_shards == shard_index``, the Grain convention), and a
-``shard_batch`` device_put at the infeed boundary.
+shuffling, per-host sharding for multi-host data parallelism, and a
+``shard_batch`` device_put at the infeed boundary.  Shard membership is
+backend-specific: the in-process readers use strided rows
+(``i % num_shards == shard_index``); the grain backend uses Grain's
+contiguous even blocks (see grain_source.py).
 
 Two reader modes behind one iterator contract: splits within the
 ``max_in_memory_rows`` budget load as numpy columns (fast exact-permutation
