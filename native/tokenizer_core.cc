@@ -1,0 +1,181 @@
+// Native wordpiece tokenizer core (libtpptok.so).
+//
+// The hot host-side loop of the BERT Transform (SURVEY.md §3.4 / §7 hard
+// part 5): pretokenize (whitespace + punctuation split, the BERT
+// basic-tokenizer convention) and greedy longest-match-first wordpiece,
+// batch-encoding rows of text into fixed-length [CLS] ... [SEP] id arrays.
+// The Python engine (transform/graph.py `_tokenize_core`) remains the
+// reference semantics; tpu_pipelines/transform/native_tokenizer.py routes
+// pure-ASCII rows here (identical output by construction — Python's \w and
+// str.lower() need unicode tables the non-ASCII rows keep using Python for)
+// and benchmarks ~7x single-row-loop speedups over the interpreter (and no pool-spawn latency).
+//
+// C ABI (ctypes):
+//   tok_create(vocab_buf, vocab_len, lowercase) -> handle
+//       vocab_buf: '\n'-joined vocab entries, id = line index.
+//   tok_encode_batch(handle, data, offsets, n_rows, max_len, out)
+//       data: concatenated UTF-8 row bytes; offsets: int64[n_rows + 1];
+//       out: int32[n_rows * max_len], 0-padded ([PAD] = 0).
+//   tok_destroy(handle)
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Tokenizer {
+  std::unordered_map<std::string, int32_t> table;
+  bool has_wordpiece = false;
+  bool lowercase = true;
+  int32_t unk = 1, cls = 2, sep = 3;
+
+  int32_t lookup_or(const std::string &key, int32_t fallback) const {
+    auto it = table.find(key);
+    return it == table.end() ? fallback : it->second;
+  }
+};
+
+inline bool is_word_char(unsigned char c) {
+  // ASCII subset of Python's \w: [A-Za-z0-9_].  Non-ASCII rows never reach
+  // this code (the binding routes them to the Python engine).
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+         (c >= '0' && c <= '9') || c == '_';
+}
+
+inline bool is_space_char(unsigned char c) {
+  // Python's \s over the ASCII range: space, \t-\r (0x09-0x0D), AND the
+  // file/group/record/unit separators 0x1C-0x1F (re's unicode whitespace).
+  return c == ' ' || (c >= 0x09 && c <= 0x0D) || (c >= 0x1C && c <= 0x1F);
+}
+
+// Greedy longest-match-first wordpiece; whole-token hit short-circuits.
+// Appends ids; a token with any unmatchable tail contributes a single [UNK].
+void wordpiece(const Tokenizer &t, std::string_view tok,
+               std::vector<int32_t> &ids, std::string &scratch) {
+  scratch.assign(tok);
+  auto whole = t.table.find(scratch);
+  if (whole != t.table.end()) {
+    ids.push_back(whole->second);
+    return;
+  }
+  size_t start = 0;
+  size_t before = ids.size();
+  while (start < tok.size()) {
+    size_t end = tok.size();
+    int32_t piece = -1;
+    while (start < end) {
+      if (start == 0) {
+        scratch.assign(tok.substr(start, end - start));
+      } else {
+        scratch.assign("##");
+        scratch.append(tok.substr(start, end - start));
+      }
+      auto it = t.table.find(scratch);
+      if (it != t.table.end()) {
+        piece = it->second;
+        break;
+      }
+      --end;
+    }
+    if (piece < 0) {
+      ids.resize(before);
+      ids.push_back(t.unk);
+      return;
+    }
+    ids.push_back(piece);
+    start = end;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void *tok_create(const char *vocab_buf, int64_t vocab_len, int lowercase) {
+  auto *t = new Tokenizer();
+  t->lowercase = lowercase != 0;
+  std::string_view buf(vocab_buf, static_cast<size_t>(vocab_len));
+  int32_t id = 0;
+  size_t pos = 0;
+  while (pos <= buf.size()) {
+    size_t nl = buf.find('\n', pos);
+    size_t end = (nl == std::string_view::npos) ? buf.size() : nl;
+    if (end > pos || nl != std::string_view::npos) {
+      std::string entry(buf.substr(pos, end - pos));
+      if (!entry.empty()) {
+        if (entry.compare(0, 2, "##") == 0) t->has_wordpiece = true;
+        t->table[std::move(entry)] = id;  // duplicate entry: last id wins,
+                                          // matching Python's dict build
+      }
+      ++id;
+    }
+    if (nl == std::string_view::npos) break;
+    pos = nl + 1;
+  }
+  t->unk = t->lookup_or("[UNK]", 1);
+  t->cls = t->lookup_or("[CLS]", 2);
+  t->sep = t->lookup_or("[SEP]", 3);
+  return t;
+}
+
+void tok_destroy(void *h) { delete static_cast<Tokenizer *>(h); }
+
+int tok_has_wordpiece(void *h) {
+  return static_cast<Tokenizer *>(h)->has_wordpiece ? 1 : 0;
+}
+
+void tok_encode_batch(void *h, const char *data, const int64_t *offsets,
+                      int64_t n_rows, int32_t max_len, int32_t *out) {
+  const Tokenizer &t = *static_cast<Tokenizer *>(h);
+  std::vector<int32_t> ids;
+  std::string lowered;
+  std::string scratch;
+  const size_t budget = static_cast<size_t>(max_len) - 1;  // room for [SEP]
+  for (int64_t r = 0; r < n_rows; ++r) {
+    const char *row = data + offsets[r];
+    size_t len = static_cast<size_t>(offsets[r + 1] - offsets[r]);
+    if (t.lowercase) {
+      lowered.assign(row, len);
+      for (char &c : lowered)
+        if (c >= 'A' && c <= 'Z') c += 'a' - 'A';
+      row = lowered.data();
+    }
+    ids.clear();
+    ids.push_back(t.cls);
+    // Pretokenize: runs of word chars, single punctuation chars otherwise
+    // (the ASCII projection of  \w+|[^\w\s]  — same split, same order).
+    size_t i = 0;
+    while (i < len && ids.size() < budget) {
+      unsigned char c = static_cast<unsigned char>(row[i]);
+      if (is_space_char(c)) {
+        ++i;
+        continue;
+      }
+      size_t start = i;
+      if (is_word_char(c)) {
+        while (i < len && is_word_char(static_cast<unsigned char>(row[i])))
+          ++i;
+      } else {
+        ++i;  // single punctuation character token
+      }
+      std::string_view tok(row + start, i - start);
+      if (t.has_wordpiece) {
+        wordpiece(t, tok, ids, scratch);
+      } else {
+        scratch.assign(tok);
+        ids.push_back(t.lookup_or(scratch, t.unk));
+      }
+    }
+    if (ids.size() > budget) ids.resize(budget);
+    ids.push_back(t.sep);
+    int32_t *dst = out + r * max_len;
+    std::memset(dst, 0, sizeof(int32_t) * static_cast<size_t>(max_len));
+    std::memcpy(dst, ids.data(), sizeof(int32_t) * ids.size());
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,123 @@
+"""Native C++ tokenizer: exact parity with the Python engine."""
+
+import numpy as np
+import pytest
+
+from tpu_pipelines.transform import native_tokenizer
+from tpu_pipelines.transform.graph import _tokenize_core
+
+pytestmark = pytest.mark.skipif(
+    not native_tokenizer.available(), reason="no native toolchain"
+)
+
+WP_VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "the", "un", "##believ",
+            "##able", "##s", "cat", "dog", ",", ".", "!", "run", "##ning",
+            "_odd", "x9", "##9"]
+PLAIN_VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "hello", "world", ","]
+
+
+def _python(col, vocab, max_len=16, lowercase=True):
+    table = {v: i for i, v in enumerate(vocab)}
+    return _tokenize_core(
+        np.asarray(col, dtype=object),
+        {"max_len": max_len, "lowercase": lowercase},
+        table,
+        any(v.startswith("##") for v in vocab),
+    )
+
+
+def _native(col, vocab, max_len=16, lowercase=True):
+    state = {"vocab": list(vocab)}
+    params = {"max_len": max_len, "lowercase": lowercase}
+    table = {v: i for i, v in enumerate(vocab)}
+    out = native_tokenizer.encode_batch(
+        np.asarray(col, dtype=object), params, state,
+        lambda subset: _tokenize_core(
+            subset, params, table, any(v.startswith("##") for v in vocab)
+        ),
+    )
+    assert out is not None
+    return out
+
+
+@pytest.mark.parametrize("vocab", [WP_VOCAB, PLAIN_VOCAB])
+def test_parity_on_edge_cases(vocab):
+    col = [
+        "the cat, the dog!",
+        "unbelievable runs running",
+        "UNBELIEVABLE CATS",         # lowercase + wordpiece tails
+        "zzz qqq",                   # all-unk
+        "",                          # empty
+        None,                        # None -> ""
+        "x9 _odd x99",
+        "a" * 500,                   # long unmatchable word
+        "the " * 50,                 # truncation at max_len
+        "cat..cat,,cat!!",           # punctuation runs split per char
+        "tabs\tand\nnewlines cat",
+    ]
+    np.testing.assert_array_equal(
+        _native(col, vocab), _python(col, vocab)
+    )
+
+
+def test_parity_no_lowercase():
+    col = ["The CAT the", "THE the"]
+    np.testing.assert_array_equal(
+        _native(col, WP_VOCAB, lowercase=False),
+        _python(col, WP_VOCAB, lowercase=False),
+    )
+
+
+def test_unicode_rows_fall_back_and_stitch():
+    col = ["the cat", "café naïve", "dog", "日本語 the", "the dog"]
+    np.testing.assert_array_equal(
+        _native(col, WP_VOCAB), _python(col, WP_VOCAB)
+    )
+
+
+def test_parity_randomized():
+    rng = np.random.default_rng(0)
+    pieces = ["the", "un", "believ", "able", "cat", "dog", "zq", ",", ".",
+              " ", "  ", "!", "x9", "_", "9"]
+    col = [
+        "".join(rng.choice(pieces, size=rng.integers(0, 30)))
+        for _ in range(300)
+    ]
+    np.testing.assert_array_equal(
+        _native(col, WP_VOCAB, max_len=24), _python(col, WP_VOCAB, max_len=24)
+    )
+
+
+def test_duplicate_vocab_entry_last_wins():
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "cat", "cat"]
+    np.testing.assert_array_equal(
+        _native(["cat"], vocab), _python(["cat"], vocab)
+    )
+
+
+def test_non_string_values_stringify():
+    col = [3.5, 42, True]
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "3", "5", ".", "42", "true"]
+    np.testing.assert_array_equal(_native(col, vocab), _python(col, vocab))
+
+
+def test_ascii_control_separators_are_whitespace():
+    """Python's \\s covers \\x1c-\\x1f; the C++ core must agree (regression:
+    these produced a spurious [UNK] from the native path)."""
+    col = ["the\x1ccat", "the\x1dcat", "the\x1ecat", "the\x1fcat",
+           "the\x0bcat", "the\x0ccat"]
+    np.testing.assert_array_equal(
+        _native(col, WP_VOCAB), _python(col, WP_VOCAB)
+    )
+
+
+def test_mostly_non_ascii_column_defers_to_pool():
+    """A column over the python-rows budget returns None (pool takes over)."""
+    state = {"vocab": list(WP_VOCAB)}
+    params = {"max_len": 8, "lowercase": True}
+    col = np.asarray(["café"] * 10, dtype=object)
+    out = native_tokenizer.encode_batch(
+        col, params, state, lambda s: (_ for _ in ()).throw(AssertionError),
+        max_python_rows=5,
+    )
+    assert out is None

@@ -767,11 +767,15 @@ def _tok_chunk(rows) -> np.ndarray:
 
 
 def _apply_tokenize(node: Node, state: Dict[str, Any], col) -> np.ndarray:
-    """Tokenize a column; large columns fan out over a process pool.
+    """Tokenize a column: C++ core first, process pool second, inline last.
 
-    The wordpiece loop is irreducibly per-row Python, which is exactly what
-    the reference ran embarrassingly-parallel under Beam (SURVEY.md §2b) —
-    here a ProcessPoolExecutor plays that role for the host stage.
+    The wordpiece loop is irreducibly per-row work — what the reference ran
+    embarrassingly-parallel under Beam (SURVEY.md §2b).  Preference order:
+    the native C++ core (transform/native_tokenizer.py, ~7x the interpreter
+    loop with no pool-spawn latency; non-ASCII rows still route through the
+    Python engine for exact unicode semantics), then a ProcessPoolExecutor fan-out of the
+    Python engine when the toolchain can't build the native core, then the
+    plain in-process loop for small columns.
     """
     p = node.params
     vocab = state["vocab"]
@@ -781,6 +785,16 @@ def _apply_tokenize(node: Node, state: Dict[str, Any], col) -> np.ndarray:
         table = state["_table"] = {v: i for i, v in enumerate(vocab)}
         state["_has_wordpiece"] = any(v.startswith("##") for v in vocab)
     has_wordpiece = state["_has_wordpiece"]
+
+    from tpu_pipelines.transform import native_tokenizer
+
+    native = native_tokenizer.encode_batch(
+        col, p, state,
+        lambda subset: _tokenize_core(subset, p, table, has_wordpiece),
+        max_python_rows=_TOK_MIN_PARALLEL_ROWS,
+    )
+    if native is not None:
+        return native
 
     import os as _os
 
