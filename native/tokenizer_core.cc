@@ -17,12 +17,24 @@
 //       data: concatenated UTF-8 row bytes; offsets: int64[n_rows + 1];
 //       out: int32[n_rows * max_len], 0-padded ([PAD] = 0).
 //   tok_destroy(handle)
+//
+// Analysis-pass counter (the vocab-BUILD side of the same pretokenizer —
+// the full-corpus stage the reference ran Beam-parallel, SURVEY.md §2b):
+//   tok_counter_create(lowercase) -> handle
+//   tok_counter_add(handle, data, offsets, n_rows)
+//       accumulates pretoken counts across calls (chunked corpora).
+//   tok_counter_serialize(handle, out, cap) -> needed_bytes
+//       "token\tcount\n" lines; call with cap=0 to size, then again with a
+//       buffer of that size.  Deterministic output not required — the
+//       Python side merges into its own dict.
+//   tok_counter_destroy(handle)
 
 #include <cstdint>
 #include <cstring>
 #include <string>
 #include <string_view>
 #include <unordered_map>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -37,6 +49,11 @@ struct Tokenizer {
     auto it = table.find(key);
     return it == table.end() ? fallback : it->second;
   }
+};
+
+struct TokenCounter {
+  std::unordered_map<std::string, int64_t> counts;
+  bool lowercase = true;
 };
 
 inline bool is_word_char(unsigned char c) {
@@ -176,6 +193,153 @@ void tok_encode_batch(void *h, const char *data, const int64_t *offsets,
     std::memset(dst, 0, sizeof(int32_t) * static_cast<size_t>(max_len));
     std::memcpy(dst, ids.data(), sizeof(int32_t) * ids.size());
   }
+}
+
+// ------------------------------------------------------------ count kernel
+
+void *tok_counter_create(int lowercase) {
+  auto *c = new TokenCounter();
+  c->lowercase = lowercase != 0;
+  return c;
+}
+
+void tok_counter_destroy(void *h) { delete static_cast<TokenCounter *>(h); }
+
+namespace {
+
+// Same ASCII projection of  \w+|[^\w\s]  as tok_encode_batch.  The row must
+// already be lowercased if the counter wants that (see count_row).
+inline void count_row_raw(TokenCounter &c, const char *row, size_t len,
+                          std::string &scratch) {
+  size_t i = 0;
+  while (i < len) {
+    unsigned char ch = static_cast<unsigned char>(row[i]);
+    if (is_space_char(ch)) {
+      ++i;
+      continue;
+    }
+    size_t start = i;
+    if (is_word_char(ch)) {
+      while (i < len && is_word_char(static_cast<unsigned char>(row[i])))
+        ++i;
+    } else {
+      ++i;
+    }
+    scratch.assign(row + start, i - start);
+    ++c.counts[scratch];
+  }
+}
+
+inline void count_row(TokenCounter &c, const char *row, size_t len,
+                      std::string &lowered, std::string &scratch) {
+  if (c.lowercase) {
+    lowered.assign(row, len);
+    for (char &ch : lowered)
+      if (ch >= 'A' && ch <= 'Z') ch += 'a' - 'A';
+    row = lowered.data();
+  }
+  count_row_raw(c, row, len, scratch);
+}
+
+}  // namespace
+
+void tok_counter_add(void *h, const char *data, const int64_t *offsets,
+                     int64_t n_rows) {
+  TokenCounter &c = *static_cast<TokenCounter *>(h);
+  std::string lowered;
+  std::string scratch;
+  for (int64_t r = 0; r < n_rows; ++r) {
+    count_row(c, data + offsets[r],
+              static_cast<size_t>(offsets[r + 1] - offsets[r]), lowered,
+              scratch);
+  }
+}
+
+// Fixed-width UCS4 rows straight out of a numpy 'U<width>' array (the
+// caller has verified every code point is < 128 with one vectorized max):
+// no encode pass, no per-row Python objects — the unicode buffer itself
+// crosses the FFI.  Trailing NULs are padding (numpy's U dtype cannot
+// represent them anyway); embedded NULs are real characters and count as
+// punctuation, matching Python's [^\w\s].
+namespace {
+
+void count_ucs4_range(TokenCounter &c, const uint32_t *data, int64_t begin,
+                      int64_t end, size_t w) {
+  std::string scratch;
+  std::string ascii_row;
+  const bool lower = c.lowercase;
+  for (int64_t r = begin; r < end; ++r) {
+    const uint32_t *row = data + r * w;
+    size_t len = w;
+    while (len > 0 && row[len - 1] == 0) --len;
+    ascii_row.resize(len);
+    // Narrow UCS4 -> char and lowercase in the same pass, so count_row_raw
+    // needs no second copy.
+    if (lower) {
+      for (size_t i = 0; i < len; ++i) {
+        uint32_t ch = row[i];
+        ascii_row[i] = static_cast<char>(
+            ch >= 'A' && ch <= 'Z' ? ch + ('a' - 'A') : ch);
+      }
+    } else {
+      for (size_t i = 0; i < len; ++i)
+        ascii_row[i] = static_cast<char>(row[i]);
+    }
+    count_row_raw(c, ascii_row.data(), len, scratch);
+  }
+}
+
+}  // namespace
+
+void tok_counter_add_ucs4(void *h, const uint32_t *data, int64_t n_rows,
+                          int64_t width_chars) {
+  TokenCounter &c = *static_cast<TokenCounter *>(h);
+  const size_t w = static_cast<size_t>(width_chars);
+  // Counting is embarrassingly parallel over rows (the Beam CombinePerKey
+  // shape): thread-local maps, one merge.  Small chunks stay serial — the
+  // thread spawn would cost more than the work.
+  unsigned hw = std::thread::hardware_concurrency();
+  int64_t n_threads = static_cast<int64_t>(hw ? (hw < 8 ? hw : 8) : 1);
+  if (n_rows < 16384 || n_threads <= 1) {
+    count_ucs4_range(c, data, 0, n_rows, w);
+    return;
+  }
+  std::vector<TokenCounter> locals(static_cast<size_t>(n_threads));
+  std::vector<std::thread> threads;
+  const int64_t step = (n_rows + n_threads - 1) / n_threads;
+  for (int64_t t = 0; t < n_threads; ++t) {
+    int64_t begin = t * step;
+    int64_t end = begin + step < n_rows ? begin + step : n_rows;
+    if (begin >= end) break;
+    locals[static_cast<size_t>(t)].lowercase = c.lowercase;
+    threads.emplace_back(count_ucs4_range,
+                         std::ref(locals[static_cast<size_t>(t)]), data,
+                         begin, end, w);
+  }
+  for (auto &th : threads) th.join();
+  for (auto &local : locals)
+    for (auto &kv : local.counts) c.counts[kv.first] += kv.second;
+}
+
+int64_t tok_counter_serialize(void *h, char *out, int64_t cap) {
+  const TokenCounter &c = *static_cast<TokenCounter *>(h);
+  int64_t needed = 0;
+  for (const auto &kv : c.counts) {
+    needed += static_cast<int64_t>(kv.first.size()) + 2 +
+              static_cast<int64_t>(std::to_string(kv.second).size());
+  }
+  if (out == nullptr || cap < needed) return needed;
+  char *p = out;
+  for (const auto &kv : c.counts) {
+    std::memcpy(p, kv.first.data(), kv.first.size());
+    p += kv.first.size();
+    *p++ = '\t';
+    std::string n = std::to_string(kv.second);
+    std::memcpy(p, n.data(), n.size());
+    p += n.size();
+    *p++ = '\n';
+  }
+  return needed;
 }
 
 }  // extern "C"

@@ -12,13 +12,17 @@ no devices have been touched yet.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+if os.environ.get("TPP_TEST_REAL_TPU", "") != "1":
+    # Default: CPU mesh.  TPP_TEST_REAL_TPU=1 leaves the real backend in
+    # place so the TPU-gated tests (flash memory analysis etc.) can run on
+    # hardware: `TPP_TEST_REAL_TPU=1 pytest tests/test_flash_attention.py`.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
-import jax  # noqa: E402
+    import jax
 
-jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")

@@ -295,10 +295,15 @@ def test_tuner_trial_shards_in_workflow(tmp_path):
     templates = {t["name"]: t for t in wf["spec"]["templates"]}
     tasks = {t["name"]: t for t in templates["pipeline-dag"]["dag"]["tasks"]}
 
+    # Argo rejects DAG templates mixing `depends` and `dependencies`; once
+    # the tuner merge needs a `depends` expression, EVERY task in the DAG
+    # must use the `depends` form.
+    assert not any("dependencies" in t for t in tasks.values())
+
     trial_names = [f"tuner-trial-{i}" for i in range(3)]
     for i, tn in enumerate(trial_names):
         # DAG: each trial runs after the tuner's upstreams...
-        assert tasks[tn]["dependencies"] == ["csvexamplegen"]
+        assert tasks[tn]["depends"] == "csvexamplegen.Succeeded"
         cmd = templates[tn]["container"]["command"]
         assert cmd[:4] == ["python", "-m", "tpu_pipelines.components.tuner_trial", "shard"]
         assert f"{i}/3" in cmd

@@ -352,3 +352,115 @@ def test_csv_example_gen_streaming_matches_whole_table(tmp_path):
         w, st = outs["whole"][s], outs["stream"][s]
         assert sorted(w["a"].tolist()) == sorted(st["a"].tolist())
         assert len(w["a"]) > 0
+
+
+def test_csv_streaming_type_flip_friendly_error(tmp_path):
+    """A type flip beyond the first streamed block raises actionable
+    guidance (name the column_types escape hatch), not a raw Arrow error;
+    pinning the type makes the same file ingest cleanly."""
+    import pytest
+
+    from tpu_pipelines.components import CsvExampleGen
+    from tpu_pipelines.dsl.pipeline import Pipeline
+    from tpu_pipelines.orchestration import LocalDagRunner, PipelineRunError
+
+    # ~2 MB file: first ~1 MB block is all ints, the tail is not.
+    csv = tmp_path / "flip.csv"
+    with open(csv, "w") as f:
+        f.write("x,y\n")
+        for i in range(90_000):
+            f.write(f"{i},{i}\n")
+        for i in range(90_000):
+            f.write(f"not_an_int_{i},{i}\n")
+
+    def pipeline(name, **params):
+        gen = CsvExampleGen(
+            input_path=str(csv), streaming_threshold_bytes=1, **params
+        )
+        return Pipeline(
+            name, [gen], pipeline_root=str(tmp_path / name),
+            metadata_path=str(tmp_path / f"{name}.sqlite"),
+        )
+
+    with pytest.raises(PipelineRunError, match="column_types"):
+        LocalDagRunner().run(pipeline("flip-fails"))
+
+    result = LocalDagRunner().run(
+        pipeline("flip-pinned", column_types={"x": "string"})
+    )
+    assert result.succeeded
+
+
+def test_span_pattern_resolution(tmp_path):
+    from tpu_pipelines.utils.span import resolve_span_pattern
+
+    for d in ("span-1", "span-2", "span-10", "span-003"):
+        (tmp_path / d).mkdir()
+    pattern = str(tmp_path / "span-{SPAN}")
+
+    path, span, version = resolve_span_pattern(pattern)
+    assert span == 10 and path.endswith("span-10") and version is None
+    path, span, _ = resolve_span_pattern(pattern, span=2)
+    assert span == 2 and path.endswith("span-2")
+    # Zero-padded layout, pinned by numeric value.
+    path, span, _ = resolve_span_pattern(pattern, span=3)
+    assert span == 3 and path.endswith("span-003")
+
+    import pytest
+
+    with pytest.raises(FileNotFoundError):
+        resolve_span_pattern(str(tmp_path / "nope-{SPAN}"))
+    with pytest.raises(FileNotFoundError):
+        resolve_span_pattern(pattern, span=99)
+
+    # {VERSION} nests inside the chosen span.
+    (tmp_path / "span-10" / "v-1").mkdir()
+    (tmp_path / "span-10" / "v-2").mkdir()
+    path, span, version = resolve_span_pattern(
+        str(tmp_path / "span-{SPAN}" / "v-{VERSION}")
+    )
+    assert (span, version) == (10, 2) and path.endswith("v-2")
+
+
+def test_csv_example_gen_spans_and_cache_rollover(tmp_path):
+    """New span at an unchanged pattern -> re-run on the new data; unchanged
+    spans -> cache hit (the TFX span-driven continuous-ingest shape)."""
+    from tpu_pipelines.components import CsvExampleGen
+    from tpu_pipelines.dsl.pipeline import Pipeline
+    from tpu_pipelines.orchestration import LocalDagRunner
+
+    def write_span(n, rows):
+        d = tmp_path / f"span-{n}"
+        d.mkdir()
+        with open(d / "data.csv", "w") as f:
+            f.write("x,y\n")
+            for i in range(rows):
+                f.write(f"{i},{i * 2}\n")
+
+    write_span(1, 40)
+    write_span(2, 60)
+
+    def pipeline():
+        gen = CsvExampleGen(input_path=str(tmp_path / "span-{SPAN}"))
+        return Pipeline(
+            "spans", [gen], pipeline_root=str(tmp_path / "root"),
+            metadata_path=str(tmp_path / "md.sqlite"),
+        )
+
+    r1 = LocalDagRunner().run(pipeline())
+    assert r1.succeeded and r1.nodes["CsvExampleGen"].status == "COMPLETE"
+    art = r1.outputs_of("CsvExampleGen", "examples")[0]
+    assert art.properties["span"] == 2
+    assert sum(art.properties["split_counts"].values()) == 60
+
+    # Same pattern, nothing new: cache hit.
+    r2 = LocalDagRunner().run(pipeline())
+    assert r2.nodes["CsvExampleGen"].status == "CACHED"
+
+    # Span 3 lands: the pattern now resolves to new content -> re-run.
+    write_span(3, 80)
+    r3 = LocalDagRunner().run(pipeline())
+    assert r3.nodes["CsvExampleGen"].status == "COMPLETE"
+    art3 = r3.outputs_of("CsvExampleGen", "examples")[0]
+    assert art3.properties["span"] == 3
+    assert sum(art3.properties["split_counts"].values()) == 80

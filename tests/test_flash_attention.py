@@ -20,14 +20,22 @@ def _qkv(b=2, l=64, h=2, d=16, seed=0):
 FLASH = functools.partial(flash_attention, block_q=16, block_k=16,
                           interpret=True)
 
+# CPU interpret mode computes exact f32, so parity with dense is tight.  On
+# the real chip (TPP_TEST_REAL_TPU=1) BOTH paths round every matmul through
+# the MXU's bf16 multiply under XLA default precision, and the two different
+# contraction orders legitimately diverge at O(1e-2) — same math, hardware
+# rounding.  Verified on TPU v5 lite: max abs diff 2.5e-2 across the suite.
+_ON_TPU = jax.default_backend() == "tpu"
+_FWD_TOL = dict(rtol=5e-2, atol=5e-2) if _ON_TPU else dict(rtol=2e-5, atol=2e-5)
+_GRAD_TOL = dict(rtol=5e-2, atol=5e-2) if _ON_TPU else dict(rtol=1e-4, atol=1e-4)
+
 
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_matches_dense(causal):
     q, k, v = _qkv()
     got = FLASH(q, k, v, causal=causal)
     want = dense_attention(q, k, v, causal=causal)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), **_FWD_TOL)
 
 
 def test_flash_with_padding_mask():
@@ -37,8 +45,7 @@ def test_flash_with_padding_mask():
     mask[:, 0] = 1
     got = FLASH(q, k, v, kv_mask=jnp.asarray(mask))
     want = dense_attention(q, k, v, kv_mask=jnp.asarray(mask))
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), **_FWD_TOL)
 
 
 def test_flash_grad_matches_dense():
@@ -53,8 +60,7 @@ def test_flash_grad_matches_dense():
     gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gd):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), **_GRAD_TOL)
 
 
 @pytest.mark.parametrize("causal", [False, True])
@@ -76,8 +82,7 @@ def test_flash_grad_matches_dense_with_mask(causal):
     gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gd):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), **_GRAD_TOL)
 
 
 @pytest.mark.skipif(jax.default_backend() != "tpu",
@@ -121,8 +126,7 @@ def test_flash_indivisible_falls_back_to_dense():
     q, k, v = _qkv(l=24)  # not divisible by block 16
     got = flash_attention(q, k, v, block_q=16, block_k=16, interpret=True)
     want = dense_attention(q, k, v)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), **_FWD_TOL)
 
 
 def test_transformer_block_flash_impl():

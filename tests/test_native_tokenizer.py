@@ -121,3 +121,113 @@ def test_mostly_non_ascii_column_defers_to_pool():
         max_python_rows=5,
     )
     assert out is None
+
+
+# ------------------------------------------------------- analysis counter
+
+
+def _py_counts(texts, lowercase=True):
+    from tpu_pipelines.transform.graph import _pretokenize
+
+    out = {}
+    for t in texts:
+        for tok in _pretokenize(t, lowercase):
+            out[tok] = out.get(tok, 0) + 1
+    return out
+
+
+def test_counter_parity_ascii_and_edge_cases():
+    texts = [
+        "Hello, world! hello WORLD", "", None, 123, "a_b-c d.e",
+        "tabs\tand\nnewlines", "!!!", "under_score_9",
+    ]
+    native = native_tokenizer.NativeTokenCounter(lowercase=True)
+    from tpu_pipelines.transform.graph import _split_ascii_rows
+
+    ascii_rows, others = _split_ascii_rows(np.asarray(texts, dtype=object))
+    assert others == []
+    native.add_ascii_rows(ascii_rows)
+    want = _py_counts(texts)
+    assert native.counts() == want
+
+
+def test_counter_streaming_chunks_accumulate():
+    native = native_tokenizer.NativeTokenCounter(lowercase=False)
+    native.add_ascii_rows([b"A a", b"a"])
+    native.add_ascii_rows([b"A"])
+    assert native.counts() == {"A": 2, "a": 2}
+
+
+def test_acc_update_counts_match_python_with_unicode_mix():
+    """The full _acc_update tokenize path: native for ASCII rows, Python
+    for non-ASCII, merged at finalize — counts equal the serial loop's."""
+    from tpu_pipelines.transform.graph import (
+        Node, _acc_finalize, _acc_init, _acc_update,
+    )
+
+    texts = ["heLLo wörld", "hello there", "naïve café", None, "a b a"] * 7
+    node = Node(id=0, op="tokenize", inputs=[],
+                params={"lowercase": True, "vocab_size": 50}, dtype="int32")
+    acc = _acc_init(node)
+    for i in range(0, len(texts), 5):   # chunked like the streaming pass
+        acc = _acc_update(node, acc, np.asarray(texts[i:i+5], object), False)
+    got = _acc_finalize(node, acc)
+
+    want_counts = _py_counts(texts)
+    want_terms = sorted(want_counts, key=lambda t: (-want_counts[t], t))
+    from tpu_pipelines.transform.graph import SPECIAL_TOKENS
+
+    assert got["vocab"] == list(SPECIAL_TOKENS) + want_terms[:46]
+
+
+def test_counter_throughput_vs_serial_loop():
+    """VERDICT r2 #4 done-criterion: recorded rows/s on a >=100k-row corpus,
+    native >= 5x the serial Python loop (asserted at 3x for CI headroom)."""
+    import time
+
+    rng = np.random.default_rng(0)
+    words = np.asarray(["alpha", "Bravo", "charlie!", "delta_9", "e,f"])
+    corpus = [
+        " ".join(rng.choice(words, size=12)) for _ in range(100_000)
+    ]
+
+    from tpu_pipelines.transform.graph import _count_pretokens_into
+
+    t0 = time.perf_counter()
+    acc = {"counts": {}}
+    _count_pretokens_into(acc, np.asarray(corpus, dtype=object), True)
+    got = dict(acc["counts"])
+    for tok, n in acc["_native_counter"].counts().items():
+        got[tok] = got.get(tok, 0) + n
+    t_native = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    want = _py_counts(corpus)
+    t_py = time.perf_counter() - t0
+
+    assert got == want
+    ratio = t_py / t_native
+    print(f"\nvocab-count 100k rows: native {100_000/t_native:,.0f} rows/s, "
+          f"python {100_000/t_py:,.0f} rows/s, speedup {ratio:.1f}x")
+    assert ratio >= 3.0, ratio
+
+
+def test_counter_float_column_parity():
+    """Float columns count their decimal text ('3.7'), exactly like the
+    per-row Python engine — NOT vocab_apply's int64-cast stringification."""
+    from tpu_pipelines.transform.graph import (
+        _acc_finalize, _acc_init, _acc_update,
+    )
+    from tpu_pipelines.transform.expr import Node
+
+    col = np.asarray([3.7, 3.7, 0.5, 12.0])
+    node = Node(id=0, op="tokenize", inputs=[],
+                params={"lowercase": True, "vocab_size": 50}, dtype="int32")
+    acc = _acc_update(node, _acc_init(node), col, False)
+    got = _acc_finalize(node, acc)["vocab"]
+    want = _py_counts([str(v) for v in col])
+    from tpu_pipelines.transform.graph import SPECIAL_TOKENS
+
+    want_terms = sorted(want, key=lambda t: (-want[t], t))
+    assert got == list(SPECIAL_TOKENS) + want_terms
+    assert "3" in got and "7" in got and "." in got  # '3.7' pretokenizes

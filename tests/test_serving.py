@@ -284,12 +284,34 @@ def test_serving_cli_boot_hotswap_and_shutdown(tmp_path):
     base.mkdir()
     # Deliberately started BEFORE any version exists: the server must wait
     # for the first push instead of crash-looping.
+    #
+    # The child pins jax to CPU via config.update: this image's sitecustomize
+    # registers the experimental TPU backend at interpreter start and wins
+    # over the JAX_PLATFORMS env var, and a first-predict REMOTE compile on
+    # the tunneled chip can exceed the request timeout (the flake history of
+    # this test).  config.update still wins when issued before any device
+    # use, which __main__ guarantees.
+    boot = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); import sys; "
+        "from tpu_pipelines.serving.__main__ import main; "
+        "sys.exit(main(sys.argv[1:]))"
+    )
     proc = subprocess.Popen(
-        [sys.executable, "-m", "tpu_pipelines.serving",
+        [sys.executable, "-c", boot,
          "--model-name", "m", "--base-dir", str(base),
          "--port", "0", "--host", "127.0.0.1", "--poll-seconds", "0.2"],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
+    def push_version(n: int, scale: float) -> None:
+        # Stage + rename: versions must appear atomically (as Pusher pushes
+        # them) — the server polls every 0.2s and must never observe a
+        # half-written payload as the newest version.
+        import os
+
+        stage = f".stage_{n}"
+        _export(tmp_path, stage, scale=scale)
+        os.rename(str(tmp_path / stage), str(base / str(n)))
+
     # Port 0 binds ephemerally; read the bound port from the log line.
     port = None
     waited = False
@@ -298,10 +320,16 @@ def test_serving_cli_boot_hotswap_and_shutdown(tmp_path):
     try:
         while time.time() < deadline and port is None:
             line = proc.stdout.readline()
+            if not line:
+                # EOF: fail fast (with the log) if the server died instead
+                # of burning the deadline in a readline busy-loop.
+                assert proc.poll() is None, (proc.returncode, lines)
+                time.sleep(0.05)
+                continue
             lines.append(line)
             if "waiting for the first push" in line and not waited:
                 waited = True
-                _export(tmp_path, "versions/1", scale=1.0)
+                push_version(1, scale=1.0)
             if "serving 'm'" in line and "127.0.0.1:" in line:
                 port = int(line.rsplit(":", 1)[1])
         assert port, lines
@@ -326,7 +354,7 @@ def test_serving_cli_boot_hotswap_and_shutdown(tmp_path):
         assert predict()[0][0] == pytest.approx(1.0)
 
         # Push version 2 (doubled weights): the watcher must hot-swap.
-        _export(tmp_path, "versions/2", scale=2.0)
+        push_version(2, scale=2.0)
         deadline = time.time() + 30
         while time.time() < deadline and status() != "2":
             time.sleep(0.2)
@@ -366,3 +394,105 @@ def test_serving_manifest_emission(tmp_path):
     assert c["volumeMounts"]
     assert svc["spec"]["ports"][0]["port"] == 8501
     assert dep["spec"]["selector"]["matchLabels"] == svc["spec"]["selector"]
+
+
+# ------------------------------------------------------------------- gRPC
+
+
+def test_grpc_tensor_codec_roundtrip():
+    from tpu_pipelines.serving.grpc_server import (
+        array_to_tensor,
+        tensor_to_array,
+    )
+
+    for arr in (
+        np.arange(12, dtype=np.float32).reshape(3, 4),
+        np.arange(6, dtype=np.int64).reshape(2, 3),
+        np.asarray([True, False]),
+        np.asarray([["a", "bb"], ["ccc", ""]], dtype=object),
+    ):
+        got = tensor_to_array(array_to_tensor(arr))
+        assert got.shape == arr.shape
+        if arr.dtype == object:
+            assert got.tolist() == arr.tolist()
+        else:
+            np.testing.assert_array_equal(got, arr)
+            assert got.dtype == arr.dtype
+
+
+def test_grpc_predict_and_status(tmp_path):
+    from tpu_pipelines.serving import ModelServer
+    from tpu_pipelines.serving.grpc_server import (
+        PredictionClient,
+        start_grpc_server,
+    )
+
+    payload = _export(tmp_path, "grpc_model", scale=3.0)
+    server = ModelServer("g", payload)
+    grpc_server, port = start_grpc_server(server)
+    client = PredictionClient(f"127.0.0.1:{port}")
+    try:
+        preds, version = client.predict(
+            "g", {"x": np.asarray([[1.0, 0.0, 0.0]], np.float32)}
+        )
+        np.testing.assert_allclose(preds, [[3.0, 0.0]])
+        assert client.model_status("g")["state"] == "AVAILABLE"
+
+        # Wrong model name -> NOT_FOUND; bad payload -> INVALID_ARGUMENT.
+        import grpc
+
+        with pytest.raises(grpc.RpcError) as e:
+            client.predict("other", {"x": np.ones((1, 3), np.float32)})
+        assert e.value.code() == grpc.StatusCode.NOT_FOUND
+        with pytest.raises(grpc.RpcError) as e:
+            client.predict("g", {"wrong_key": np.ones((1, 3), np.float32)})
+        assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        client.close()
+        grpc_server.stop(grace=2)
+        server.stop()
+
+
+def test_grpc_concurrent_requests_through_shared_batcher(tmp_path):
+    """Mirror of test_server_concurrent_requests on the gRPC surface, with
+    batching=True so gRPC rides the same micro-batcher as REST."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from tpu_pipelines.serving import ModelServer
+    from tpu_pipelines.serving.grpc_server import (
+        PredictionClient,
+        start_grpc_server,
+    )
+
+    payload = _export(tmp_path, "grpc_conc_model")
+    server = ModelServer("conc", payload, batching=True, max_batch_size=16,
+                         batch_timeout_s=0.01)
+    grpc_server, port = start_grpc_server(server)
+    client = PredictionClient(f"127.0.0.1:{port}")
+    try:
+        def call(i):
+            x = np.asarray(
+                [[float(i), 0.0, 0.0], [0.0, float(i), 0.0]], np.float32
+            )
+            preds, _ = client.predict("conc", {"x": x})
+            return i, preds
+
+        with ThreadPoolExecutor(max_workers=16) as pool:
+            for i, preds in pool.map(call, range(32)):
+                assert preds[0][0] == i and preds[1][1] == i
+    finally:
+        client.close()
+        grpc_server.stop(grace=2)
+        server.stop()
+
+
+def test_infra_validator_grpc_canary(tmp_path):
+    from tpu_pipelines.components.infra_validator import _grpc_canary
+
+    payload = _export(tmp_path, "grpc_canary_model", scale=2.0)
+    predict = _grpc_canary(payload)
+    try:
+        preds = predict({"x": np.asarray([[1.0, 0.0, 0.0]], np.float32)})
+        np.testing.assert_allclose(preds, [[2.0, 0.0]])
+    finally:
+        predict.close()

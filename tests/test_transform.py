@@ -264,3 +264,89 @@ def test_quantile_sketch_large_stream_close_to_exact():
     for b, e_rank in zip(got, np.linspace(0, 1, 11)[1:-1]):
         rank = (vals < b).mean()
         assert abs(rank - e_rank) < 0.01, (b, rank, e_rank)
+
+
+def test_apply_device_equals_apply_host(analyzed):
+    """apply_device (jitted numeric subgraph) == apply_host, including the
+    second-chunk shapes a streamed materialization produces."""
+    graph, data = analyzed
+    for sl in (slice(0, 32), slice(32, 45)):   # two different batch shapes
+        batch = {k: v[sl] for k, v in data.items()}
+        ref = graph.apply_host(batch)
+        dev = graph.apply_device(batch)
+        assert set(dev) == set(ref)
+        for k in ref:
+            np.testing.assert_allclose(
+                np.asarray(ref[k], np.float32), np.asarray(dev[k], np.float32),
+                rtol=1e-5, atol=1e-6,
+            )
+
+
+def test_apply_device_string_output_falls_back(tmp_path):
+    """A graph whose output is a raw string column cannot jit; apply_device
+    must transparently produce the host result."""
+    def preprocessing_fn(inputs, tft):
+        return {
+            "pay_raw": inputs["payment_type"],
+            "miles_z": tft.scale_to_z_score(inputs["trip_miles"]),
+        }
+
+    graph = TransformGraph.build(preprocessing_fn, _taxi_schema())
+    data = _taxi_data()
+    graph.analyze(data)
+    batch = {k: v[:16] for k, v in data.items()}
+    ref = graph.apply_host(batch)
+    dev = graph.apply_device(batch)
+    assert [str(x) for x in dev["pay_raw"]] == [str(x) for x in ref["pay_raw"]]
+    np.testing.assert_allclose(dev["miles_z"], ref["miles_z"], rtol=1e-5)
+
+
+def test_transform_component_device_materialization(tmp_path):
+    """Component e2e with materialize_on_device forced on: outputs equal the
+    host-materialized run, and the execution records the device flag +
+    per-split wall-clock."""
+    def run(root, on_device):
+        gen = CsvExampleGen(input_path=TAXI_CSV)
+        schema = SchemaGen(
+            statistics=StatisticsGen(
+                examples=gen.outputs["examples"]
+            ).outputs["statistics"],
+        )
+        tf = Transform(
+            examples=gen.outputs["examples"],
+            schema=schema.outputs["schema"],
+            module_file=TAXI_MODULE,
+            materialize_on_device=on_device,
+        )
+        p = Pipeline(
+            f"tx-dev-{on_device}", [tf],
+            pipeline_root=str(tmp_path / f"root{on_device}"),
+            metadata_path=str(tmp_path / f"md{on_device}.sqlite"),
+        )
+        result = LocalDagRunner().run(p)
+        assert result.succeeded
+        from tpu_pipelines.metadata import MetadataStore
+
+        store = MetadataStore(str(tmp_path / f"md{on_device}.sqlite"))
+        props = store.get_execution(
+            result.nodes["Transform"].execution_id
+        ).properties
+        store.close()
+        uri = result.outputs_of("Transform", "transformed_examples")[0].uri
+        return props, uri
+
+    props_dev, uri_dev = run("a", True)
+    props_host, uri_host = run("b", False)
+    assert props_dev["materialize_on_device"] is True
+    assert props_host["materialize_on_device"] is False
+    assert set(props_dev["materialize_split_wall_s"]) == {"train", "eval"}
+
+    for split in ("train", "eval"):
+        a = examples_io.read_split(uri_dev, split)
+        b = examples_io.read_split(uri_host, split)
+        assert set(a) == set(b)
+        for k in a:
+            np.testing.assert_allclose(
+                np.asarray(a[k], np.float32), np.asarray(b[k], np.float32),
+                rtol=1e-5, atol=1e-6,
+            )

@@ -291,3 +291,40 @@ def test_load_shard_results_rejects_stale_shards(tmp_path):
     got = load_shard_results(d, examples_uri="uri-new", num_shards=2)
     assert set(got) == {'{"x": 1}'}
     assert got['{"x": 1}']["metrics"]["loss"] == 1.0
+
+
+def test_load_shard_results_rejects_trial_config_mismatch(tmp_path):
+    """Shard pods resolve runtime parameters to defaults; a merge running
+    under overridden budgets must skip their scores, not reuse them."""
+    from tpu_pipelines.components.tuner import (
+        _outcome, load_shard_results, trial_config_key, write_shard_results,
+    )
+
+    cfg_default = trial_config_key({"train_steps": 100, "module_file": "m.py"})
+    cfg_override = trial_config_key({"train_steps": 900, "module_file": "m.py"})
+    d = str(tmp_path / "shards")
+    write_shard_results(
+        d, 0, 1, [_outcome(0, {"x": 1}, metrics={"loss": 1.0})],
+        examples_uri="uri", trial_config=cfg_default,
+    )
+    assert load_shard_results(
+        d, examples_uri="uri", num_shards=1, trial_config=cfg_override,
+    ) == {}
+    got = load_shard_results(
+        d, examples_uri="uri", num_shards=1, trial_config=cfg_default,
+    )
+    assert set(got) == {'{"x": 1}'}
+
+
+def test_tuner_merge_requires_merged_candidate_key():
+    """A shard outcome keyed by the RAW candidate (no base_hyperparameters
+    merged in) must not be reused: shards always write merged keys, so a
+    raw-key hit could only be a stale file from a run with different
+    base_hp.  (ADVICE r2: the raw-cand fallback silently reused those.)"""
+    from tpu_pipelines.components.tuner import candidate_key
+
+    # The executor looks up candidate_key({**base_hp, **cand}) only; assert
+    # the two key spaces are distinct so the dropped fallback cannot alias.
+    base_hp = {"lr": 0.1}
+    cand = {"x": 1}
+    assert candidate_key({**base_hp, **cand}) != candidate_key(cand)

@@ -122,12 +122,28 @@ def _convert_options(column_types):
         # pin any column whose type could shift deeper into a large file
         # (whole-file inference below the threshold has no such limit).
         "column_types": Parameter(type=dict, default=None),
+        # Span/version selection (the TFX ExampleGen convention): when
+        # input_path contains "{SPAN}" (and optionally "{VERSION}"), the
+        # highest numbered match ingests unless pinned here.  The runner
+        # resolves the same pattern when content-fingerprinting, so a new
+        # span invalidates the execution cache.
+        "span": Parameter(type=int, default=None),
+        "version": Parameter(type=int, default=None),
     },
     external_input_parameters=("input_path",),
 )
 def CsvExampleGen(ctx):
     """Read CSV file(s), hash-split, write Parquet — streaming when large."""
+    from tpu_pipelines.utils.span import has_span_pattern, resolve_span_pattern
+
     path = ctx.exec_properties["input_path"]
+    span = version = None
+    if has_span_pattern(path):
+        path, span, version = resolve_span_pattern(
+            path,
+            ctx.exec_properties.get("span"),
+            ctx.exec_properties.get("version"),
+        )
     splits = ctx.exec_properties["splits"] or dict(DEFAULT_SPLITS)
     threshold = ctx.exec_properties["streaming_threshold_bytes"]
     convert = _convert_options(ctx.exec_properties["column_types"])
@@ -151,9 +167,24 @@ def CsvExampleGen(ctx):
                 with pacsv.open_csv(f, convert_options=convert) as reader:
                     yield from reader
 
-        counts = _split_and_write_streaming(
-            batches(), out.uri, splits, first.schema
-        )
+        try:
+            counts = _split_and_write_streaming(
+                batches(), out.uri, splits, first.schema
+            )
+        except (pa.ArrowInvalid, pa.ArrowTypeError) as e:
+            # The streaming reader infers each column's type from its FIRST
+            # block only; a type that shifts deeper in a large file (ints
+            # then floats, empty then strings, a schema differing across
+            # files) surfaces here as a raw Arrow cast error mid-stream.
+            raise ValueError(
+                f"streaming CSV ingest of {path!r} failed mid-stream: {e}\n"
+                "The streaming reader pins column types from the first "
+                "block. If a column's type shifts deeper in the file (or "
+                "across files), pin it explicitly via the column_types "
+                "parameter, e.g. column_types={'fare': 'float64'}; "
+                "whole-file reads (below streaming_threshold_bytes) infer "
+                "from every row instead."
+            ) from e
     else:
         table = pa.concat_tables([
             pacsv.read_csv(f, convert_options=convert) for f in files
@@ -161,8 +192,17 @@ def CsvExampleGen(ctx):
         counts = _split_and_write(table, out.uri, splits)
     out.properties["split_names"] = sorted(counts)
     out.properties["split_counts"] = counts
+    if span is not None:
+        out.properties["span"] = span
+    if version is not None:
+        out.properties["version"] = version
     n = sum(counts.values())
-    return {"num_examples": n, **{f"rows_{k}": v for k, v in counts.items()}}
+    props = {"num_examples": n, **{f"rows_{k}": v for k, v in counts.items()}}
+    if span is not None:
+        props["span"] = span
+    if version is not None:
+        props["version"] = version
+    return props
 
 
 @component(

@@ -1,15 +1,24 @@
 """ExampleValidator: anomalies from validating statistics against a schema.
 
 Capability match for TFX ExampleValidator / TFDV ``validate_statistics``
-(SURVEY.md §2a row 4): schema-conformance checks per split, plus optional
-drift detection against a previous statistics artifact (L-infinity distance
-over categorical distributions — the TFDV drift comparator).
+(SURVEY.md §2a row 4): schema-conformance checks per split, plus two
+statistics-vs-statistics comparators mirroring TFDV's:
+
+  - **drift**: this run's splits vs a *previous* statistics artifact
+    (time-adjacent spans);
+  - **skew**: the training split vs the other splits of the *same* artifact
+    (TFDV's training/serving skew comparator — the eval/serving data a model
+    will face must look like what it trained on).
+
+Both use L-infinity distance over categorical top-value distributions and
+Jensen-Shannon divergence (base 2, in [0, 1]) over numeric histograms.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import os
 from typing import Dict, List, Optional
 
@@ -26,7 +35,8 @@ class Anomaly:
     split: str
     feature: str
     kind: str          # MISSING_FEATURE | NEW_FEATURE | TYPE_MISMATCH |
-                       # PRESENCE | OUT_OF_DOMAIN | OUT_OF_RANGE | DRIFT
+                       # PRESENCE | OUT_OF_DOMAIN | OUT_OF_RANGE | DRIFT |
+                       # SKEW
     severity: str      # ERROR | WARNING
     description: str
 
@@ -107,6 +117,87 @@ def linf_categorical_distance(
     return max(abs(da.get(k, 0) / ta - db.get(k, 0) / tb) for k in keys)
 
 
+def _rebin(edges: List[float], counts: List[int], grid: List[float]) -> List[float]:
+    """Histogram mass per ``grid`` interval, treating each source bin as a
+    uniform density — exact for piecewise-constant distributions, which is
+    all a histogram asserts."""
+    total = float(sum(counts)) or 1.0
+    out = []
+    for g0, g1 in zip(grid, grid[1:]):
+        m = 0.0
+        for e0, e1, c in zip(edges, edges[1:], counts):
+            if e1 <= g0 or e0 >= g1 or e1 == e0:
+                continue
+            m += c * (min(e1, g1) - max(e0, g0)) / (e1 - e0)
+        out.append(m / total)
+    return out
+
+
+def js_numeric_divergence(
+    a: SplitStatistics, b: SplitStatistics, feature: str
+) -> Optional[float]:
+    """Jensen-Shannon divergence (base 2, in [0, 1]) between the two splits'
+    numeric histograms, rebinned onto the union of their edges so differing
+    bucket boundaries compare exactly (TFDV's numeric skew/drift measure)."""
+    fa, fb = a.features.get(feature), b.features.get(feature)
+    if not (fa and fb and fa.numeric and fb.numeric):
+        return None
+    ha, hb = fa.numeric, fb.numeric
+    if not (ha.histogram_edges and hb.histogram_edges):
+        return None
+    grid = sorted(set(ha.histogram_edges) | set(hb.histogram_edges))
+    if len(grid) < 2:
+        return None
+    pa = _rebin(ha.histogram_edges, ha.histogram_counts, grid)
+    pb = _rebin(hb.histogram_edges, hb.histogram_counts, grid)
+    # Mass outside the other split's support lands in the union grid's outer
+    # intervals automatically (the union covers both ranges).
+    mid = [(x + y) / 2.0 for x, y in zip(pa, pb)]
+
+    def kl(p, q):
+        # q = mid >= p/2 > 0 wherever p > 0, so the sum is finite.
+        return sum(x * math.log2(x / y) for x, y in zip(p, q) if x > 0)
+
+    return 0.5 * kl(pa, mid) + 0.5 * kl(pb, mid)
+
+
+def compare_splits(
+    current: SplitStatistics,
+    baseline: SplitStatistics,
+    *,
+    kind: str,
+    linf_threshold: float,
+    js_threshold: float,
+    feature_thresholds: Optional[Dict[str, float]] = None,
+    vs: str = "baseline",
+) -> List[Anomaly]:
+    """Distribution comparison between two splits: L-inf over categorical
+    top values, JS divergence over numeric histograms.  A threshold of 0
+    disables that family; ``feature_thresholds`` overrides per feature.
+    Shared by the DRIFT (vs previous artifact) and SKEW (train vs eval/
+    serving split) comparators."""
+    overrides = feature_thresholds or {}
+    anomalies: List[Anomaly] = []
+    for name in current.features:
+        linf_t = overrides.get(name, linf_threshold)
+        if linf_t:
+            d = linf_categorical_distance(current, baseline, name)
+            if d is not None and d > linf_t:
+                anomalies.append(
+                    Anomaly(current.split, name, kind, "ERROR",
+                            f"L-inf distance {d:.4f} > {linf_t} vs {vs}")
+                )
+        js_t = overrides.get(name, js_threshold)
+        if js_t:
+            d = js_numeric_divergence(current, baseline, name)
+            if d is not None and d > js_t:
+                anomalies.append(
+                    Anomaly(current.split, name, kind, "ERROR",
+                            f"JS divergence {d:.4f} > {js_t} vs {vs}")
+                )
+    return anomalies
+
+
 @component(
     inputs={"statistics": "ExampleStatistics", "schema": "Schema"},
     outputs={"anomalies": "ExampleAnomalies"},
@@ -114,6 +205,16 @@ def linf_categorical_distance(
         # Optional uri of a previous ExampleStatistics payload for drift.
         "baseline_statistics_uri": Parameter(type=str, default=""),
         "drift_threshold": Parameter(type=float, default=0.3),
+        # JS-divergence threshold for numeric drift (0 = categorical only,
+        # the pre-existing behavior).
+        "drift_js_threshold": Parameter(type=float, default=0.0),
+        # Training/serving skew: compare skew_baseline_split's distributions
+        # against every other split in THIS statistics artifact.  0 disables
+        # that family; skew_feature_thresholds overrides per feature.
+        "skew_baseline_split": Parameter(type=str, default="train"),
+        "skew_linf_threshold": Parameter(type=float, default=0.0),
+        "skew_js_threshold": Parameter(type=float, default=0.0),
+        "skew_feature_thresholds": Parameter(type=dict, default=None),
         # Fail the pipeline on ERROR-severity anomalies.
         "fail_on_anomalies": Parameter(type=bool, default=True),
     },
@@ -128,18 +229,37 @@ def ExampleValidator(ctx):
     baseline_uri = ctx.exec_properties["baseline_statistics_uri"]
     if baseline_uri:
         baseline = load_statistics(baseline_uri)
-        thresh = ctx.exec_properties["drift_threshold"]
         for split, s in stats.items():
             prev = baseline.get(split)
             if prev is None:
                 continue
-            for name in s.features:
-                d = linf_categorical_distance(s, prev, name)
-                if d is not None and d > thresh:
-                    anomalies.append(
-                        Anomaly(split, name, "DRIFT", "ERROR",
-                                f"L-inf distance {d:.4f} > {thresh} vs baseline")
-                    )
+            anomalies.extend(compare_splits(
+                s, prev, kind="DRIFT",
+                linf_threshold=ctx.exec_properties["drift_threshold"],
+                js_threshold=ctx.exec_properties["drift_js_threshold"],
+            ))
+
+    skew_linf = ctx.exec_properties["skew_linf_threshold"]
+    skew_js = ctx.exec_properties["skew_js_threshold"]
+    skew_overrides = ctx.exec_properties["skew_feature_thresholds"]
+    if skew_linf or skew_js or skew_overrides:
+        train_split = ctx.exec_properties["skew_baseline_split"]
+        train = stats.get(train_split)
+        if train is None:
+            raise ValueError(
+                f"skew comparison needs split {train_split!r}; artifact has "
+                f"{sorted(stats)}"
+            )
+        for split, s in stats.items():
+            if split == train_split:
+                continue
+            anomalies.extend(compare_splits(
+                s, train, kind="SKEW",
+                linf_threshold=skew_linf,
+                js_threshold=skew_js,
+                feature_thresholds=skew_overrides,
+                vs=f"{train_split} split",
+            ))
 
     out = ctx.output("anomalies")
     os.makedirs(out.uri, exist_ok=True)

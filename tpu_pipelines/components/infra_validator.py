@@ -32,10 +32,10 @@ NOT_BLESSED_FILE = "NOT_BLESSED"
         "num_examples": Parameter(type=int, default=8),
         # Raw examples (apply embedded transform) vs pre-transformed.
         "raw_examples": Parameter(type=bool, default=True),
-        # "inprocess": load + call predict directly.  "http": boot the
-        # framework ModelServer on a loopback port and canary through the
-        # REST surface — the closest local equivalent of the reference's
-        # serving-container canary.
+        # "inprocess": load + call predict directly.  "http"/"grpc": boot
+        # the framework ModelServer on a loopback port and canary through
+        # that surface — the closest local equivalent of the reference's
+        # serving-container canary (TF Serving speaks both, SURVEY.md §3.5).
         "serving_binary": Parameter(type=str, default="inprocess"),
         # Latency smoke: after one warmup, time this many repeat predicts on
         # the same batch and record p50/p95 (ms) into the blessing.
@@ -57,8 +57,14 @@ def InfraValidator(ctx):
     try:
         data = examples_io.read_split(ctx.input("examples").uri, split)
         batch = {k: v[:n] for k, v in data.items()}
-        if ctx.exec_properties.get("serving_binary", "inprocess") == "http":
+        binary = ctx.exec_properties.get("serving_binary", "inprocess")
+        if binary == "http":
             predict = _http_canary(
+                ctx.input("model").uri,
+                raw=ctx.exec_properties["raw_examples"],
+            )
+        elif binary == "grpc":
+            predict = _grpc_canary(
                 ctx.input("model").uri,
                 raw=ctx.exec_properties["raw_examples"],
             )
@@ -142,4 +148,28 @@ def _http_canary(model_uri: str, raw: bool = True):
             return np.asarray(json.load(r)["predictions"])
 
     predict.close = server.stop
+    return predict
+
+
+def _grpc_canary(model_uri: str, raw: bool = True):
+    """predict(batch) through the gRPC surface on a loopback port."""
+    from tpu_pipelines.serving import ModelServer
+    from tpu_pipelines.serving.grpc_server import (
+        PredictionClient,
+        start_grpc_server,
+    )
+
+    server = ModelServer("canary", model_uri, raw=raw)
+    grpc_server, port = start_grpc_server(server)
+    client = PredictionClient(f"127.0.0.1:{port}")
+
+    def predict(batch) -> np.ndarray:
+        preds, _ = client.predict("canary", batch)
+        return np.asarray(preds)
+
+    def close() -> None:
+        client.close()
+        grpc_server.stop(grace=2)
+
+    predict.close = close
     return predict

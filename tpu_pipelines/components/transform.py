@@ -11,6 +11,7 @@ training and serving, by construction.
 
 from __future__ import annotations
 
+import logging
 import os
 import time
 import shutil
@@ -23,6 +24,8 @@ from tpu_pipelines.transform.graph import TransformGraph
 from tpu_pipelines.utils.module_loader import load_fn
 
 MODULE_COPY = "module_file.py"
+
+log = logging.getLogger(__name__)
 
 
 @component(
@@ -42,6 +45,11 @@ MODULE_COPY = "module_file.py"
         "chunk_rows": Parameter(type=int, default=0),  # 0 = row-group size
         # On-chip analyzer reductions: None/"auto" | True | False.
         "analyze_on_chip": Parameter(type=bool, default=None),
+        # Materialize through the jitted numeric subgraph on the default
+        # jax device (BASELINE: "Transform ... jit_compile=True on-chip").
+        # None/"auto" = on when an accelerator is present; host numpy is
+        # always the fallback (and the semantics reference).
+        "materialize_on_device": Parameter(type=bool, default=None),
     },
     external_input_parameters=("module_file",),
 )
@@ -63,11 +71,20 @@ def Transform(ctx):
         ctx.exec_properties["chunk_rows"] or examples_io.DEFAULT_ROW_GROUP
     )
 
+    analyze_rows = 0
+
+    def counted_chunks():
+        nonlocal analyze_rows
+        for chunk in examples_io.iter_column_chunks(
+            examples_uri, analyze_split, rows=chunk_rows
+        ):
+            if chunk:
+                analyze_rows += len(next(iter(chunk.values())))
+            yield chunk
+
     t0 = time.perf_counter()
     graph.analyze_chunks(
-        lambda: examples_io.iter_column_chunks(
-            examples_uri, analyze_split, rows=chunk_rows
-        ),
+        counted_chunks,
         on_chip=ctx.exec_properties["analyze_on_chip"],
     )
     analyze_s = time.perf_counter() - t0
@@ -81,16 +98,43 @@ def Transform(ctx):
 
     passthrough = ctx.exec_properties["passthrough_columns"] or []
     transformed_out = ctx.output("transformed_examples")
+
+    on_device = ctx.exec_properties.get("materialize_on_device")
+    if on_device is None:
+        import jax
+
+        on_device = jax.default_backend() not in ("cpu",)
+
+    def materialize_chunk(raw):
+        nonlocal on_device
+        if on_device:
+            try:
+                cols = graph.apply_device(raw)
+            except Exception as e:  # noqa: BLE001 — host numpy is authoritative
+                log.warning(
+                    "device materialization failed (%s); using host numpy", e
+                )
+                on_device = False
+            else:
+                if graph.device_apply_active is False:
+                    # apply_device decided the graph can't jit (string
+                    # interface) and used the host path — record the truth.
+                    on_device = False
+                return cols
+        return graph.apply_host(raw)
+
     counts = {}
+    split_wall = {}
     t0 = time.perf_counter()
     for split in splits:
         writer = None
         n_rows = 0
+        t_split = time.perf_counter()
         try:
             for raw in examples_io.iter_column_chunks(
                 examples_uri, split, rows=chunk_rows
             ):
-                cols = graph.apply_host(raw)
+                cols = materialize_chunk(raw)
                 for name in passthrough:
                     if name in cols:
                         raise ValueError(
@@ -109,6 +153,7 @@ def Transform(ctx):
             if writer is not None:
                 writer.close()
         counts[split] = n_rows
+        split_wall[split] = round(time.perf_counter() - t_split, 4)
     materialize_s = time.perf_counter() - t0
     total_rows = sum(counts.values())
     transformed_out.properties["split_names"] = sorted(counts)
@@ -122,8 +167,19 @@ def Transform(ctx):
         # Host data-plane throughput (the Beam-replacement measurement):
         # materialization covers tokenize/vocab/hash + Parquet write.
         "analyze_wall_s": round(analyze_s, 4),
+        # Full-pass analysis throughput — the stage the native token-count
+        # kernel + pool fan-out accelerate (SURVEY.md §2b Beam row).  The
+        # pass may run multiple phases over the split for nested analyzers,
+        # so rows here counts every streamed row, re-reads included.
+        "analyze_rows_per_sec": (
+            round(analyze_rows / analyze_s, 2) if analyze_s > 0 else 0.0
+        ),
         "materialize_wall_s": round(materialize_s, 4),
+        "materialize_split_wall_s": split_wall,
         "materialize_rows_per_sec": (
             round(total_rows / materialize_s, 2) if materialize_s > 0 else 0.0
         ),
+        # True = every chunk went through the jitted device path (a mid-run
+        # fallback to host numpy flips this off).
+        "materialize_on_device": bool(on_device),
     }

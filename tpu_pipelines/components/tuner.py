@@ -96,6 +96,25 @@ def candidate_key(cand: Dict[str, Any]) -> str:
     return json.dumps(cand, sort_keys=True, default=str)
 
 
+def trial_config_key(exec_properties: Dict[str, Any]) -> str:
+    """Canonical key over everything (besides the candidate hyperparameters,
+    which the merged candidate_key covers) that changes what a shard trial
+    trains: budgets, mesh, custom_config, module file.  Shard pods resolve
+    runtime parameters to their *defaults*, so a run with runtime-overridden
+    budgets must not silently reuse shard scores trained under the defaults
+    — the merge validates this key against each shard file."""
+    return json.dumps(
+        {
+            "train_steps": exec_properties.get("train_steps", 100),
+            "eval_steps": exec_properties.get("eval_steps", 0),
+            "mesh": exec_properties.get("mesh"),
+            "custom_config": exec_properties.get("custom_config"),
+            "module_file": exec_properties.get("module_file"),
+        },
+        sort_keys=True, default=str,
+    )
+
+
 def resolve_search_space(
     exec_properties: Dict[str, Any], module_file: str
 ) -> Dict[str, List[Any]]:
@@ -285,6 +304,7 @@ def shard_file_path(shard_dir: str, shard: int, num_shards: int) -> str:
 def write_shard_results(
     shard_dir: str, shard: int, num_shards: int,
     outcomes: List[Dict[str, Any]], *, examples_uri: str = "",
+    trial_config: str = "",
 ) -> str:
     os.makedirs(shard_dir, exist_ok=True)
     path = shard_file_path(shard_dir, shard, num_shards)
@@ -292,6 +312,7 @@ def write_shard_results(
     with open(tmp, "w") as f:
         json.dump({"shard": shard, "num_shards": num_shards,
                    "examples_uri": examples_uri,
+                   "trial_config": trial_config,
                    "outcomes": outcomes}, f, indent=2, default=str)
     os.replace(tmp, path)  # atomic: mergers never see half a shard
     return path
@@ -299,6 +320,7 @@ def write_shard_results(
 
 def load_shard_results(
     shard_dir: str, *, examples_uri: str = "", num_shards: int = 0,
+    trial_config: str = "",
 ) -> Dict[str, Dict[str, Any]]:
     """{candidate_key: outcome} from every *matching* shard file.  Keyed by
     hyperparameter content, not index, so a shard/merge enumeration mismatch
@@ -329,6 +351,14 @@ def load_shard_results(
             logger.warning(
                 "ignoring stale tuner shard %s (examples %r, want %r)",
                 path, payload.get("examples_uri"), examples_uri,
+            )
+            continue
+        if trial_config and payload.get("trial_config") != trial_config:
+            # Shards trained under different budgets/mesh/custom_config (e.g.
+            # runtime-parameter overrides the shard pods resolved to
+            # defaults) — their scores answer a different question.
+            logger.warning(
+                "ignoring stale tuner shard %s (trial config mismatch)", path,
             )
             continue
         for outcome in payload.get("outcomes", []):
@@ -400,13 +430,17 @@ def Tuner(ctx):
         shard_dir,
         examples_uri=uris["examples_uri"],
         num_shards=int(ctx.exec_properties["trial_shards"] or 0),
+        trial_config=trial_config_key(ctx.exec_properties),
     ) if shard_dir else {}
     outcomes: Dict[int, Dict[str, Any]] = {}
     todo: List[int] = []
     for i, cand in enumerate(candidates):
+        # Merged-key lookup only: shards write {**base_hp, **cand} keys, so a
+        # raw-cand fallback could silently resurrect a shard score computed
+        # under DIFFERENT base_hyperparameters (shard files live at a fixed
+        # path and survive base_hp changes).  A miss degrades to a local
+        # re-run, which is always correct.
         pre = precomputed.get(candidate_key({**base_hp, **cand}))
-        if pre is None:
-            pre = precomputed.get(candidate_key(cand))
         if pre is not None:
             outcomes[i] = {**pre, "trial": i}
         else:

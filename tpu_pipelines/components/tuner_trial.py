@@ -50,6 +50,7 @@ def _run_shard(args) -> int:
         _run_trial_subprocess,
         build_trial_fn_args,
         enumerate_candidates,
+        trial_config_key,
         write_shard_results,
     )
     from tpu_pipelines.dsl.compiler import Compiler, resolve_property
@@ -123,6 +124,7 @@ def _run_shard(args) -> int:
         path = write_shard_results(
             args.shard_dir, shard, num_shards, outcomes,
             examples_uri=examples_uri,
+            trial_config=trial_config_key(props),
         )
     logger.info("tuner shard %d/%d wrote %s", shard, num_shards, path)
     return 0

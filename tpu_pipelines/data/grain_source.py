@@ -91,6 +91,18 @@ class ParquetRowSource:
         return {k: v[row] for k, v in cols.items()}
 
 
+def grain_shard_rows(n_total: int, config) -> int:
+    """Rows Grain's ShardOptions assigns this shard: CONTIGUOUS even blocks
+    (with drop_remainder, exactly floor(n/k) each; without, the first n%k
+    shards get one extra) — not the strided i%k convention of the in-process
+    readers.  The single source of this formula for BatchIterator's counts
+    and the aligned-epoch fast path below."""
+    base, extra = divmod(n_total, config.num_shards)
+    if config.drop_remainder:
+        return base
+    return base + (1 if config.shard_index < extra else 0)
+
+
 def grain_batches(uri: str, split: str, config, columns=None):
     """Infinite-or-num_epochs iterator of dict-of-numpy batches via Grain.
 
@@ -101,38 +113,67 @@ def grain_batches(uri: str, split: str, config, columns=None):
     every interpreter, but readers never touch jax devices, so no backend
     initializes in them.)
 
-    One single-epoch loader per epoch, NOT one multi-epoch sampler: Grain
-    would emit a flat index stream whose batches straddle epoch boundaries,
-    breaking the steps_per_epoch()/per-epoch-reshuffle contract the
-    in-process readers keep.  The cost is a worker-pool respawn per epoch —
-    noise next to an epoch of training.
+    When this shard's rows divide evenly into batches (drop_remainder with
+    shard_n % batch == 0), ONE multi-epoch loader serves the whole run:
+    Grain's IndexSampler reshuffles per epoch internally (verified: each
+    num_records block is a fresh permutation) and aligned batches never
+    straddle an epoch boundary, so the steps_per_epoch()/per-epoch-reshuffle
+    contract holds with zero worker-pool respawns — the respawn cost that
+    could rival a short fine-tune epoch.  Unaligned shards fall back to one
+    single-epoch loader per epoch (a flat multi-epoch stream would emit
+    batches mixing the tail of one epoch with the head of the next).
     """
     import grain.python as pg
 
     source = ParquetRowSource(uri, split, columns)
-    epoch = 0
-    while config.num_epochs is None or epoch < config.num_epochs:
-        sampler = pg.IndexSampler(
-            num_records=len(source),
-            shard_options=pg.ShardOptions(
-                shard_index=config.shard_index,
-                shard_count=config.num_shards,
-                drop_remainder=config.drop_remainder,
+    shard_options = pg.ShardOptions(
+        shard_index=config.shard_index,
+        shard_count=config.num_shards,
+        drop_remainder=config.drop_remainder,
+    )
+
+    read_options = None
+    if (
+        getattr(config, "grain_read_threads", None) is not None
+        or getattr(config, "grain_prefetch_rows", None) is not None
+    ):
+        threads = config.grain_read_threads
+        threads = 16 if threads is None else threads
+        prefetch = config.grain_prefetch_rows
+        read_options = pg.ReadOptions(
+            num_threads=threads,
+            prefetch_buffer_size=(
+                max(threads, 16) if prefetch is None else prefetch
             ),
-            shuffle=config.shuffle,
-            num_epochs=1,
-            # Distinct per-epoch reshuffle, deterministic in (seed, epoch).
-            seed=config.seed * 100_003 + epoch,
         )
-        loader = pg.DataLoader(
+
+    def loader_for(num_epochs, seed):
+        return pg.DataLoader(
             data_source=source,
-            sampler=sampler,
+            sampler=pg.IndexSampler(
+                num_records=len(source),
+                shard_options=shard_options,
+                shuffle=config.shuffle,
+                num_epochs=num_epochs,
+                seed=seed,
+            ),
             operations=[
                 pg.Batch(
                     config.batch_size, drop_remainder=config.drop_remainder
                 )
             ],
             worker_count=config.grain_workers,
+            read_options=read_options,
         )
-        yield from loader
+
+    shard_n = grain_shard_rows(len(source), config)
+    if config.drop_remainder and shard_n % config.batch_size == 0:
+        # num_epochs=None = infinite, still reshuffled per epoch.
+        yield from loader_for(config.num_epochs, config.seed)
+        return
+
+    epoch = 0
+    while config.num_epochs is None or epoch < config.num_epochs:
+        # Distinct per-epoch reshuffle, deterministic in (seed, epoch).
+        yield from loader_for(1, config.seed * 100_003 + epoch)
         epoch += 1

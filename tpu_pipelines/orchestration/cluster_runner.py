@@ -202,6 +202,17 @@ class TPUJobRunner:
             elif deps:
                 task["dependencies"] = deps
             tasks.append(task)
+        if any("depends" in t for t in tasks):
+            # Argo rejects DAG templates that mix `depends` and
+            # `dependencies`; when any task needs a `depends` expression
+            # (tuner fan-out above), rewrite the plain lists into their
+            # equivalent expression so the whole DAG uses one form.
+            for t in tasks:
+                deps = t.pop("dependencies", None)
+                if deps:
+                    t["depends"] = " && ".join(
+                        f"{d}.Succeeded" for d in deps
+                    )
         templates: List[Dict[str, Any]] = [
             {"name": "pipeline-dag", "dag": {"tasks": tasks}}
         ]

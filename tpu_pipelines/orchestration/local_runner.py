@@ -45,6 +45,7 @@ from tpu_pipelines.metadata.types import (
     ExecutionState,
 )
 from tpu_pipelines.utils.fingerprint import execution_cache_key, fingerprint_dir
+from tpu_pipelines.utils.span import has_span_pattern, resolve_span_pattern
 
 log = logging.getLogger("tpu_pipelines.runner")
 
@@ -377,9 +378,18 @@ class LocalDagRunner:
         }
         # External data named by path-valued exec-properties participates by
         # content, so editing a source file invalidates the cache even though
-        # the path string is unchanged.
+        # the path string is unchanged.  {SPAN}/{VERSION} patterns resolve to
+        # the concrete (newest or pinned) directory FIRST, so a new span
+        # arriving at an unchanged pattern string also invalidates.
         for param in node.external_input_parameters:
             path = props.get(param)
+            if isinstance(path, str) and has_span_pattern(path):
+                try:
+                    path, _, _ = resolve_span_pattern(
+                        path, props.get("span"), props.get("version"),
+                    )
+                except FileNotFoundError:
+                    path = None  # executor will raise with the real error
             if isinstance(path, str) and os.path.exists(path):
                 input_fps[f"__external__:{param}"] = [fingerprint_dir(path)]
         cache_key = execution_cache_key(

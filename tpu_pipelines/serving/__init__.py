@@ -7,6 +7,8 @@ TensorFlow Serving (C++ gRPC/REST, versioned model dirs).  Here:
     over the framework's self-contained model payloads, with TF-Serving's
     version-dir convention (serves the highest numeric subdir, re-scans on
     demand) and endpoint shapes (``/v1/models/<name>:predict``).
+  - ``tpu_pipelines.serving.grpc_server`` — the gRPC half of the surface:
+    a PredictionService sharing the same loaded model and micro-batcher.
   - ``tpu_pipelines.serving.saved_model`` — optional jax2tf SavedModel export
     for interop with actual TF Serving deployments.
 """

@@ -41,6 +41,9 @@ def main(argv=None) -> int:
     parser.add_argument("--batch-timeout-ms", type=float, default=5.0)
     parser.add_argument("--poll-seconds", type=float, default=30.0,
                         help="version-watch interval; 0 disables hot reload")
+    parser.add_argument("--grpc-port", type=int, default=-1,
+                        help="also serve gRPC predict on this port "
+                             "(0 = ephemeral; -1 = REST only)")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -65,11 +68,29 @@ def main(argv=None) -> int:
                 args.base_dir,
             )
             time.sleep(max(args.poll_seconds, 1.0))
+        except Exception as e:  # noqa: BLE001
+            # A version dir observed mid-write (a non-atomic pusher, scp, …)
+            # can fail with anything; keep waiting like TF Serving's watcher
+            # instead of crash-looping the pod — the next poll sees the
+            # finished payload.
+            log.warning(
+                "model under %r not loadable yet (%s); retrying",
+                args.base_dir, e,
+            )
+            time.sleep(max(args.poll_seconds, 1.0))
     port = server.start(port=args.port, host=args.host)
     log.info(
         "serving %r (version %s) on %s:%d",
         args.model_name, server.version, args.host, port,
     )
+    grpc_server = None
+    if args.grpc_port >= 0:
+        from tpu_pipelines.serving.grpc_server import start_grpc_server
+
+        grpc_server, grpc_port = start_grpc_server(
+            server, port=args.grpc_port, host=args.host
+        )
+        log.info("grpc predict on %s:%d", args.host, grpc_port)
 
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
@@ -84,6 +105,8 @@ def main(argv=None) -> int:
             except Exception as e:  # noqa: BLE001 — keep serving old version
                 log.warning("version rescan failed: %s", e)
     finally:
+        if grpc_server is not None:
+            grpc_server.stop(grace=2)
         server.stop()
         log.info("server stopped")
     return 0

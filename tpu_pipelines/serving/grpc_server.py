@@ -1,0 +1,225 @@
+"""gRPC predict surface sharing the REST server's model + batcher.
+
+SURVEY.md §3.5 names TF Serving's surface as "gRPC/REST predict"; this is
+the gRPC half.  One ``GrpcPredictionService`` wraps an existing
+``ModelServer`` and exposes:
+
+    /tpu_pipelines.serving.PredictionService/Predict
+    /tpu_pipelines.serving.PredictionService/GetModelStatus
+
+Requests route through ``ModelServer``'s predict path, so micro-batching
+(``batching=True``) coalesces concurrent gRPC and REST callers into the
+same padded device calls, and hot-swaps apply to both surfaces at once.
+
+The service is registered with hand-written ``grpc.method_handlers`` over
+the protoc-generated messages (``prediction_service_pb2``): the image has
+``protoc`` but not the grpc python codegen plugin, and the handler table is
+four lines of boilerplate per method anyway.
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent import futures
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from tpu_pipelines.serving import prediction_service_pb2 as pb
+from tpu_pipelines.serving.server import ModelServer
+
+log = logging.getLogger("tpu_pipelines.serving")
+
+SERVICE_NAME = "tpu_pipelines.serving.PredictionService"
+
+_NUMERIC_DTYPES = ("float32", "float64", "int32", "int64", "bool")
+
+
+# ------------------------------------------------------------------- codec
+
+def array_to_tensor(arr: np.ndarray) -> "pb.TensorValue":
+    arr = np.asarray(arr)
+    t = pb.TensorValue(shape=list(arr.shape))
+    if arr.dtype.kind in ("U", "S", "O"):
+        t.dtype = "string"
+        t.string_vals.extend(
+            v if isinstance(v, bytes) else str(v).encode("utf-8")
+            for v in arr.reshape(-1)
+        )
+        return t
+    if arr.dtype.name not in _NUMERIC_DTYPES:
+        # Widen wire-exotic numerics instead of failing: TPU models
+        # routinely predict in bfloat16/float16, and the REST surface
+        # (preds.tolist()) serves them fine — the two surfaces must agree.
+        if arr.dtype.kind == "f":
+            arr = arr.astype(np.float32)
+        elif arr.dtype.kind in ("i", "u"):
+            arr = arr.astype(np.int64)
+        else:
+            raise ValueError(f"unsupported tensor dtype {arr.dtype.name!r}")
+    t.dtype = arr.dtype.name
+    t.data = np.ascontiguousarray(arr).astype(arr.dtype.newbyteorder("<")).tobytes()
+    return t
+
+
+def tensor_to_array(t: "pb.TensorValue") -> np.ndarray:
+    shape = tuple(t.shape)
+    if t.dtype == "string":
+        vals = [v.decode("utf-8") for v in t.string_vals]
+        return np.asarray(vals, dtype=object).reshape(shape)
+    if t.dtype not in _NUMERIC_DTYPES:
+        raise ValueError(f"unsupported tensor dtype {t.dtype!r}")
+    arr = np.frombuffer(t.data, dtype=np.dtype(t.dtype).newbyteorder("<"))
+    return arr.astype(t.dtype).reshape(shape)
+
+
+# ----------------------------------------------------------------- service
+
+class GrpcPredictionService:
+    """The servicer: validates the model name, decodes tensors, and predicts
+    through the shared ``ModelServer`` (batcher included)."""
+
+    def __init__(self, server: ModelServer):
+        self._server = server
+
+    def Predict(self, request: "pb.PredictRequest", context):
+        import grpc
+
+        if request.model_name and request.model_name != self._server.model_name:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"unknown model {request.model_name!r} "
+                f"(serving {self._server.model_name!r})",
+            )
+        try:
+            batch: Dict[str, Any] = {
+                k: tensor_to_array(v) for k, v in request.inputs.items()
+            }
+            if not batch:
+                raise ValueError("request has no inputs")
+        except Exception as e:  # noqa: BLE001 — request decode/shape faults
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, f"{type(e).__name__}: {e}"
+            )
+        try:
+            preds = self._server.predict_batch(batch)
+        except (ValueError, KeyError, TypeError) as e:
+            # The model rejecting this batch (missing feature, wrong shape)
+            # is still the caller's fault.
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, f"{type(e).__name__}: {e}"
+            )
+        except Exception as e:  # noqa: BLE001 — server-side fault: the
+            # client's request is fine and a retry may succeed (model mid-
+            # swap, device error); INVALID_ARGUMENT would tell clients and
+            # load balancers to stop retrying.
+            context.abort(
+                grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}"
+            )
+        try:
+            return pb.PredictResponse(
+                model_version=self._server.version or "",
+                predictions=array_to_tensor(np.asarray(preds)),
+            )
+        except Exception as e:  # noqa: BLE001 — encode fault is server-side
+            context.abort(
+                grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}"
+            )
+
+    def GetModelStatus(self, request: "pb.ModelStatusRequest", context):
+        import grpc
+
+        if request.model_name and request.model_name != self._server.model_name:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"unknown model {request.model_name!r}",
+            )
+        return pb.ModelStatusResponse(
+            version=self._server.version or "", state="AVAILABLE"
+        )
+
+
+def _method_handlers(service: GrpcPredictionService):
+    import grpc
+
+    return {
+        "Predict": grpc.unary_unary_rpc_method_handler(
+            service.Predict,
+            request_deserializer=pb.PredictRequest.FromString,
+            response_serializer=pb.PredictResponse.SerializeToString,
+        ),
+        "GetModelStatus": grpc.unary_unary_rpc_method_handler(
+            service.GetModelStatus,
+            request_deserializer=pb.ModelStatusRequest.FromString,
+            response_serializer=pb.ModelStatusResponse.SerializeToString,
+        ),
+    }
+
+
+def start_grpc_server(
+    model_server: ModelServer,
+    *,
+    port: int = 0,
+    host: str = "127.0.0.1",
+    max_workers: int = 16,
+) -> Tuple[Any, int]:
+    """Serve gRPC predict for ``model_server``; returns (grpc_server, port).
+
+    Call ``grpc_server.stop(grace)`` to shut down.  Runs alongside (not
+    instead of) the REST surface; both share one loaded model and batcher.
+    """
+    import grpc
+
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler(
+            SERVICE_NAME, _method_handlers(GrpcPredictionService(model_server))
+        ),
+    ))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0:
+        raise RuntimeError(f"could not bind gRPC port on {host}:{port}")
+    server.start()
+    log.info("gRPC predict for %r on %s:%d", model_server.model_name, host, bound)
+    return server, bound
+
+
+# ------------------------------------------------------------------ client
+
+class PredictionClient:
+    """Minimal client for tests and the InfraValidator gRPC canary."""
+
+    def __init__(self, target: str):
+        import grpc
+
+        self._channel = grpc.insecure_channel(target)
+        self._predict = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/Predict",
+            request_serializer=pb.PredictRequest.SerializeToString,
+            response_deserializer=pb.PredictResponse.FromString,
+        )
+        self._status = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/GetModelStatus",
+            request_serializer=pb.ModelStatusRequest.SerializeToString,
+            response_deserializer=pb.ModelStatusResponse.FromString,
+        )
+
+    def predict(
+        self, model_name: str, batch: Dict[str, Any], timeout: float = 30.0
+    ) -> Tuple[np.ndarray, str]:
+        req = pb.PredictRequest(model_name=model_name)
+        for k, v in batch.items():
+            req.inputs[k].CopyFrom(array_to_tensor(np.asarray(v)))
+        resp = self._predict(req, timeout=timeout)
+        return tensor_to_array(resp.predictions), resp.model_version
+
+    def model_status(
+        self, model_name: str, timeout: float = 10.0
+    ) -> Dict[str, str]:
+        resp = self._status(
+            pb.ModelStatusRequest(model_name=model_name), timeout=timeout
+        )
+        return {"version": resp.version, "state": resp.state}
+
+    def close(self) -> None:
+        self._channel.close()

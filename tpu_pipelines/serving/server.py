@@ -127,6 +127,15 @@ class ModelServer:
             raise RuntimeError("no model loaded")
         return loaded.predict if self.raw else loaded.predict_transformed
 
+    def predict_batch(self, batch: Dict[str, Any]) -> np.ndarray:
+        """Predict on a columnar feature batch — the shared entry for every
+        surface (REST, gRPC, InfraValidator canaries), so all of them ride
+        the same micro-batcher and see hot-swaps at the same instant."""
+        n_rows = len(next(iter(batch.values())))
+        if self._batcher is not None:
+            return self._batcher.submit(batch, n_rows)
+        return np.asarray(self._predict_fn()(batch))
+
     def predict(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         """TF-Serving REST semantics: 'instances' (row) or 'inputs' (column)."""
         if "instances" in payload:
@@ -141,12 +150,7 @@ class ModelServer:
             batch = {k: np.asarray(v) for k, v in payload["inputs"].items()}
         else:
             raise ValueError("request needs 'instances' or 'inputs'")
-        n_rows = len(next(iter(batch.values())))
-        if self._batcher is not None:
-            preds = self._batcher.submit(batch, n_rows)
-        else:
-            preds = np.asarray(self._predict_fn()(batch))
-        return {"predictions": preds.tolist()}
+        return {"predictions": self.predict_batch(batch).tolist()}
 
     # ---------------------------------------------------------------- HTTP
 

@@ -110,6 +110,14 @@ class TrainResult:
     final_metrics: Dict[str, float] = dataclasses.field(default_factory=dict)
     examples_per_sec: float = 0.0
     examples_per_sec_per_chip: float = 0.0
+    # Median examples/sec/chip over device-sync-anchored step windows
+    # (TrainLoopConfig.anchor_every > 0); 0.0 when anchoring was off or the
+    # run was too short for a full window.  On platforms where host clocks
+    # can run ahead of device execution this is the primary throughput
+    # figure; examples_per_sec_per_chip (whole-run, end-anchored) is the
+    # secondary.
+    anchored_examples_per_sec_per_chip: float = 0.0
+    anchor_windows: int = 0
     steps_completed: int = 0
     resumed_from_step: int = 0
     # Productive fraction of job wall-clock.  Source "ml_goodput_measurement"

@@ -86,6 +86,8 @@ class TransformGraph:
         self.nodes = nodes
         self.outputs = outputs
         self.state: Dict[int, Dict[str, Any]] = state or {}
+        # Lazy (host_fn, jitted device_fn) pair for apply_device.
+        self._device_apply = None
 
     # ------------------------------------------------------------ building
 
@@ -230,6 +232,47 @@ class TransformGraph:
         """Vectorized numpy evaluation (materialization / host fallback)."""
         vals = self._eval(batch, np)
         return {name: vals[nid] for name, nid in self.outputs.items()}
+
+    def apply_device(
+        self, batch: Dict[str, np.ndarray]
+    ) -> Dict[str, np.ndarray]:
+        """Materialize one batch through the host/device split: string ops
+        on host, the whole numeric subgraph as ONE jitted computation on the
+        default jax device (the BASELINE "Transform ... jit_compile=True
+        on-chip" path for materialization, not just analyzer reductions).
+        Numerically equal to apply_host up to f32 rounding — both are
+        interpretations of the same DAG; tested for equality e2e.
+        """
+        if self._device_apply is None:
+            import jax
+
+            host_fn, device_fn, iface_names = self.split_host_device()
+            if any(
+                self.nodes[int(k[1:])].dtype == STRING for k in iface_names
+            ):
+                # A string-valued output crosses the interface (e.g. an
+                # identity passthrough of a raw string column): jit cannot
+                # ingest or return string arrays, so this graph materializes
+                # host-side.  Numeric-only graphs — the common case once
+                # strings are vocab'd/hashed — take the device path.
+                self._device_apply = (None, None)
+            else:
+                self._device_apply = (host_fn, jax.jit(device_fn))
+        host_fn, jitted = self._device_apply
+        if jitted is None:
+            return self.apply_host(batch)
+        out = jitted(host_fn(batch))
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    @property
+    def device_apply_active(self) -> Optional[bool]:
+        """None before apply_device first ran; False when it decided this
+        graph cannot jit (string interface) and is silently using the host
+        path; True when chunks really go through the jitted device fn.
+        Callers recording "ran on device" must check this, not assume."""
+        if self._device_apply is None:
+            return None
+        return self._device_apply[1] is not None
 
     def _eval(
         self,
@@ -605,13 +648,130 @@ def _acc_update(
         _sketch_add(acc, vals[~np.isnan(vals)])
         return acc
     if node.op == "tokenize":
-        counts = acc["counts"]
-        lowercase = node.params.get("lowercase", True)
-        for text in col:
-            for tok in _pretokenize(text, lowercase):
-                counts[tok] = counts.get(tok, 0) + 1
+        _count_pretokens_into(acc, col, node.params.get("lowercase", True))
         return acc
     raise ValueError(f"unknown analyzer {node.op!r}")
+
+
+def _tokenize_stringify(col) -> np.ndarray:
+    """Per-element ``str(value)`` semantics as a U-dtype array — the exact
+    text the per-row Python engine tokenizes (floats keep their decimal
+    text, None becomes ""), unlike ``_stringify_column`` whose int64 cast
+    is vocab_apply's contract, not tokenize's."""
+    arr = np.asarray(col)
+    if arr.dtype == object:
+        # None pretokenizes to no tokens ("" in the Python engine);
+        # stringify would turn it into the literal "None".
+        mask = np.frompyfunc(lambda x: x is None, 1, 1)(arr).astype(bool)
+        if mask.any():
+            arr = arr.copy()
+            arr[mask] = ""
+    return np.asarray(arr.ravel(), dtype="U")
+
+
+def _split_ascii_rows(col, strs: Optional[np.ndarray] = None):
+    """(ascii_rows: List[bytes], other_texts: List[str]) for native routing.
+
+    All-ASCII columns (the common corpus) take one vectorized encode; mixed
+    columns degrade to per-row routing so non-ASCII rows keep Python's exact
+    unicode semantics.  ``strs`` passes in an already-stringified column so
+    callers that stringified for another fast path don't pay twice.
+    """
+    if strs is None:
+        strs = _tokenize_stringify(col)
+    try:
+        return [bytes(b) for b in np.char.encode(strs, "ascii")], []
+    except UnicodeEncodeError:
+        pass
+    ascii_rows, others = [], []
+    for s in strs:
+        try:
+            ascii_rows.append(str(s).encode("ascii"))
+        except UnicodeEncodeError:
+            others.append(str(s))
+    return ascii_rows, others
+
+
+def _count_pretokens_into(acc: Dict[str, Any], col, lowercase: bool) -> None:
+    """Accumulate the vocab-build token counts for one chunk.
+
+    The full-corpus counting pass is the stage the reference ran as a Beam
+    CombinePerKey (SURVEY.md §3.4 / §2b); here, preference order mirrors
+    the apply side (_apply_tokenize): the C++ count kernel for ASCII rows
+    (token counts stay in the C++ hash map until finalize), a process-pool
+    fan-out of the Python counter when the toolchain can't build the native
+    core, and the plain in-process loop for small chunks.  Non-ASCII rows
+    always count through Python's unicode-exact pretokenizer.
+    """
+    counts = acc["counts"]
+
+    def count_py(texts) -> None:
+        for text in texts:
+            for tok in _pretokenize(text, lowercase):
+                counts[tok] = counts.get(tok, 0) + 1
+
+    from tpu_pipelines.transform import native_tokenizer
+
+    native = acc.get("_native_counter")
+    if native is None and "_native_counter" not in acc:
+        try:
+            native = native_tokenizer.NativeTokenCounter(lowercase)
+        except RuntimeError:
+            native = None
+        acc["_native_counter"] = native
+    if native is not None:
+        strs = _tokenize_stringify(col)
+        # All-ASCII fast path: the U-dtype UCS4 buffer crosses the FFI
+        # as-is (one vectorized max() validates) — no encode pass, no
+        # per-row Python objects at all.
+        if native.add_unicode_array(strs):
+            return
+        ascii_rows, others = _split_ascii_rows(col, strs=strs)
+        native.add_ascii_rows(ascii_rows)
+        count_py(others)
+        return
+
+    import os as _os
+
+    workers = min(_os.cpu_count() or 1, _TOK_MAX_WORKERS)
+    if len(col) >= _TOK_MIN_PARALLEL_ROWS and workers > 1:
+        # One pool for the WHOLE analysis pass, stashed on the accumulator
+        # like the native counter (finalize shuts it down): a fresh spawn
+        # per streamed chunk would pay worker startup dozens of times per
+        # split and could dominate the counting it parallelizes.
+        ex = acc.get("_count_pool")
+        if ex is None:
+            from concurrent.futures import ProcessPoolExecutor
+
+            ex = acc["_count_pool"] = ProcessPoolExecutor(
+                max_workers=workers, initializer=_count_init,
+                initargs=(lowercase,),
+            )
+        chunks = [c for c in np.array_split(np.asarray(col, dtype=object),
+                                            workers * 4) if len(c)]
+        for part in ex.map(_count_chunk_py, chunks):
+            for tok, n in part.items():
+                counts[tok] = counts.get(tok, 0) + n
+        return
+    count_py(col)
+
+
+# Worker-process state for pool-parallel vocab counting (mirrors _tok_init/
+# _tok_chunk on the apply side).
+_COUNT_LOWERCASE = True
+
+
+def _count_init(lowercase: bool) -> None:
+    global _COUNT_LOWERCASE
+    _COUNT_LOWERCASE = lowercase
+
+
+def _count_chunk_py(rows) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for text in rows:
+        for tok in _pretokenize(text, _COUNT_LOWERCASE):
+            out[tok] = out.get(tok, 0) + 1
+    return out
 
 
 def _acc_finalize(node: Node, acc: Dict[str, Any]) -> Dict[str, Any]:
@@ -655,6 +815,16 @@ def _acc_finalize(node: Node, acc: Dict[str, Any]) -> Dict[str, Any]:
         return {"boundaries": np.unique(boundaries)}
     if node.op == "tokenize":
         counts = acc["counts"]
+        native = acc.get("_native_counter")
+        if native is not None:
+            # Drain the C++ hash map once; merge with the Python-side counts
+            # from any non-ASCII rows.
+            for tok, n in native.counts().items():
+                counts[tok] = counts.get(tok, 0) + n
+            acc["_native_counter"] = None
+        pool = acc.pop("_count_pool", None)
+        if pool is not None:
+            pool.shutdown()
         # descending frequency, then lexical — deterministic
         terms = sorted(counts, key=lambda t: (-counts[t], t))
         budget = max(0, int(p.get("vocab_size", 8000)) - len(SPECIAL_TOKENS))

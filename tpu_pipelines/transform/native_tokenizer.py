@@ -69,8 +69,36 @@ def _load_library():
             ctypes.c_int32,
             np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
         ]
+        lib.tok_counter_create.restype = ctypes.c_void_p
+        lib.tok_counter_create.argtypes = [ctypes.c_int]
+        lib.tok_counter_destroy.argtypes = [ctypes.c_void_p]
+        lib.tok_counter_add.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ]
+        lib.tok_counter_add_ucs4.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ]
+        lib.tok_counter_serialize.restype = ctypes.c_int64
+        lib.tok_counter_serialize.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+        ]
         _lib = lib
         return _lib
+
+
+def _pack_rows(rows: List[bytes]):
+    """(data, offsets_ptr, n) for the concatenated-rows C ABI."""
+    n = len(rows)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    if n:
+        lens = np.fromiter((len(r) for r in rows), np.int64, count=n)
+        np.cumsum(lens, out=offsets[1:])
+    return (
+        b"".join(rows),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+    )
 
 
 class NativeTokenizer:
@@ -96,15 +124,67 @@ class NativeTokenizer:
         out = np.zeros((n, max_len), dtype=np.int32)
         if not n:
             return out
-        offsets = np.zeros(n + 1, dtype=np.int64)
-        lens = np.fromiter((len(r) for r in rows), np.int64, count=n)
-        np.cumsum(lens, out=offsets[1:])
-        data = b"".join(rows)
-        self._lib.tok_encode_batch(
-            self._handle, data,
-            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-            n, max_len, out,
+        data, offsets_ptr, n = _pack_rows(rows)
+        self._lib.tok_encode_batch(self._handle, data, offsets_ptr, n,
+                                   max_len, out)
+        return out
+
+
+class NativeTokenCounter:
+    """Streaming pretoken counter over ASCII rows (the vocab-build side).
+
+    The analysis-pass twin of NativeTokenizer: same C++ pretokenizer, but
+    accumulating ``{token: count}`` across ``add_ascii_rows`` calls instead
+    of encoding against a vocab.  ``counts()`` drains the C++ hash map once
+    at finalize time — tokens never cross the FFI boundary per row.
+    """
+
+    def __init__(self, lowercase: bool):
+        lib = _load_library()
+        if lib is None:
+            raise RuntimeError("native tokenizer library unavailable")
+        self._lib = lib
+        self._handle = lib.tok_counter_create(1 if lowercase else 0)
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.tok_counter_destroy(handle)
+            self._handle = None
+
+    def add_ascii_rows(self, rows: List[bytes]) -> None:
+        if not rows:
+            return
+        data, offsets_ptr, n = _pack_rows(rows)
+        self._lib.tok_counter_add(self._handle, data, offsets_ptr, n)
+
+    def add_unicode_array(self, strs: np.ndarray) -> bool:
+        """Count a numpy ``U<width>``-dtype array directly from its UCS4
+        buffer — no encode pass at all.  Returns False (nothing counted)
+        when any code point is non-ASCII; the caller falls back to per-row
+        routing.  One vectorized max() is the entire validity check."""
+        if strs.size == 0 or strs.dtype.itemsize == 0:
+            return True
+        strs = np.ascontiguousarray(strs)
+        codes = strs.view(np.uint32)
+        if int(codes.max(initial=0)) >= 128:
+            return False
+        self._lib.tok_counter_add_ucs4(
+            self._handle, strs.ctypes.data, strs.size,
+            strs.dtype.itemsize // 4,
         )
+        return True
+
+    def counts(self) -> Dict[str, int]:
+        needed = self._lib.tok_counter_serialize(self._handle, None, 0)
+        if needed <= 0:
+            return {}
+        buf = ctypes.create_string_buffer(int(needed))
+        self._lib.tok_counter_serialize(self._handle, buf, needed)
+        out: Dict[str, int] = {}
+        for line in buf.raw[:needed].decode("utf-8").splitlines():
+            term, _, cnt = line.rpartition("\t")
+            out[term] = int(cnt)
         return out
 
 

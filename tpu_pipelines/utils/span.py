@@ -1,0 +1,79 @@
+"""Span/version resolution for ExampleGen input patterns.
+
+TFX ExampleGen's span/version convention (SURVEY.md §2a ExampleGen row):
+time-partitioned data lands in numbered directories and the pipeline
+ingests the newest — ``input_path="/data/span-{SPAN}"`` resolves to the
+highest existing span (or a pinned one), and ``{VERSION}`` inside a span
+resolves the same way for re-deliveries of the same span.
+
+The local runner resolves the same pattern before content-fingerprinting
+external inputs, so a NEW span arriving at an unchanged pattern string
+invalidates the execution cache exactly like editing a named file would.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import re
+from typing import Optional, Tuple
+
+SPAN_TOKEN = "{SPAN}"
+VERSION_TOKEN = "{VERSION}"
+
+
+def has_span_pattern(path: str) -> bool:
+    return SPAN_TOKEN in path or VERSION_TOKEN in path
+
+
+def _prefix_through(path: str, token: str) -> Tuple[str, str]:
+    """Split ``path`` at the end of the path segment containing ``token``:
+    resolve tokens left-to-right, one directory level at a time, so a later
+    {VERSION} segment (not yet resolved) never reaches glob as a literal."""
+    seg_end = path.index(token) + len(token)
+    nxt = path.find("/", seg_end)
+    if nxt == -1:
+        return path, ""
+    return path[:nxt], path[nxt:]
+
+
+def _resolve_token(path: str, token: str, pinned: Optional[int]) -> Tuple[str, int]:
+    head, tail = _prefix_through(path, token)
+    regex = re.compile(
+        re.escape(head).replace(re.escape(token), r"(\d+)") + r"$"
+    )
+    if pinned is not None:
+        # Accept any digit-run equal to the pinned value, so zero-padded
+        # layouts (span-001) pin by number, not by string.
+        for cand in sorted(_glob.glob(head.replace(token, "*"))):
+            m = regex.match(cand)
+            if m and int(m.group(1)) == pinned:
+                return cand + tail, pinned
+        raise FileNotFoundError(f"no match for {path!r} with {token}={pinned}")
+    best: Optional[Tuple[int, str]] = None
+    for cand in sorted(_glob.glob(head.replace(token, "*"))):
+        m = regex.match(cand)
+        if m:
+            n = int(m.group(1))
+            if best is None or n > best[0]:
+                best = (n, cand)
+    if best is None:
+        raise FileNotFoundError(f"no spans match pattern {path!r}")
+    return best[1] + tail, best[0]
+
+
+def resolve_span_pattern(
+    path: str,
+    span: Optional[int] = None,
+    version: Optional[int] = None,
+) -> Tuple[str, Optional[int], Optional[int]]:
+    """Resolve {SPAN} (then {VERSION} within it) to a concrete path.
+
+    Returns ``(resolved_path, span, version)`` with None for absent tokens.
+    ``span``/``version`` pin specific values; None selects the highest.
+    """
+    out_span = out_version = None
+    if SPAN_TOKEN in path:
+        path, out_span = _resolve_token(path, SPAN_TOKEN, span)
+    if VERSION_TOKEN in path:
+        path, out_version = _resolve_token(path, VERSION_TOKEN, version)
+    return path, out_span, out_version
