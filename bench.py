@@ -455,14 +455,17 @@ def main() -> None:
         vs_baseline = round(value / A100_BERT_BASE_EX_PER_SEC, 4)
         mfu = bert["mfu"]
     elif taxi is not None:
+        # vs_baseline is ONLY the A100 north-star ratio; with no BERT number
+        # it must read as absent, not as taxi's (self-relative) ratio —
+        # a >=0.9 check must not pass in a round the flagship never ran.
         metric = "taxi_trainer_examples_per_sec_per_chip"
         value = taxi["examples_per_sec_per_chip"]
-        vs_baseline = taxi.get("vs_round1_self_baseline", 0.0)
+        vs_baseline = None
         mfu = None
     else:
         metric = "bench_failed"
         value = 0.0
-        vs_baseline = 0.0
+        vs_baseline = None
         mfu = None
 
     report = {

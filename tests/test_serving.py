@@ -391,6 +391,12 @@ def test_serving_manifest_emission(tmp_path):
     assert "--batching" in c["command"]
     assert "/pipeline/serving/taxi" in c["command"]
     assert c["readinessProbe"]["httpGet"]["path"] == "/v1/models/taxi"
+    # gRPC exposed alongside REST (TF Serving's 8500/8501 convention).
+    assert "--grpc-port" in c["command"]
+    port_names = {p["name"] for p in c["ports"]}
+    assert port_names == {"http", "grpc"}
+    svc_ports = {p["name"]: p["port"] for p in svc["spec"]["ports"]}
+    assert svc_ports == {"http": 8501, "grpc": 8500}
     assert c["volumeMounts"]
     assert svc["spec"]["ports"][0]["port"] == 8501
     assert dep["spec"]["selector"]["matchLabels"] == svc["spec"]["selector"]

@@ -364,6 +364,7 @@ class TPUJobRunner:
         *,
         replicas: int = 1,
         port: int = 8501,
+        grpc_port: int = 8500,
         batching: bool = True,
         on_tpu: bool = False,
     ) -> str:
@@ -372,8 +373,10 @@ class TPUJobRunner:
         §2d, §3.5).  ``model_base_dir`` is the Pusher destination (versioned
         layout) on the shared volume; the server's ``--poll-seconds`` watcher
         hot-swaps each newly pushed version, so pushing IS deploying.
-        ``on_tpu`` schedules serving pods onto TPU nodes for jitted on-chip
-        inference; default is CPU serving (the usual canary/low-QPS shape).
+        ``grpc_port`` exposes the gRPC predict surface alongside REST (TF
+        Serving's 8500/8501 convention; pass -1 for REST only).  ``on_tpu``
+        schedules serving pods onto TPU nodes for jitted on-chip inference;
+        default is CPU serving (the usual canary/low-QPS shape).
         """
         cfg = self.config
         name = k8s_name(f"{model_name}-serving")
@@ -384,13 +387,18 @@ class TPUJobRunner:
             "--base-dir", model_base_dir,
             "--port", str(port),
         ]
+        if grpc_port >= 0:
+            command += ["--grpc-port", str(grpc_port)]
         if batching:
             command.append("--batching")
+        ports = [{"containerPort": port, "name": "http"}]
+        if grpc_port >= 0:
+            ports.append({"containerPort": grpc_port, "name": "grpc"})
         container: Dict[str, Any] = {
             "name": "model-server",
             "image": cfg.image,
             "command": command,
-            "ports": [{"containerPort": port}],
+            "ports": ports,
             "readinessProbe": {
                 "httpGet": {"path": f"/v1/models/{model_name}", "port": port},
                 "initialDelaySeconds": 5,
@@ -429,7 +437,14 @@ class TPUJobRunner:
                          "labels": labels},
             "spec": {
                 "selector": labels,
-                "ports": [{"port": port, "targetPort": port}],
+                "ports": (
+                    [{"name": "http", "port": port, "targetPort": port}]
+                    + (
+                        [{"name": "grpc", "port": grpc_port,
+                          "targetPort": grpc_port}]
+                        if grpc_port >= 0 else []
+                    )
+                ),
             },
         }
         os.makedirs(cfg.output_dir, exist_ok=True)

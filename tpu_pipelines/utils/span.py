@@ -41,16 +41,21 @@ def _resolve_token(path: str, token: str, pinned: Optional[int]) -> Tuple[str, i
     regex = re.compile(
         re.escape(head).replace(re.escape(token), r"(\d+)") + r"$"
     )
+    # glob.escape the literal part so a directory named e.g. "run[1]" is
+    # matched literally, not as a glob character class; only the token
+    # becomes a wildcard.  ("{" / "}" are not glob metacharacters, so the
+    # token survives escaping verbatim.)
+    glob_pat = _glob.escape(head).replace(token, "*")
     if pinned is not None:
         # Accept any digit-run equal to the pinned value, so zero-padded
         # layouts (span-001) pin by number, not by string.
-        for cand in sorted(_glob.glob(head.replace(token, "*"))):
+        for cand in sorted(_glob.glob(glob_pat)):
             m = regex.match(cand)
             if m and int(m.group(1)) == pinned:
                 return cand + tail, pinned
         raise FileNotFoundError(f"no match for {path!r} with {token}={pinned}")
     best: Optional[Tuple[int, str]] = None
-    for cand in sorted(_glob.glob(head.replace(token, "*"))):
+    for cand in sorted(_glob.glob(glob_pat)):
         m = regex.match(cand)
         if m:
             n = int(m.group(1))
