@@ -653,11 +653,20 @@ def _acc_update(
     raise ValueError(f"unknown analyzer {node.op!r}")
 
 
-# str(value)-semantics stringify shared with the encode router (NOT
-# _stringify_column, whose int64 cast is vocab_apply's contract).
-from tpu_pipelines.transform.native_tokenizer import (  # noqa: E402
-    tokenize_stringify as _tokenize_stringify,
-)
+def _tokenize_stringify(col) -> np.ndarray:
+    """Per-element ``str(value)`` semantics as a U-dtype array — the exact
+    text the per-row Python engine tokenizes (floats keep their decimal
+    text, None becomes ""), unlike ``_stringify_column`` whose int64 cast
+    is vocab_apply's contract, not tokenize's."""
+    arr = np.asarray(col)
+    if arr.dtype == object:
+        # None pretokenizes to no tokens ("" in the Python engine);
+        # stringify would turn it into the literal "None".
+        mask = np.frompyfunc(lambda x: x is None, 1, 1)(arr).astype(bool)
+        if mask.any():
+            arr = arr.copy()
+            arr[mask] = ""
+    return np.asarray(arr.ravel(), dtype="U")
 
 
 def _split_ascii_rows(col, strs: Optional[np.ndarray] = None):

@@ -87,22 +87,6 @@ def _load_library():
         return _lib
 
 
-def tokenize_stringify(col) -> np.ndarray:
-    """Per-element ``str(value)`` semantics as a U-dtype array — the exact
-    text the per-row Python engine tokenizes (floats keep their decimal
-    text, None becomes "").  Shared by the analysis counter and the encode
-    router so both sides of the tokenizer see identical row text."""
-    arr = np.asarray(col)
-    if arr.dtype == object:
-        # None pretokenizes to no tokens ("" in the Python engine);
-        # stringify would turn it into the literal "None".
-        mask = np.frompyfunc(lambda x: x is None, 1, 1)(arr).astype(bool)
-        if mask.any():
-            arr = arr.copy()
-            arr[mask] = ""
-    return np.asarray(arr.ravel(), dtype="U")
-
-
 def _all_ascii_view(strs: np.ndarray):
     """(uint32 buffer base array, width_chars) when every code point of the
     U-dtype array is ASCII, else None — the one-vectorized-max validity
@@ -149,6 +133,11 @@ class NativeTokenizer:
 
     def encode_ascii_rows(self, rows: List[bytes], max_len: int) -> np.ndarray:
         """[len(rows), max_len] int32 ids for pre-validated ASCII rows."""
+        if max_len < 2:
+            # The C kernel's budget arithmetic ((size_t)max_len - 1) needs
+            # room for [CLS] + [SEP]; anything below 2 would underflow into
+            # an out-of-bounds write.  No real tokenize config is this small.
+            raise ValueError(f"max_len must be >= 2, got {max_len}")
         n = len(rows)
         out = np.zeros((n, max_len), dtype=np.int32)
         if not n:
