@@ -306,6 +306,52 @@ def bench_taxi(smoke: bool) -> dict:
     return out
 
 
+def bench_pipeline_e2e(smoke: bool) -> dict:
+    """End-to-end pipeline wall-clock — the second BASELINE metric
+    ("TFX Trainer examples/sec/chip; end-to-end pipeline wall-clock").
+
+    Runs the canonical taxi DAG (CsvExampleGen -> Stats -> Schema ->
+    Validator -> Transform -> Trainer -> Evaluator -> InfraValidator ->
+    Pusher, examples/taxi/pipeline.py) fresh (empty pipeline home, so no
+    execution-cache hits) under LocalDagRunner, and reports total
+    wall-clock plus the per-component breakdown the metadata store records.
+    """
+    import tempfile
+
+    from tpu_pipelines.orchestration import LocalDagRunner
+    from tpu_pipelines.utils.module_loader import load_fn
+
+    module = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "examples", "taxi", "pipeline.py",
+    )
+    steps = "4" if smoke else "200"
+    saved = {k: os.environ.get(k) for k in ("TAXI_TRAIN_STEPS",)}
+    os.environ["TAXI_TRAIN_STEPS"] = steps
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            pipeline = load_fn(module, "create_pipeline")(td)
+            t0 = time.perf_counter()
+            result = LocalDagRunner().run(pipeline)
+            total = time.perf_counter() - t0
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return {
+        "pipeline": "taxi",
+        "green": result.succeeded,
+        "wall_clock_s": round(total, 2),
+        "train_steps": int(steps),
+        "nodes": {
+            nid: {"status": nr.status, "wall_s": round(nr.wall_clock_s, 2)}
+            for nid, nr in result.nodes.items()
+        },
+    }
+
+
 def bench_flash_probe(smoke: bool) -> dict:
     """Flash vs dense attention, fwd+bwd, at long sequence on this chip.
 
@@ -433,7 +479,7 @@ def _clean_err(msg: str, limit: int = 200) -> str:
         import re
 
         _ANSI = re.compile(r"\x1b\[[0-9;]*m")
-    return _ANSI.sub("", msg).splitlines()[0][:limit]
+    return (_ANSI.sub("", msg).splitlines() or [""])[0][:limit]
 
 
 TRANSIENT_MARKERS = (
@@ -500,6 +546,8 @@ def main() -> None:
         ):
             taxi = taxi2
         taxi["best_of"] = 2
+    e2e, e2e_err = run_workload("pipeline_e2e", bench_pipeline_e2e, smoke,
+                                retries=1)
     bert, bert_err = run_workload("bert", bench_bert, smoke)
     flash, flash_err = run_workload("flash_probe", bench_flash_probe, smoke,
                                     retries=1)
@@ -534,10 +582,12 @@ def main() -> None:
         "chip": chip,
         "bert": bert,
         "taxi": taxi,
+        "pipeline_e2e": e2e,
         "flash_probe": flash,
         "errors": {
             k: v for k, v in [
-                ("bert", bert_err), ("taxi", taxi_err), ("flash", flash_err),
+                ("bert", bert_err), ("taxi", taxi_err),
+                ("flash_probe", flash_err), ("pipeline_e2e", e2e_err),
             ] if v
         },
         "smoke": smoke,

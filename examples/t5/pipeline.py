@@ -11,11 +11,13 @@ import os
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 
-T5_SMALL = {"batch_size": 64, "learning_rate": 1e-3}
+T5_SMALL = {"batch_size": 64, "learning_rate": 1e-3,
+            "beam_size": 4, "max_decode_len": 32}
 T5_TINY = {
     "vocab_size": 128, "d_model": 32, "n_layers": 1, "n_heads": 2,
     "head_dim": 8, "d_ff": 32, "dropout_rate": 0.0,
     "batch_size": 8, "learning_rate": 3e-3,
+    "beam_size": 2, "max_decode_len": 8,
 }
 
 
@@ -43,6 +45,7 @@ def _ensure_data(base: str) -> str:
 
 def create_pipeline(base_dir: str = ""):
     from tpu_pipelines.components import (
+        BulkInferrer,
         CsvExampleGen,
         SchemaGen,
         StatisticsGen,
@@ -70,8 +73,18 @@ def create_pipeline(base_dir: str = ""):
         train_steps=int(os.environ.get("T5_TRAIN_STEPS", "100")),
         hyperparameters=hp,
     )
+    # Real seq2seq inference: beam-search decoding (models/t5.py) over the
+    # raw examples through the embedded transform — the BulkInferrer
+    # "generate" path, not teacher forcing.
+    inferrer = BulkInferrer(
+        examples=gen.outputs["examples"],
+        model=trainer.outputs["model"],
+        predict_method="generate",
+        data_splits=["eval"],
+        batch_size=64,
+    )
     return Pipeline(
-        "t5-seq2seq", [gen, stats, schema, transform, trainer],
+        "t5-seq2seq", [gen, stats, schema, transform, trainer, inferrer],
         pipeline_root=os.path.join(base, "root"),
         metadata_path=os.path.join(base, "metadata.sqlite"),
     )

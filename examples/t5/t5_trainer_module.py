@@ -17,6 +17,36 @@ def build_model(hyperparameters):
     return build_t5_model(hyperparameters)
 
 
+def make_generate_fn(model, params, hyperparameters):
+    """Export hook (trainer/export.py): jitted beam-search decoding over
+    transformed feature batches — the BulkInferrer predict_method="generate"
+    path.  Decode length/beam ride the exported hyperparameters."""
+    from tpu_pipelines.models.t5 import make_beam_generate
+
+    # End-of-sequence is the tokenizer's [SEP] (id 3): tft.tokenize emits
+    # "[CLS] ... [SEP]" with SPECIAL_TOKENS [PAD]=0 [UNK]=1 [CLS]=2 [SEP]=3
+    # (transform/graph.py), so trained targets terminate with 3 — NOT the
+    # upstream-T5 convention of eos=1, which here is [UNK].
+    gen = make_beam_generate(
+        model,
+        beam_size=int(hyperparameters.get("beam_size", 4)),
+        max_decode_len=int(hyperparameters.get("max_decode_len", 32)),
+        eos_id=int(hyperparameters.get("eos_id", 3)),
+    )
+
+    def fn(batch):
+        mask = (
+            jnp.asarray(batch["input_mask"], jnp.int32)
+            if "input_mask" in batch else None
+        )
+        tokens, _score = gen(
+            params, jnp.asarray(batch["inputs"], jnp.int32), mask
+        )
+        return tokens
+
+    return fn
+
+
 def apply_fn(model, params, batch):
     return model.apply({"params": params}, {
         "inputs": jnp.asarray(batch["inputs"], jnp.int32),

@@ -37,6 +37,11 @@ PREDICTIONS_FILE = "predictions"
         "passthrough_columns": Parameter(type=list, default=None),
         # Examples are raw (apply embedded transform) vs pre-transformed.
         "raw_examples": Parameter(type=bool, default=True),
+        # "forward": the model's forward pass (classification/regression).
+        # "generate": autoregressive decoding for seq2seq models — requires
+        # the exported module to define make_generate_fn (models/t5.py
+        # make_greedy_generate / make_beam_generate build the decode fn).
+        "predict_method": Parameter(type=str, default="forward"),
     },
 )
 def BulkInferrer(ctx):
@@ -50,10 +55,31 @@ def BulkInferrer(ctx):
         return {"skipped": True, "reason": "model not blessed"}
 
     loaded = load_exported_model(ctx.input("model").uri)
-    predict = (
-        loaded.predict if ctx.exec_properties["raw_examples"]
-        else loaded.predict_transformed
-    )
+    method = ctx.exec_properties["predict_method"]
+    if method == "generate":
+        if loaded.generate is None:
+            raise ValueError(
+                "predict_method='generate' but the exported module defines "
+                "no make_generate_fn(model, params, hyperparameters)"
+            )
+        if not ctx.exec_properties["raw_examples"] and loaded.transform:
+            # loaded.generate runs the embedded transform; feeding it
+            # already-transformed examples would tokenize them twice.
+            raise ValueError(
+                "predict_method='generate' consumes RAW examples (the "
+                "embedded transform is applied inside generate); wire the "
+                "ExampleGen output, not transformed_examples"
+            )
+        predict = loaded.generate
+    elif method == "forward":
+        predict = (
+            loaded.predict if ctx.exec_properties["raw_examples"]
+            else loaded.predict_transformed
+        )
+    else:
+        raise ValueError(
+            f"predict_method must be 'forward' or 'generate', got {method!r}"
+        )
     examples_uri = ctx.input("examples").uri
     splits = ctx.exec_properties["data_splits"] or examples_io.split_names(
         examples_uri

@@ -13,8 +13,9 @@ self-attention; see models/transformer.py).
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
@@ -61,7 +62,7 @@ class RelativePositionBias(nn.Module):
     max_distance: int = 128
 
     @nn.compact
-    def __call__(self, qlen: int, klen: int):
+    def __call__(self, qlen: int, klen: int, row=None):
         buckets = relative_position_buckets(
             qlen, klen, bidirectional=self.bidirectional,
             num_buckets=self.num_buckets, max_distance=self.max_distance,
@@ -71,6 +72,12 @@ class RelativePositionBias(nn.Module):
             nn.initializers.normal(stddev=1.0),
             (self.num_buckets, self.n_heads),
         )
+        if row is not None:
+            # Incremental decode: only query position ``row`` is live this
+            # step — slice its bucket row so the bias is [1, h, 1, klen].
+            buckets = jax.lax.dynamic_slice_in_dim(
+                buckets, jnp.asarray(row, jnp.int32), 1, axis=0
+            )
         # [q, k, h] -> [1, h, q, k] additive bias
         return jnp.transpose(table[buckets], (2, 0, 1))[None].astype(jnp.float32)
 
@@ -87,11 +94,23 @@ class T5Stack(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, encoded=None, kv_mask=None, enc_mask=None,
-                 deterministic: bool = True):
-        bias = RelativePositionBias(
-            n_heads=self.n_heads, bidirectional=not self.causal,
-            name="rel_pos",
-        )(x.shape[1], x.shape[1])
+                 deterministic: bool = True, decode_pos=None,
+                 max_decode_len: Optional[int] = None):
+        if decode_pos is not None:
+            # One-token decode step: bias is the single row of the full
+            # [max_decode_len, max_decode_len] relative-position matrix at
+            # this step's position; the causal structure comes from the
+            # attention cache's <=pos validity mask.
+            bias = RelativePositionBias(
+                n_heads=self.n_heads, bidirectional=not self.causal,
+                name="rel_pos",
+            )(max_decode_len, max_decode_len, row=decode_pos)
+            kv_mask = None
+        else:
+            bias = RelativePositionBias(
+                n_heads=self.n_heads, bidirectional=not self.causal,
+                name="rel_pos",
+            )(x.shape[1], x.shape[1])
         for i in range(self.n_layers):
             x = TransformerBlock(
                 n_heads=self.n_heads, head_dim=self.head_dim, d_ff=self.d_ff,
@@ -102,6 +121,7 @@ class T5Stack(nn.Module):
             )(
                 x, encoded=encoded, kv_mask=kv_mask, enc_mask=enc_mask,
                 self_bias=bias, deterministic=deterministic,
+                decode_pos=decode_pos, max_decode_len=max_decode_len,
             )
         return nn.RMSNorm(dtype=self.dtype, name="final_norm")(x)
 
@@ -141,11 +161,13 @@ class T5(nn.Module):
         return self.encoder(x, kv_mask=input_mask, deterministic=deterministic)
 
     def decode(self, decoder_input_ids, encoded, *, target_mask=None,
-               enc_mask=None, deterministic=True):
+               enc_mask=None, deterministic=True, decode_pos=None,
+               max_decode_len=None):
         y = self.shared(jnp.asarray(decoder_input_ids, jnp.int32))
         y = self.decoder(
             y, encoded=encoded, kv_mask=target_mask, enc_mask=enc_mask,
-            deterministic=deterministic,
+            deterministic=deterministic, decode_pos=decode_pos,
+            max_decode_len=max_decode_len,
         )
         # tied embedding as the output projection, T5's 1/sqrt(d) scaling;
         # logits in float32 for a stable softmax loss
@@ -202,3 +224,209 @@ def t5_partition_rules():
     return list(TRANSFORMER_PARTITION_RULES) + [
         (r"rel_pos/rel_embedding", P(None, "model")),
     ]
+
+
+# ---------------------------------------------------------------------------
+# Autoregressive generation (the seq2seq inference path).
+#
+# The reference's BulkInferrer/serving story for seq2seq needs real decoding,
+# not teacher forcing.  TPU-first shape discipline: the whole decode is ONE
+# jitted computation — encoder forward, then a lax.scan over decode steps,
+# each step a single-token decoder pass against the static-shape KV cache
+# (models/transformer.py decode path).  No growing arrays, no host round
+# trips per token; EOS handling is masking, not control flow.
+# ---------------------------------------------------------------------------
+
+
+def _decode_one(model, params, cache, tok, encoded, enc_mask, pos,
+                max_decode_len: int):
+    """One single-token decoder pass; returns (new_cache, logits [b, V])."""
+    variables = {"params": params}
+    if cache is not None:
+        variables["cache"] = cache
+    logits, mut = model.apply(
+        variables, tok[:, None], encoded, enc_mask=enc_mask,
+        decode_pos=pos, max_decode_len=max_decode_len,
+        method=T5.decode, mutable=["cache"],
+    )
+    return mut["cache"], logits[:, 0]
+
+
+def make_greedy_generate(
+    model: T5,
+    *,
+    max_decode_len: int = 32,
+    eos_id: int = 1,
+    pad_id: int = 0,
+    temperature: float = 0.0,
+):
+    """Build a jitted ``fn(params, inputs, input_mask=None, rng=None) ->
+    (tokens [b, max_decode_len], done [b])``.
+
+    ``temperature == 0`` is greedy argmax; ``> 0`` samples from the scaled
+    softmax (``rng`` required).  Sequences emit EOS then pad; ``done`` marks
+    rows that finished within the budget.  The T5 shift-right convention
+    (BOS = pad = 0) starts the decoder.
+    """
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+
+    def pick(logits, rng):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            rng, logits / jnp.asarray(temperature, logits.dtype), axis=-1
+        ).astype(jnp.int32)
+
+    def fn(params, inputs, input_mask=None, rng=None):
+        if temperature > 0.0 and rng is None:
+            raise ValueError("sampling (temperature > 0) requires rng")
+        if rng is None:
+            rng = jax.random.key(0)
+        encoded = model.apply(
+            {"params": params}, inputs, input_mask, method=T5.encode
+        )
+        b = inputs.shape[0]
+        bos = jnp.full((b,), pad_id, jnp.int32)
+
+        # Step 0 runs outside the scan: its mutable apply CREATES the cache
+        # collection, so the scan carry has a fixed structure.
+        rng, r0 = jax.random.split(rng)
+        cache, logits0 = _decode_one(
+            model, params, None, bos, encoded, input_mask, 0, max_decode_len
+        )
+        tok0 = pick(logits0, r0)
+        finished0 = tok0 == eos_id
+
+        def step(carry, t):
+            cache, tok, finished, rng = carry
+            rng, r = jax.random.split(rng)
+            cache, logits = _decode_one(
+                model, params, cache, tok, encoded, input_mask, t,
+                max_decode_len,
+            )
+            nxt = jnp.where(finished, pad_id, pick(logits, r))
+            return (cache, nxt, finished | (nxt == eos_id), rng), nxt
+
+        (_, _, finished, _), rest = jax.lax.scan(
+            step, (cache, tok0, finished0, rng),
+            jnp.arange(1, max_decode_len),
+        )
+        tokens = jnp.concatenate([tok0[:, None], rest.T], axis=1)
+        return tokens, finished
+
+    return jax.jit(fn)
+
+
+def make_beam_generate(
+    model: T5,
+    *,
+    beam_size: int = 4,
+    max_decode_len: int = 32,
+    eos_id: int = 1,
+    pad_id: int = 0,
+    length_alpha: float = 0.6,
+):
+    """Build a jitted beam search ``fn(params, inputs, input_mask=None) ->
+    (tokens [b, max_decode_len], score [b])``.
+
+    Freeze-in-place beams: a finished beam may only emit pad at zero added
+    log-prob, so its cumulative score is frozen while it stays a candidate —
+    one topk over ``beam_size * vocab`` per step, no separate alive/finished
+    sets.  Final selection maximizes ``logp / ((5 + len) / 6) ** alpha``
+    (the GNMT length penalty).  Encoder runs once; beams share it via a
+    flat ``batch * beam`` layout, and each step reorders the KV cache with
+    one gather.
+    """
+
+    def fn(params, inputs, input_mask=None):
+        b, k = inputs.shape[0], beam_size
+        encoded = model.apply(
+            {"params": params}, inputs, input_mask, method=T5.encode
+        )
+        # Flat [b*k, ...] layout: beam j of row i lives at i*k + j.
+        flat_encoded = jnp.repeat(encoded, k, axis=0)
+        flat_enc_mask = (
+            None if input_mask is None else jnp.repeat(input_mask, k, axis=0)
+        )
+
+        def reorder(tree, beam_idx):
+            """Gather beam rows ([b, k] indices into the beam axis)."""
+            def leaf(x):
+                y = x.reshape(b, k, *x.shape[1:])
+                idx = beam_idx.reshape(
+                    b, k, *([1] * (y.ndim - 2))
+                ).astype(jnp.int32)
+                return jnp.take_along_axis(
+                    y, jnp.broadcast_to(idx, (b, k, *y.shape[2:])), axis=1
+                ).reshape(x.shape)
+            return jax.tree_util.tree_map(leaf, tree)
+
+        bos = jnp.full((b * k,), pad_id, jnp.int32)
+        cache, logits0 = _decode_one(
+            model, params, None, bos, flat_encoded, flat_enc_mask, 0,
+            max_decode_len,
+        )
+        vocab = logits0.shape[-1]
+        logprobs0 = jax.nn.log_softmax(
+            logits0.astype(jnp.float32)
+        ).reshape(b, k, vocab)
+        # All beams start identical: only beam 0 is live at step 0, so the
+        # first topk selects k DISTINCT first tokens instead of k copies.
+        init_live = jnp.where(
+            jnp.arange(k) == 0, 0.0, -jnp.inf
+        )[None, :, None]
+        top0, idx0 = jax.lax.top_k(
+            (logprobs0 + init_live).reshape(b, k * vocab), k
+        )
+        tok0 = (idx0 % vocab).astype(jnp.int32)
+        cache = reorder(cache, idx0 // vocab)
+        logp = top0                                     # [b, k]
+        finished = tok0 == eos_id
+        lengths = jnp.ones((b, k), jnp.int32)
+        tokens = jnp.full((b, k, max_decode_len), pad_id, jnp.int32)
+        tokens = tokens.at[:, :, 0].set(tok0)
+
+        neg_inf = jnp.float32(-1e30)
+        pad_only = jnp.where(
+            jnp.arange(vocab) == pad_id, 0.0, neg_inf
+        )[None, None, :]                                # finished: pad, +0
+
+        def step(carry, t):
+            cache, tok, logp, lengths, finished, tokens = carry
+            cache, logits = _decode_one(
+                model, params, cache, tok.reshape(b * k), flat_encoded,
+                flat_enc_mask, t, max_decode_len,
+            )
+            lp = jax.nn.log_softmax(
+                logits.astype(jnp.float32)
+            ).reshape(b, k, vocab)
+            cand = logp[:, :, None] + jnp.where(
+                finished[:, :, None], pad_only, lp
+            )
+            top, idx = jax.lax.top_k(cand.reshape(b, k * vocab), k)
+            beam_idx = idx // vocab
+            nxt = (idx % vocab).astype(jnp.int32)
+            cache = reorder(cache, beam_idx)
+            take = lambda a: jnp.take_along_axis(a, beam_idx, axis=1)
+            was_finished = take(finished)
+            lengths = take(lengths) + jnp.where(was_finished, 0, 1)
+            finished = was_finished | (nxt == eos_id)
+            tokens = jnp.take_along_axis(
+                tokens, beam_idx[:, :, None], axis=1
+            ).at[:, :, t].set(jnp.where(was_finished, pad_id, nxt))
+            return (cache, nxt, top, lengths, finished, tokens), None
+
+        (_, _, logp, lengths, _, tokens), _ = jax.lax.scan(
+            step, (cache, tok0, logp, lengths, finished, tokens),
+            jnp.arange(1, max_decode_len),
+        )
+        penalty = ((5.0 + lengths.astype(jnp.float32)) / 6.0) ** length_alpha
+        score = logp / penalty                          # [b, k]
+        best = jnp.argmax(score, axis=1)
+        out = jnp.take_along_axis(
+            tokens, best[:, None, None], axis=1
+        )[:, 0]
+        return out, jnp.take_along_axis(score, best[:, None], axis=1)[:, 0]
+
+    return jax.jit(fn)

@@ -82,6 +82,8 @@ class MultiHeadAttention(nn.Module):
         kv_mask=None,
         bias=None,
         deterministic: bool = True,
+        decode_pos=None,
+        max_decode_len: Optional[int] = None,
     ):
         is_self = x_kv is None
         x_kv = x_q if is_self else x_kv
@@ -89,8 +91,66 @@ class MultiHeadAttention(nn.Module):
             (self.n_heads, self.head_dim), axis=-1, dtype=self.dtype, name=name
         )
         q = proj("query")(x_q)
+
+        if decode_pos is not None and not is_self:
+            # Cross attention during incremental decoding: the encoder output
+            # is constant across decode steps, so its K/V projections are
+            # computed exactly once — the variable initializer runs only on
+            # the cache-creating apply (step 0) and later steps reuse the
+            # stored arrays instead of re-projecting [b, enc_len, d_model]
+            # through two matmuls per layer per token.
+            cached_ek = self.variable(
+                "cache", "cached_enc_key", lambda: proj("key")(x_kv)
+            )
+            cached_ev = self.variable(
+                "cache", "cached_enc_value", lambda: proj("value")(x_kv)
+            )
+            out = dense_attention(
+                q, cached_ek.value, cached_ev.value, causal=False,
+                kv_mask=kv_mask, bias=bias,
+            )
+            return nn.DenseGeneral(
+                x_q.shape[-1], axis=(-2, -1), dtype=self.dtype, name="out"
+            )(out)
+
         k = proj("key")(x_kv)
         v = proj("value")(x_kv)
+
+        if decode_pos is not None and is_self:
+            # Incremental decoding: x_q is this step's single token
+            # ([b, 1, d_model]); K/V land in a static-shape cache at
+            # ``decode_pos`` and attention runs over the filled prefix.
+            # The cache is a flax "cache" collection created on the first
+            # mutable apply — static shapes keep the whole decode loop
+            # jit/scan-compatible (no growing arrays).
+            if max_decode_len is None:
+                raise ValueError("decode_pos requires max_decode_len")
+            b = q.shape[0]
+            cached_k = self.variable(
+                "cache", "cached_key", jnp.zeros,
+                (b, max_decode_len, self.n_heads, self.head_dim), k.dtype,
+            )
+            cached_v = self.variable(
+                "cache", "cached_value", jnp.zeros,
+                (b, max_decode_len, self.n_heads, self.head_dim), v.dtype,
+            )
+            pos = jnp.asarray(decode_pos, jnp.int32)
+            cached_k.value = jax.lax.dynamic_update_slice_in_dim(
+                cached_k.value, k, pos, axis=1
+            )
+            cached_v.value = jax.lax.dynamic_update_slice_in_dim(
+                cached_v.value, v, pos, axis=1
+            )
+            # Positions after ``pos`` are zeros (future steps): mask them.
+            valid = (jnp.arange(max_decode_len) <= pos)[None, :]
+            out = dense_attention(
+                q, cached_k.value, cached_v.value, causal=False,
+                kv_mask=jnp.broadcast_to(valid, (b, max_decode_len)),
+                bias=bias,
+            )
+            return nn.DenseGeneral(
+                x_q.shape[-1], axis=(-2, -1), dtype=self.dtype, name="out"
+            )(out)
 
         impl = self.attn_impl
         if impl == "auto":
@@ -154,6 +214,8 @@ class TransformerBlock(nn.Module):
         enc_mask=None,
         self_bias=None,
         deterministic: bool = True,
+        decode_pos=None,
+        max_decode_len: Optional[int] = None,
     ):
         mha = lambda name, causal: MultiHeadAttention(
             n_heads=self.n_heads, head_dim=self.head_dim,
@@ -170,11 +232,13 @@ class TransformerBlock(nn.Module):
             return ln(f"{name}_norm")(x + fn(x))
 
         x = sub(x, "attn", lambda h: mha("attn", self.causal)(
-            h, kv_mask=kv_mask, bias=self_bias, deterministic=deterministic
+            h, kv_mask=kv_mask, bias=self_bias, deterministic=deterministic,
+            decode_pos=decode_pos, max_decode_len=max_decode_len,
         ))
         if self.use_cross:
             x = sub(x, "cross", lambda h: mha("cross", False)(
-                h, encoded, kv_mask=enc_mask, deterministic=deterministic
+                h, encoded, kv_mask=enc_mask, deterministic=deterministic,
+                decode_pos=decode_pos,
             ))
         x = sub(x, "mlp", lambda h: MlpBlock(
             d_ff=self.d_ff, dropout_rate=self.dropout_rate,

@@ -94,6 +94,12 @@ class LoadedModel:
     transform: Optional[TransformGraph]
     predict: Callable[[Dict[str, np.ndarray]], Any]
     predict_transformed: Callable[[Dict[str, np.ndarray]], Any]
+    # Autoregressive generation (seq2seq models): present when the exported
+    # module defines ``make_generate_fn(model, params, hyperparameters)``
+    # returning a callable over TRANSFORMED feature batches (e.g. a jitted
+    # T5 beam/greedy decode from models/t5.py).  ``generate`` takes raw
+    # batches (host transform applied first); None for non-seq2seq models.
+    generate: Optional[Callable[[Dict[str, np.ndarray]], Any]] = None
     # The two halves of `predict`, exposed for exporters (serving/
     # saved_model.py): host string stage (numpy, identity when no transform)
     # and the single jitted device computation (numeric transform fused with
@@ -155,6 +161,20 @@ def load_exported_model(uri: str) -> LoadedModel:
 
         host_preprocess, device_predict = (lambda b: b), _forward
 
+    generate = None
+    gen_builder = getattr(module, "make_generate_fn", None)
+    if gen_builder is not None:
+        device_generate = gen_builder(
+            model, params, spec.get("hyperparameters", {})
+        )
+        if transform is not None:
+            _transform_dev = jax.jit(device_fn)
+
+            def generate(raw_batch: Dict[str, np.ndarray]):
+                return device_generate(_transform_dev(host_fn(raw_batch)))
+        else:
+            generate = device_generate
+
     return LoadedModel(
         params=params,
         model=model,
@@ -164,4 +184,5 @@ def load_exported_model(uri: str) -> LoadedModel:
         predict_transformed=_forward,
         host_preprocess=host_preprocess,
         device_predict=device_predict,
+        generate=generate,
     )
