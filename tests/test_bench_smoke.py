@@ -33,7 +33,7 @@ def test_bench_smoke_emits_full_report():
     assert report["smoke"] is True
     assert report["unit"] == "examples/sec/chip"
     # Every workload is either present or accounted for in errors.
-    for key in ("bert", "taxi", "pipeline_e2e", "flash_probe"):
+    for key in ("bert", "taxi", "pipeline_e2e", "flash_probe", "t5_decode"):
         assert report.get(key) is not None or key in report["errors"], (
             key, report.get("errors")
         )

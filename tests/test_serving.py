@@ -502,3 +502,82 @@ def test_infra_validator_grpc_canary(tmp_path):
         np.testing.assert_allclose(preds, [[2.0, 0.0]])
     finally:
         predict.close()
+
+
+def _seq2seq_module(tmp_path):
+    mod = tmp_path / "toy_seq2seq.py"
+    mod.write_text(
+        "import jax.numpy as jnp\n"
+        "from tpu_pipelines.models.t5 import T5, make_greedy_generate\n"
+        "HP = dict(vocab_size=32, d_model=8, n_layers=1, n_heads=2,\n"
+        "          head_dim=4, d_ff=16, dropout_rate=0.0, dtype=jnp.float32)\n"
+        "def build_model(hp):\n"
+        "    return T5(**HP)\n"
+        "def make_generate_fn(model, params, hyperparameters):\n"
+        "    gen = make_greedy_generate(model, max_decode_len=5, eos_id=3)\n"
+        "    def fn(batch):\n"
+        "        tokens, _ = gen(params, jnp.asarray(batch['inputs'],\n"
+        "                                            jnp.int32))\n"
+        "        return tokens\n"
+        "    return fn\n"
+    )
+    return str(mod)
+
+
+def test_server_generate_endpoint(tmp_path):
+    """Seq2seq :generate route: decodes token sequences; :predict-only
+    models answer 400 with a clear error."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_pipelines.models.t5 import T5
+    from tpu_pipelines.serving import ModelServer
+
+    module = _seq2seq_module(tmp_path)
+    model = T5(vocab_size=32, d_model=8, n_layers=1, n_heads=2, head_dim=4,
+               d_ff=16, dropout_rate=0.0, dtype=jnp.float32)
+    params = model.init(
+        jax.random.key(0),
+        {"inputs": np.zeros((1, 4), np.int32),
+         "targets": np.zeros((1, 3), np.int32)},
+    )["params"]
+    export_model(
+        serving_model_dir=str(tmp_path / "s2s" / "1"),
+        params=params, module_file=module,
+    )
+    server = ModelServer("s2s", str(tmp_path / "s2s"))
+    port = server.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/s2s:generate",
+            data=json.dumps(
+                {"instances": [{"inputs": [5, 9, 3, 2]},
+                               {"inputs": [7, 1, 4, 4]}]}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            out = json.load(r)
+        toks = np.asarray(out["outputs"])
+        assert toks.shape == (2, 5)
+        assert toks.dtype.kind == "i"
+    finally:
+        server.stop()
+
+    # A forward-only payload must reject :generate, not crash.
+    base = tmp_path / "served2" / "toy"
+    _export(tmp_path, "served2/toy/1")
+    server2 = ModelServer("toy", str(base))
+    port2 = server2.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port2}/v1/models/toy:generate",
+            data=json.dumps({"instances": [{"x": [1.0, 0.0, 0.0]}]}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        assert exc.value.code == 400
+        assert "generate" in json.load(exc.value)["error"]
+    finally:
+        server2.stop()

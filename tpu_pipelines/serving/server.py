@@ -7,6 +7,8 @@ jitted function — trainer/export.py), and answers TF-Serving-style REST:
 
     GET  /v1/models/<name>            -> version status
     POST /v1/models/<name>:predict    -> {"predictions": [...]}
+    POST /v1/models/<name>:generate   -> {"outputs": [[token ids], ...]}
+         (seq2seq payloads exported with a make_generate_fn hook)
          body: {"instances": [{feature: value, ...}, ...]}
          or    {"inputs": {feature: [values...], ...}}
 
@@ -136,21 +138,54 @@ class ModelServer:
             return self._batcher.submit(batch, n_rows)
         return np.asarray(self._predict_fn()(batch))
 
-    def predict(self, payload: Dict[str, Any]) -> Dict[str, Any]:
-        """TF-Serving REST semantics: 'instances' (row) or 'inputs' (column)."""
+    @staticmethod
+    def _payload_to_batch(payload: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """TF-Serving REST semantics: 'instances' (row) or 'inputs' (column);
+        None for an empty instances list."""
         if "instances" in payload:
             rows = payload["instances"]
             if not rows:
-                return {"predictions": []}
-            batch = {
+                return None
+            return {
                 k: np.asarray([r[k] for r in rows])
                 for k in rows[0]
             }
-        elif "inputs" in payload:
-            batch = {k: np.asarray(v) for k, v in payload["inputs"].items()}
-        else:
-            raise ValueError("request needs 'instances' or 'inputs'")
+        if "inputs" in payload:
+            return {k: np.asarray(v) for k, v in payload["inputs"].items()}
+        raise ValueError("request needs 'instances' or 'inputs'")
+
+    def predict(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        batch = self._payload_to_batch(payload)
+        if batch is None:
+            return {"predictions": []}
         return {"predictions": self.predict_batch(batch).tolist()}
+
+    def generate(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """Seq2seq decoding (models exported with a make_generate_fn hook —
+        trainer/export.py): returns generated token-id sequences.  Decoding
+        batches whole requests (the beam/greedy fn is itself batched), so
+        this path bypasses the forward-pass micro-batcher."""
+        with self._lock:
+            loaded = self._loaded
+        if loaded is None:
+            raise RuntimeError("no model loaded")
+        if loaded.generate is None:
+            raise ValueError(
+                f"model {self.model_name!r} does not support :generate "
+                "(exported module has no make_generate_fn)"
+            )
+        if not self.raw and loaded.transform is not None:
+            # Same hazard bulk_inferrer.py rejects: loaded.generate applies
+            # the embedded transform, so a raw=False server (callers send
+            # already-materialized features) would double-tokenize.
+            raise ValueError(
+                ":generate requires raw features (server is raw=False but "
+                "the payload embeds a transform)"
+            )
+        batch = self._payload_to_batch(payload)
+        if batch is None:
+            return {"outputs": []}
+        return {"outputs": np.asarray(loaded.generate(batch)).tolist()}
 
     # ---------------------------------------------------------------- HTTP
 
@@ -187,13 +222,18 @@ class ModelServer:
                     self._reply(404, {"error": f"unknown path {self.path}"})
 
             def do_POST(self):
-                if self.path != f"/v1/models/{server.model_name}:predict":
+                routes = {
+                    f"/v1/models/{server.model_name}:predict": server.predict,
+                    f"/v1/models/{server.model_name}:generate": server.generate,
+                }
+                handler = routes.get(self.path)
+                if handler is None:
                     self._reply(404, {"error": f"unknown path {self.path}"})
                     return
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     payload = json.loads(self.rfile.read(n) or b"{}")
-                    self._reply(200, server.predict(payload))
+                    self._reply(200, handler(payload))
                 except Exception as e:
                     self._reply(400, {"error": f"{type(e).__name__}: {e}"})
 
