@@ -331,7 +331,8 @@ def bench_t5_decode(smoke: bool) -> dict:
 
     model = build_t5_model(hp)
     rng = np.random.default_rng(0)
-    inputs = rng.integers(2, 100, size=(batch, enc_len)).astype(np.int32)
+    hi = min(100, int(hp.get("vocab_size", 32128)))
+    inputs = rng.integers(2, hi, size=(batch, enc_len)).astype(np.int32)
     params = model.init(
         jax.random.key(0),
         {"inputs": inputs, "targets": np.ones((batch, 4), np.int32)},

@@ -1,0 +1,239 @@
+"""TFRecord / ArrayRecord ingest parity.
+
+Fixtures are written by the REAL upstream writers (tf.io.TFRecordWriter +
+tf.train.Example, array_record's ArrayRecordWriter), then parsed by the
+framework's TF-free reader (data/record_io.py) — so these tests assert wire
+compatibility with the reference's actual output format, not a round trip
+through our own encoder.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from tpu_pipelines.data import record_io
+
+tf = pytest.importorskip("tensorflow")
+
+
+def _make_example(i: int) -> bytes:
+    feat = {
+        "name": tf.train.Feature(
+            bytes_list=tf.train.BytesList(value=[f"row-{i}".encode()])
+        ),
+        "fare": tf.train.Feature(
+            float_list=tf.train.FloatList(value=[float(i) * 1.5])
+        ),
+        "count": tf.train.Feature(
+            int64_list=tf.train.Int64List(value=[i * 1000])
+        ),
+        "vec": tf.train.Feature(
+            float_list=tf.train.FloatList(value=[float(i), float(-i), 0.25])
+        ),
+        "neg": tf.train.Feature(
+            int64_list=tf.train.Int64List(value=[-i - 1])
+        ),
+    }
+    return tf.train.Example(
+        features=tf.train.Features(feature=feat)
+    ).SerializeToString()
+
+
+def _write_tfrecord(path: str, n: int, start: int = 0) -> None:
+    with tf.io.TFRecordWriter(path) as w:
+        for i in range(start, start + n):
+            w.write(_make_example(i))
+
+
+def _write_array_record(path: str, n: int) -> None:
+    from array_record.python.array_record_module import ArrayRecordWriter
+
+    w = ArrayRecordWriter(path, "group_size:4")
+    for i in range(n):
+        w.write(_make_example(i))
+    w.close()
+
+
+def test_parse_tf_example_fields():
+    parsed = record_io.parse_tf_example(_make_example(7))
+    assert list(parsed["name"]) == [b"row-7"]
+    np.testing.assert_allclose(parsed["fare"], [10.5])
+    assert parsed["count"].tolist() == [7000]
+    np.testing.assert_allclose(parsed["vec"], [7.0, -7.0, 0.25])
+    assert parsed["neg"].tolist() == [-8]
+
+
+def test_tfrecord_batches_match_tf_parse(tmp_path):
+    path = str(tmp_path / "data.tfrecord")
+    _write_tfrecord(path, 100)
+    batches = list(record_io.tf_example_batches(
+        record_io.iter_tfrecords(path), batch_rows=32
+    ))
+    assert sum(b.num_rows for b in batches) == 100
+    assert [b.num_rows for b in batches] == [32, 32, 32, 4]
+    table = pa.Table.from_batches(batches)
+    assert table.column("name").to_pylist()[3] == "row-3"
+    np.testing.assert_allclose(
+        table.column("fare").to_numpy(), np.arange(100) * 1.5
+    )
+    assert table.column("count").to_pylist() == [i * 1000 for i in range(100)]
+    vec = table.column("vec").to_pylist()
+    assert vec[5] == [5.0, -5.0, 0.25]
+    assert table.column("neg").to_pylist() == [-i - 1 for i in range(100)]
+
+
+def test_array_record_reader(tmp_path):
+    path = str(tmp_path / "data.array_record")
+    _write_array_record(path, 50)
+    recs = list(record_io.iter_array_records(path))
+    assert len(recs) == 50
+    parsed = record_io.parse_tf_example(recs[9])
+    assert parsed["count"].tolist() == [9000]
+
+
+def test_ragged_features_rejected(tmp_path):
+    path = str(tmp_path / "ragged.tfrecord")
+    with tf.io.TFRecordWriter(path) as w:
+        for n_vals in (2, 3):
+            feat = {"x": tf.train.Feature(
+                float_list=tf.train.FloatList(value=[0.0] * n_vals)
+            )}
+            w.write(tf.train.Example(
+                features=tf.train.Features(feature=feat)
+            ).SerializeToString())
+    with pytest.raises(ValueError, match="ragged"):
+        list(record_io.tf_example_batches(record_io.iter_tfrecords(path)))
+
+
+def _run_import(tmp_path, input_path, **params):
+    from tpu_pipelines.components import ImportExampleGen
+    from tpu_pipelines.dsl.pipeline import Pipeline
+    from tpu_pipelines.orchestration import LocalDagRunner
+
+    gen = ImportExampleGen(input_path=input_path, **params)
+    pipe = Pipeline(
+        "record-import", [gen],
+        pipeline_root=str(tmp_path / "root"),
+        metadata_path=str(tmp_path / "md.sqlite"),
+    )
+    result = LocalDagRunner().run(pipe)
+    assert result.succeeded, result.nodes
+    (art,) = result.outputs_of("ImportExampleGen", "examples")
+    return art
+
+
+def test_import_single_tfrecord_hash_splits(tmp_path):
+    from tpu_pipelines.data import examples_io
+
+    path = str(tmp_path / "all.tfrecord")
+    _write_tfrecord(path, 200)
+    art = _run_import(tmp_path, path, splits={"train": 3, "eval": 1})
+    names = sorted(art.properties["split_names"])
+    assert names == ["eval", "train"]
+    counts = art.properties["split_counts"]
+    assert counts["train"] + counts["eval"] == 200
+    assert counts["train"] > counts["eval"] > 0
+    table = examples_io.read_split_table(art.uri, "train")
+    assert set(table.column_names) == {"name", "fare", "count", "vec", "neg"}
+
+
+def test_import_split_record_files(tmp_path):
+    from tpu_pipelines.data import examples_io
+
+    d = tmp_path / "records"
+    d.mkdir()
+    _write_tfrecord(str(d / "train.tfrecord"), 30)
+    _write_tfrecord(str(d / "eval.tfrecord"), 10, start=30)
+    art = _run_import(tmp_path, str(d))
+    assert art.properties["split_counts"] == {"train": 30, "eval": 10}
+    eval_names = examples_io.read_split_table(
+        art.uri, "eval"
+    ).column("name").to_pylist()
+    assert eval_names[0] == "row-30"
+
+
+def test_import_split_array_record_files(tmp_path):
+    d = tmp_path / "arecords"
+    d.mkdir()
+    _write_array_record(str(d / "train.array_record"), 12)
+    art = _run_import(tmp_path, str(d))
+    assert art.properties["split_counts"] == {"train": 12}
+
+
+def test_mixed_formats_rejected(tmp_path):
+    d = tmp_path / "mixed"
+    d.mkdir()
+    _write_tfrecord(str(d / "train.tfrecord"), 2)
+    import pyarrow.parquet as pq
+
+    pq.write_table(pa.table({"x": [1]}), str(d / "eval.parquet"))
+    from tpu_pipelines.components import ImportExampleGen
+    from tpu_pipelines.dsl.pipeline import Pipeline
+    from tpu_pipelines.orchestration import LocalDagRunner
+
+    from tpu_pipelines.orchestration.local_runner import PipelineRunError
+
+    gen = ImportExampleGen(input_path=str(d))
+    pipe = Pipeline(
+        "mixed-import", [gen],
+        pipeline_root=str(tmp_path / "root"),
+        metadata_path=str(tmp_path / "md.sqlite"),
+    )
+    with pytest.raises(PipelineRunError, match="mixed"):
+        LocalDagRunner().run(pipe)
+
+
+def test_duplicate_split_stems_rejected(tmp_path):
+    from tpu_pipelines.components import ImportExampleGen
+    from tpu_pipelines.dsl.pipeline import Pipeline
+    from tpu_pipelines.orchestration import LocalDagRunner
+    from tpu_pipelines.orchestration.local_runner import PipelineRunError
+
+    d = tmp_path / "dup"
+    d.mkdir()
+    _write_tfrecord(str(d / "train.tfrecord"), 2)
+    _write_tfrecord(str(d / "train.tfrecords"), 2)
+    gen = ImportExampleGen(input_path=str(d))
+    pipe = Pipeline(
+        "dup-import", [gen],
+        pipeline_root=str(tmp_path / "root"),
+        metadata_path=str(tmp_path / "md.sqlite"),
+    )
+    with pytest.raises(PipelineRunError, match="same split name"):
+        LocalDagRunner().run(pipe)
+
+
+def test_bytes_type_pinned_by_first_chunk(tmp_path):
+    """A bytes feature that flips utf8-ness after the first chunk raises a
+    first-chunk-pinning error (CSV-style), not a Parquet writer crash."""
+    path = str(tmp_path / "flip.tfrecord")
+    with tf.io.TFRecordWriter(path) as w:
+        for i in range(4):
+            payload = b"\xff\xfe" if i >= 2 else f"ok-{i}".encode()
+            feat = {"blob": tf.train.Feature(
+                bytes_list=tf.train.BytesList(value=[payload])
+            )}
+            w.write(tf.train.Example(
+                features=tf.train.Features(feature=feat)
+            ).SerializeToString())
+    with pytest.raises(ValueError, match="pinned by the first chunk"):
+        list(record_io.tf_example_batches(
+            record_io.iter_tfrecords(path), batch_rows=2
+        ))
+    # The reverse order (binary first) pins binary and ingests fine.
+    path2 = str(tmp_path / "flip2.tfrecord")
+    with tf.io.TFRecordWriter(path2) as w:
+        for i in range(4):
+            payload = b"\xff\xfe" if i < 2 else f"ok-{i}".encode()
+            feat = {"blob": tf.train.Feature(
+                bytes_list=tf.train.BytesList(value=[payload])
+            )}
+            w.write(tf.train.Example(
+                features=tf.train.Features(feature=feat)
+            ).SerializeToString())
+    batches = list(record_io.tf_example_batches(
+        record_io.iter_tfrecords(path2), batch_rows=2
+    ))
+    assert all(b.schema.field("blob").type == pa.binary() for b in batches)

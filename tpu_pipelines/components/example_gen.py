@@ -205,12 +205,79 @@ def CsvExampleGen(ctx):
     return props
 
 
+RECORD_SUFFIXES = (".tfrecord", ".tfrecords", ".array_record", ".arrayrecord")
+
+
+def _record_reader(path: str):
+    from tpu_pipelines.data import record_io
+
+    if path.endswith((".array_record", ".arrayrecord")):
+        return record_io.iter_array_records(path)
+    return record_io.iter_tfrecords(path)
+
+
+def _import_record_files(files, out_uri: str, splits: Dict[str, int],
+                         per_split: bool) -> Dict[str, int]:
+    """tf.train.Example record files → Parquet splits, O(chunk) memory.
+
+    ``per_split=True``: each file IS a split (``<split>.tfrecord``).
+    Otherwise all files concatenate and hash-split row-by-row, identically
+    to the CSV path.
+    """
+    from tpu_pipelines.data import record_io
+
+    counts: Dict[str, int] = {}
+    if per_split:
+        stems = [os.path.splitext(os.path.basename(f))[0] for f in files]
+        dupes = sorted({s for s in stems if stems.count(s) > 1})
+        if dupes:
+            raise ValueError(
+                f"multiple record files map to the same split name(s) "
+                f"{dupes} (e.g. train.tfrecord + train.tfrecords); "
+                "one file per split"
+            )
+        for f in files:
+            split = os.path.splitext(os.path.basename(f))[0]
+            writer = None
+            counts[split] = 0
+            try:
+                for batch in record_io.tf_example_batches(_record_reader(f)):
+                    if writer is None:
+                        writer = examples_io.open_split_writer(
+                            out_uri, split, batch.schema
+                        )
+                    writer.write_table(pa.Table.from_batches([batch]))
+                    counts[split] += batch.num_rows
+            finally:
+                if writer is not None:
+                    writer.close()
+            if writer is None:
+                raise ValueError(f"record file {f!r} is empty")
+        return counts
+
+    def batches():
+        for f in files:
+            yield from record_io.tf_example_batches(_record_reader(f))
+
+    it = batches()
+    first = next(it, None)
+    if first is None:
+        raise ValueError(f"no records in {files!r}")
+
+    def chained():
+        yield first
+        yield from it
+
+    return _split_and_write_streaming(chained(), out_uri, splits, first.schema)
+
+
 @component(
     outputs={"examples": "Examples"},
     parameters={
-        # Path to a directory of <split>.parquet files OR an .npz file whose
-        # arrays are columns (MNIST-style tensors allowed: dims beyond the
-        # first are flattened into fixed-length list columns).
+        # Path to a directory of <split>.parquet (or <split>.tfrecord /
+        # <split>.array_record) files, a single record file, OR an .npz file
+        # whose arrays are columns (MNIST-style tensors allowed: dims beyond
+        # the first are flattened into fixed-length list columns).
         "input_path": Parameter(type=str, required=True),
         "splits": Parameter(type=dict, default=None),
     },
@@ -219,8 +286,13 @@ def CsvExampleGen(ctx):
 def ImportExampleGen(ctx):
     """Import already-materialized data as an Examples artifact.
 
-    Two accepted layouts:
+    Accepted layouts:
       - directory with ``<split>.parquet`` files → imported split-per-file
+      - directory with ``<split>.tfrecord``/``.array_record`` files of
+        ``tf.train.Example`` payloads → parsed split-per-file (the
+        reference's canonical ingest format, SURVEY.md §2a ExampleGen;
+        parsed TF-free by data/record_io.py)
+      - a single record file → parsed, then hash-split like CsvExampleGen
       - a single ``.npz`` → columns hash-split like CsvExampleGen
     """
     path = ctx.exec_properties["input_path"]
@@ -230,13 +302,32 @@ def ImportExampleGen(ctx):
         import pyarrow.parquet as pq
 
         files = sorted(f for f in os.listdir(path) if f.endswith(".parquet"))
-        if not files:
-            raise ValueError(f"no .parquet files under {path!r}")
+        record_files = sorted(
+            f for f in os.listdir(path) if f.endswith(RECORD_SUFFIXES)
+        )
+        if not files and not record_files:
+            raise ValueError(
+                f"no .parquet or record files under {path!r}"
+            )
+        if files and record_files:
+            raise ValueError(
+                f"mixed .parquet and record files under {path!r}; "
+                "one format per import"
+            )
+        if record_files:
+            counts = _import_record_files(
+                [os.path.join(path, f) for f in record_files],
+                out.uri, {}, per_split=True,
+            )
+            files = []
         for f in files:
             split = os.path.splitext(f)[0]
             table = pq.read_table(os.path.join(path, f))
             examples_io.write_split(out.uri, split, table)
             counts[split] = table.num_rows
+    elif path.endswith(RECORD_SUFFIXES):
+        splits = ctx.exec_properties["splits"] or dict(DEFAULT_SPLITS)
+        counts = _import_record_files([path], out.uri, splits, per_split=False)
     elif path.endswith(".npz"):
         data = np.load(path)
         arrays = {}

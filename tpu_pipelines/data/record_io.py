@@ -1,0 +1,294 @@
+"""TFRecord / ArrayRecord ingest: the reference's canonical example formats.
+
+The reference's ExampleGen family reads TFRecords of ``tf.train.Example``
+protos (SURVEY.md §2a ExampleGen row: "Ingest CSV/TFRecord/..."), and the
+TPU-era successor container is ArrayRecord (SURVEY.md §2a TPU-equiv column).
+This module reads BOTH without importing TensorFlow:
+
+  - the TFRecord container framing (length / masked-crc / payload) is a
+    stable public wire format, parsed directly;
+  - ``tf.train.Example`` is parsed with a minimal protobuf wire-format
+    decoder that is field-number compatible with the public proto
+    (Example.features=1, Features.feature=1 map, Feature oneof
+    bytes_list=1 / float_list=2 / int64_list=3, each with value=1) —
+    packed and unpacked repeated encodings both accepted;
+  - ArrayRecord files are read through the installed ``array_record``
+    bindings; their payloads are the same ``tf.train.Example`` bytes.
+
+Parsing yields pyarrow RecordBatches in bounded chunks, so ingest memory is
+O(chunk) regardless of file size (the same out-of-core contract as the
+streaming CSV path).  Scalar features become scalar columns; fixed-length
+multi-value features become fixed-size list columns; UTF-8 byte features
+decode to strings (non-UTF-8 payloads stay binary).
+
+CRC verification note: TFRecord's masked crc32c fields are SKIPPED on read
+(the reference's readers verify them; corruption here surfaces as a parse
+error instead).  This module does not write either format — the framework's
+own example container is Parquet.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+# ------------------------------------------------------------------ framing
+
+
+def iter_tfrecords(path: str) -> Iterator[bytes]:
+    """Yield raw record payloads from one TFRecord file.
+
+    Container framing per record: u64le length, u32le masked length-crc,
+    payload, u32le masked payload-crc.  CRCs are skipped (see module note).
+    """
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if not header:
+                return
+            if len(header) < 12:
+                raise ValueError(
+                    f"truncated TFRecord header in {path!r} "
+                    f"({len(header)} trailing bytes)"
+                )
+            (length,) = struct.unpack("<Q", header[:8])
+            payload = f.read(length)
+            if len(payload) < length:
+                raise ValueError(
+                    f"truncated TFRecord payload in {path!r} "
+                    f"(wanted {length}, got {len(payload)})"
+                )
+            if len(f.read(4)) < 4:
+                raise ValueError(f"truncated TFRecord footer in {path!r}")
+            yield payload
+
+
+def iter_array_records(path: str) -> Iterator[bytes]:
+    """Yield raw record payloads from one ArrayRecord file."""
+    from array_record.python.array_record_module import ArrayRecordReader
+
+    reader = ArrayRecordReader(path)
+    try:
+        n = reader.num_records()
+        # Chunked reads: bounded memory on arbitrarily large files.
+        chunk = 4096
+        for lo in range(0, n, chunk):
+            for rec in reader.read(list(range(lo, min(lo + chunk, n)))):
+                yield rec
+    finally:
+        reader.close()
+
+
+# ------------------------------------------------- tf.train.Example parsing
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long")
+
+
+def _skip_field(buf: bytes, pos: int, wire_type: int) -> int:
+    if wire_type == 0:
+        _, pos = _read_varint(buf, pos)
+        return pos
+    if wire_type == 1:
+        return pos + 8
+    if wire_type == 2:
+        n, pos = _read_varint(buf, pos)
+        return pos + n
+    if wire_type == 5:
+        return pos + 4
+    raise ValueError(f"unsupported wire type {wire_type}")
+
+
+def _iter_fields(buf: bytes) -> Iterator[Tuple[int, int, bytes, int]]:
+    """Yield (field_number, wire_type, buf, value_pos) — caller decodes."""
+    pos = 0
+    end = len(buf)
+    while pos < end:
+        key, pos = _read_varint(buf, pos)
+        yield key >> 3, key & 0x7, buf, pos
+        pos = _skip_field(buf, pos, key & 0x7)
+
+
+def _length_delimited(buf: bytes, pos: int) -> bytes:
+    n, pos = _read_varint(buf, pos)
+    return buf[pos : pos + n]
+
+
+def _decode_float_list(buf: bytes) -> np.ndarray:
+    """FloatList: repeated float value = 1 — packed or unpacked."""
+    packed: List[bytes] = []
+    singles: List[float] = []
+    for num, wt, b, pos in _iter_fields(buf):
+        if num != 1:
+            continue
+        if wt == 2:
+            packed.append(_length_delimited(b, pos))
+        elif wt == 5:
+            singles.append(struct.unpack_from("<f", b, pos)[0])
+    if packed:
+        arr = np.frombuffer(b"".join(packed), dtype="<f4")
+        if singles:
+            arr = np.concatenate([arr, np.asarray(singles, "<f4")])
+        return arr
+    return np.asarray(singles, "<f4")
+
+
+def _decode_int64_list(buf: bytes) -> np.ndarray:
+    """Int64List: repeated int64 value = 1 — packed varints or unpacked."""
+    out: List[int] = []
+    for num, wt, b, pos in _iter_fields(buf):
+        if num != 1:
+            continue
+        if wt == 2:
+            chunk = _length_delimited(b, pos)
+            p = 0
+            while p < len(chunk):
+                v, p = _read_varint(chunk, p)
+                out.append(v - (1 << 64) if v >= (1 << 63) else v)
+        elif wt == 0:
+            v, _ = _read_varint(b, pos)
+            out.append(v - (1 << 64) if v >= (1 << 63) else v)
+    return np.asarray(out, np.int64)
+
+
+def _decode_bytes_list(buf: bytes) -> List[bytes]:
+    return [
+        _length_delimited(b, pos)
+        for num, wt, b, pos in _iter_fields(buf)
+        if num == 1 and wt == 2
+    ]
+
+
+def parse_tf_example(payload: bytes) -> Dict[str, object]:
+    """tf.train.Example bytes -> {feature_name: ndarray | list[bytes]}."""
+    features: Dict[str, object] = {}
+    for num, wt, buf, pos in _iter_fields(payload):
+        if num != 1 or wt != 2:           # Example.features
+            continue
+        for fnum, fwt, fbuf, fpos in _iter_fields(_length_delimited(buf, pos)):
+            if fnum != 1 or fwt != 2:     # Features.feature map entry
+                continue
+            entry = _length_delimited(fbuf, fpos)
+            name: Optional[str] = None
+            value: object = None
+            for enum_, ewt, ebuf, epos in _iter_fields(entry):
+                if enum_ == 1 and ewt == 2:          # key
+                    name = _length_delimited(ebuf, epos).decode("utf-8")
+                elif enum_ == 2 and ewt == 2:        # value: Feature
+                    feat = _length_delimited(ebuf, epos)
+                    for knum, kwt, kbuf, kpos in _iter_fields(feat):
+                        if kwt != 2:
+                            continue
+                        body = _length_delimited(kbuf, kpos)
+                        if knum == 1:
+                            value = _decode_bytes_list(body)
+                        elif knum == 2:
+                            value = _decode_float_list(body)
+                        elif knum == 3:
+                            value = _decode_int64_list(body)
+            if name is not None and value is not None:
+                features[name] = value
+    return features
+
+
+# ------------------------------------------------------------ batch builder
+
+
+def _column(values: list, name: str,
+            bytes_types: Dict[str, pa.DataType]) -> pa.Array:
+    """Rows of a feature -> a pyarrow column.
+
+    Every row must have the same value count (scalar, or fixed-length list
+    — the reference's fixed-shape feature-spec contract).  Byte features
+    decode to UTF-8 strings when the FIRST chunk decodes, else stay binary;
+    the choice is pinned in ``bytes_types`` so every later chunk carries the
+    same schema (the same first-block pinning the streaming CSV reader
+    documents) — a later chunk that violates the pinned string type raises
+    with that context instead of crashing the Parquet writer mid-file.
+    """
+    lengths = {len(v) for v in values}
+    if len(lengths) != 1:
+        raise ValueError(
+            f"feature {name!r} is ragged (row value counts {sorted(lengths)}); "
+            "fixed-length features required — pad upstream or split columns"
+        )
+    (n,) = lengths
+    if n == 0:
+        raise ValueError(f"feature {name!r} has empty values")
+    first = values[0]
+    if isinstance(first, list):                       # bytes rows
+        flat = [b for row in values for b in row]
+        pinned = bytes_types.get(name)
+        if pinned is None:
+            try:
+                col: pa.Array = pa.array(
+                    [b.decode("utf-8") for b in flat], pa.string()
+                )
+                bytes_types[name] = pa.string()
+            except UnicodeDecodeError:
+                col = pa.array(flat, pa.binary())
+                bytes_types[name] = pa.binary()
+        elif pinned == pa.string():
+            try:
+                col = pa.array([b.decode("utf-8") for b in flat], pa.string())
+            except UnicodeDecodeError as e:
+                raise ValueError(
+                    f"feature {name!r} was typed string from the first "
+                    f"chunk but a later chunk holds non-UTF-8 bytes ({e}); "
+                    "the column type is pinned by the first chunk (like "
+                    "streaming CSV inference) — re-encode the column "
+                    "upstream or shrink batch_rows so the first chunk "
+                    "samples the binary rows"
+                ) from e
+        else:
+            col = pa.array(flat, pa.binary())
+    else:
+        col = pa.array(np.concatenate(values))
+    if n == 1:
+        return col
+    return pa.FixedSizeListArray.from_arrays(col, n)
+
+
+def tf_example_batches(
+    records: Iterable[bytes], batch_rows: int = 8192
+) -> Iterator[pa.RecordBatch]:
+    """Parse a record stream into bounded-size pyarrow RecordBatches."""
+    rows: List[Dict[str, object]] = []
+    bytes_types: Dict[str, pa.DataType] = {}
+
+    def flush() -> pa.RecordBatch:
+        names = list(rows[0])
+        for r in rows:
+            if set(r) != set(names):
+                missing = set(names) ^ set(r)
+                raise ValueError(
+                    f"inconsistent feature sets across examples: {missing}"
+                )
+        cols = {
+            name: _column([r[name] for r in rows], name, bytes_types)
+            for name in names
+        }
+        return pa.RecordBatch.from_pydict(cols)
+
+    for rec in records:
+        rows.append(parse_tf_example(rec))
+        if len(rows) >= batch_rows:
+            yield flush()
+            rows = []
+    if rows:
+        yield flush()
