@@ -48,6 +48,7 @@ def _write_tfrecord(path: str, n: int, start: int = 0) -> None:
 
 
 def _write_array_record(path: str, n: int) -> None:
+    pytest.importorskip("array_record")
     from array_record.python.array_record_module import ArrayRecordWriter
 
     w = ArrayRecordWriter(path, "group_size:4")
@@ -237,3 +238,23 @@ def test_bytes_type_pinned_by_first_chunk(tmp_path):
         record_io.iter_tfrecords(path2), batch_rows=2
     ))
     assert all(b.schema.field("blob").type == pa.binary() for b in batches)
+
+
+def test_value_count_pinned_by_first_chunk(tmp_path):
+    """A feature whose per-row value count changes BETWEEN chunks (each
+    chunk internally consistent) raises the pinning error, not a raw
+    Parquet schema mismatch."""
+    path = str(tmp_path / "shape_flip.tfrecord")
+    with tf.io.TFRecordWriter(path) as w:
+        for i in range(4):
+            n_vals = 2 if i < 2 else 3
+            feat = {"x": tf.train.Feature(
+                float_list=tf.train.FloatList(value=[float(i)] * n_vals)
+            )}
+            w.write(tf.train.Example(
+                features=tf.train.Features(feature=feat)
+            ).SerializeToString())
+    with pytest.raises(ValueError, match="pinned by the first chunk"):
+        list(record_io.tf_example_batches(
+            record_io.iter_tfrecords(path), batch_rows=2
+        ))

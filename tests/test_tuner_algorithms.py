@@ -1,0 +1,207 @@
+"""Adaptive tuner algorithms: successive halving + TPE.
+
+Algorithm-level tests drive a synthetic objective through a fake run_batch
+(no training); component-level tests run the real Tuner over the toy
+run_fn module, asserting budgets, promotion, and the published artifact.
+"""
+
+import json
+import os
+
+import pytest
+
+from tpu_pipelines.components import tuner_algorithms as ta
+
+
+def _fake_run_batch(score_fn, log=None, fail_on=()):
+    """run_batch whose 'loss' is score_fn(cand); records (n, steps) calls."""
+    def run_batch(cands, steps, first_id):
+        if log is not None:
+            log.append((len(cands), steps))
+        out = []
+        for i, c in enumerate(cands):
+            tid = first_id + i
+            if tid in fail_on:
+                out.append({"trial": tid, "hyperparameters": c,
+                            "status": "failed", "error": "boom"})
+            else:
+                out.append({
+                    "trial": tid, "hyperparameters": c, "status": "ok",
+                    "metrics": {"loss": float(score_fn(c, steps))},
+                })
+        return out
+    return run_batch
+
+
+def test_halving_promotes_and_finds_minimum():
+    space = {"x": list(range(10))}
+    log = []
+    trials, best = ta.successive_halving(
+        space,
+        run_batch=_fake_run_batch(lambda c, s: (c["x"] - 6) ** 2, log),
+        max_steps=90, n0=9, eta=3, seed=0,
+    )
+    # 3 rungs: 9 trials at small budget, 3 at medium, 1 at 90 steps.
+    assert [n for n, _ in log] == [9, 3, 1]
+    steps = [s for _, s in log]
+    assert steps[-1] == 90
+    assert steps == sorted(steps)
+    assert best["hyperparameters"]["x"] in (5, 6, 7)
+    assert best["train_steps"] == 90
+    # Every trial carries its rung + budget for the trials.json record.
+    assert all("rung" in t and "train_steps" in t for t in trials)
+
+
+def test_halving_survives_failed_trials():
+    space = {"x": list(range(8))}
+    trials, best = ta.successive_halving(
+        space,
+        run_batch=_fake_run_batch(
+            lambda c, s: c["x"], fail_on={0, 1}
+        ),
+        max_steps=20, n0=8, eta=2, seed=1,
+    )
+    assert best is not None
+    assert sum(1 for t in trials if t["status"] != "ok") == 2
+
+
+def test_halving_rejects_bad_eta():
+    with pytest.raises(ValueError, match="eta"):
+        ta.successive_halving(
+            {"x": [1]}, run_batch=_fake_run_batch(lambda c, s: 0),
+            max_steps=10, n0=4, eta=1,
+        )
+
+
+def test_tpe_concentrates_on_good_region():
+    space = {"x": list(range(30)), "y": ["a", "b"]}
+
+    def score(c, _steps):
+        return abs(c["x"] - 21) + (0 if c["y"] == "b" else 10)
+
+    log = []
+    trials, best = ta.tpe(
+        space,
+        run_batch=_fake_run_batch(score, log),
+        train_steps=7, max_trials=24, batch_size=4, seed=0,
+    )
+    assert len(trials) == 24
+    assert all(s == 7 for _, s in log)
+    assert best["metrics"]["loss"] <= 3.0
+    # The density ratio must pull later proposals toward the good region:
+    # the post-startup half scores better on average than the random half.
+    losses = [t["metrics"]["loss"] for t in trials if t["status"] == "ok"]
+    assert sum(losses[12:]) / 12 < sum(losses[:12]) / 12
+
+
+def test_tpe_deterministic_for_seed():
+    space = {"x": list(range(6))}
+    kw = dict(run_batch=_fake_run_batch(lambda c, s: c["x"]),
+              train_steps=3, max_trials=10, batch_size=3, seed=5)
+    t1, b1 = ta.tpe(space, **kw)
+    t2, b2 = ta.tpe(space, **kw)
+    assert [t["hyperparameters"] for t in t1] == [
+        t["hyperparameters"] for t in t2
+    ]
+    assert b1["hyperparameters"] == b2["hyperparameters"]
+
+
+# ---------------------------------------------------------------- component
+
+
+def _toy_module(tmp_path):
+    mod = tmp_path / "toy_trainer.py"
+    mod.write_text(
+        "from tpu_pipelines.trainer.fn_args import TrainResult\n"
+        "def run_fn(fn_args):\n"
+        "    hp = fn_args.hyperparameters\n"
+        "    loss = (hp['x'] - 3) ** 2 + 10.0 / fn_args.train_steps\n"
+        "    return TrainResult(final_metrics={'loss': float(loss)},\n"
+        "                       steps_completed=fn_args.train_steps)\n"
+    )
+    return str(mod)
+
+
+def _examples_gen(tmp_path):
+    from tpu_pipelines.components import CsvExampleGen
+
+    csv = tmp_path / "data.csv"
+    csv.write_text("a,b\n" + "\n".join(f"{i},{i}" for i in range(8)) + "\n")
+    return CsvExampleGen(input_path=str(csv))
+
+
+def _run_tuner(tmp_path, **tuner_kwargs):
+    from tpu_pipelines.components import Tuner
+    from tpu_pipelines.dsl.pipeline import Pipeline
+    from tpu_pipelines.orchestration import LocalDagRunner
+
+    tuner = Tuner(
+        examples=_examples_gen(tmp_path).outputs["examples"],
+        module_file=_toy_module(tmp_path),
+        **tuner_kwargs,
+    )
+    p = Pipeline(
+        "tune-adaptive", [tuner],
+        pipeline_root=str(tmp_path / "root"),
+        metadata_path=str(tmp_path / "md.sqlite"),
+    )
+    result = LocalDagRunner().run(p)
+    assert result.succeeded
+    uri = result.outputs_of("Tuner", "best_hyperparameters")[0].uri
+    with open(os.path.join(uri, "best_hyperparameters.json")) as f:
+        best = json.load(f)
+    with open(os.path.join(uri, "trials.json")) as f:
+        trials = json.load(f)
+    return best, trials
+
+
+def test_tuner_component_halving(tmp_path):
+    best, trials = _run_tuner(
+        tmp_path,
+        search_space={"x": list(range(9))},
+        algorithm="halving",
+        max_trials=9,
+        train_steps=40,
+        seed=0,
+    )
+    assert best["x"] in (2, 3, 4)
+    budgets = sorted({t["train_steps"] for t in trials})
+    assert budgets[-1] == 40 and len(budgets) >= 2
+    # Per-rung trial dirs are distinct (global trial ids).
+    ids = [t["trial"] for t in trials]
+    assert len(set(ids)) == len(ids)
+
+
+def test_tuner_component_tpe(tmp_path):
+    best, trials = _run_tuner(
+        tmp_path,
+        search_space={"x": list(range(9))},
+        algorithm="tpe",
+        max_trials=12,
+        train_steps=5,
+        seed=0,
+    )
+    assert best["x"] in (2, 3, 4)
+    assert len(trials) == 12
+
+
+def test_adaptive_rejects_trial_shards(tmp_path):
+    from tpu_pipelines.orchestration.local_runner import PipelineRunError
+    from tpu_pipelines.components import Tuner
+    from tpu_pipelines.dsl.pipeline import Pipeline
+    from tpu_pipelines.orchestration import LocalDagRunner
+
+    tuner = Tuner(
+        examples=_examples_gen(tmp_path).outputs["examples"],
+        module_file=_toy_module(tmp_path),
+        search_space={"x": [1, 2]},
+        algorithm="tpe",
+        trial_shards=2,
+    )
+    p = Pipeline(
+        "tune-bad", [tuner],
+        pipeline_root=str(tmp_path / "root"),
+        metadata_path=str(tmp_path / "md.sqlite"),
+    )
+    with pytest.raises(PipelineRunError, match="trial_shards"):
+        LocalDagRunner().run(p)

@@ -148,7 +148,11 @@ def enumerate_candidates(
         n = max_trials or min(10, _space_size(space))
         candidates = _random(space, n, exec_properties.get("seed", 0))
     else:
-        raise ValueError(f"unknown tuner algorithm {algorithm!r}")
+        raise ValueError(
+            f"unknown enumerable tuner algorithm {algorithm!r} "
+            "(adaptive algorithms 'halving'/'tpe' are handled by the "
+            "component, not by candidate enumeration)"
+        )
     if not candidates:
         raise ValueError(
             f"tuner produced no candidates (space={space}, "
@@ -380,8 +384,19 @@ def load_shard_results(
         "module_file": Parameter(type=str, required=True),
         # {name: [candidate values]}; falls back to module SEARCH_SPACE.
         "search_space": Parameter(type=dict, default=None),
-        "algorithm": Parameter(type=str, default="grid"),  # grid | random
+        # grid | random | halving (successive halving, the Hyperband inner
+        # loop) | tpe (Tree-structured Parzen Estimator) — the latter two
+        # are the KerasTuner/Katib adaptive equivalents (tuner_algorithms.py)
+        "algorithm": Parameter(type=str, default="grid"),
         "max_trials": Parameter(type=int, default=0),      # 0 = all (grid)
+        # halving: initial candidate count (defaults to max_trials or 9),
+        # reduction factor, and the smallest rung budget (0 = derived).
+        "halving_eta": Parameter(type=int, default=3),
+        "min_train_steps": Parameter(type=int, default=0),
+        # tpe: proposal batch size, good-fraction, random startup trials.
+        "tpe_batch": Parameter(type=int, default=4),
+        "tpe_gamma": Parameter(type=float, default=0.25),
+        "tpe_startup": Parameter(type=int, default=0),
         "train_steps": Parameter(type=int, default=100),
         "eval_steps": Parameter(type=int, default=0),
         # Metric key from TrainResult.final_metrics; "" = eval_loss if
@@ -404,7 +419,6 @@ def load_shard_results(
 )
 def Tuner(ctx):
     module_file = ctx.exec_properties["module_file"]
-    candidates = enumerate_candidates(ctx.exec_properties, module_file)
 
     direction = ctx.exec_properties["direction"]
     if direction not in ("min", "max"):
@@ -414,6 +428,15 @@ def Tuner(ctx):
     out = ctx.output("best_hyperparameters")
 
     uris = ctx_data_uris(ctx)
+
+    algorithm = ctx.exec_properties.get("algorithm", "grid")
+    if algorithm in ("halving", "hyperband", "tpe"):
+        return _adaptive_tuner(
+            ctx, algorithm, module_file, uris, out, base_hp, objective,
+            direction,
+        )
+
+    candidates = enumerate_candidates(ctx.exec_properties, module_file)
 
     def trial_fn_args(i: int) -> FnArgs:
         return build_trial_fn_args(
@@ -451,25 +474,7 @@ def Tuner(ctx):
             len(outcomes), len(candidates), shard_dir,
         )
 
-    parallel = max(1, int(ctx.exec_properties["parallel_trials"]))
-    isolate = bool(ctx.exec_properties["isolate_trials"]) or parallel > 1
-    if isolate:
-        # Subprocess trials are a single-controller mechanism: under
-        # multi-host SPMD every host process would race on the same spec/
-        # result files and the subprocesses would never join the coordination
-        # service.  Multi-host fan-out is what trial_shards is for.
-        # Detected from the bootstrap env (parallel/distributed.py), NOT via
-        # jax.process_count(): touching jax here would initialize the TPU
-        # backend in the parent and lock the chips away from every trial
-        # subprocess this mode exists to spawn.
-        from tpu_pipelines.parallel.distributed import ENV_NUM_PROCESSES
-
-        if int(os.environ.get(ENV_NUM_PROCESSES, "1") or 1) > 1:
-            raise ValueError(
-                "parallel_trials/isolate_trials cannot run under multi-host "
-                "SPMD (every host would spawn colliding trial subprocesses); "
-                "use trial_shards for cluster fan-out instead"
-            )
+    parallel, isolate = _trial_exec_mode(ctx)
     if todo and parallel > 1:
         outcomes.update(_run_trials_parallel(
             todo, candidates, module_file, trial_fn_args, parallel
@@ -519,8 +524,35 @@ def Tuner(ctx):
             n_failed, len(trials), len(trials) - n_failed,
         )
 
-    os.makedirs(out.uri, exist_ok=True)
     best = {**base_hp, **candidates[best_idx]}
+    return _publish_results(out, best, trials, best_idx, best_score, n_failed)
+
+
+def _trial_exec_mode(ctx) -> "tuple[int, bool]":
+    parallel = max(1, int(ctx.exec_properties["parallel_trials"]))
+    isolate = bool(ctx.exec_properties["isolate_trials"]) or parallel > 1
+    if isolate:
+        # Subprocess trials are a single-controller mechanism: under
+        # multi-host SPMD every host process would race on the same spec/
+        # result files and the subprocesses would never join the coordination
+        # service.  Multi-host fan-out is what trial_shards is for.
+        # Detected from the bootstrap env (parallel/distributed.py), NOT via
+        # jax.process_count(): touching jax here would initialize the TPU
+        # backend in the parent and lock the chips away from every trial
+        # subprocess this mode exists to spawn.
+        from tpu_pipelines.parallel.distributed import ENV_NUM_PROCESSES
+
+        if int(os.environ.get(ENV_NUM_PROCESSES, "1") or 1) > 1:
+            raise ValueError(
+                "parallel_trials/isolate_trials cannot run under multi-host "
+                "SPMD (every host would spawn colliding trial subprocesses); "
+                "use trial_shards for cluster fan-out instead"
+            )
+    return parallel, isolate
+
+
+def _publish_results(out, best, trials, best_idx, best_score, n_failed):
+    os.makedirs(out.uri, exist_ok=True)
     # Multi-host: every process ran the trials (SPMD), but these plain-file
     # writes land in the shared output dir — process 0 only.  jax is already
     # live here (the trials trained), so ask the backend, which also covers
@@ -542,3 +574,92 @@ def Tuner(ctx):
         "best_trial": best_idx,
         "best_score": best_score,
     }
+
+
+def _adaptive_tuner(ctx, algorithm, module_file, uris, out, base_hp,
+                    objective, direction):
+    """Successive-halving / TPE flow: rounds of trials through the same
+    subprocess/parallel machinery, budgets and proposals driven by earlier
+    scores (tuner_algorithms.py)."""
+    from tpu_pipelines.components import tuner_algorithms as ta
+
+    if int(ctx.exec_properties["trial_shards"] or 0):
+        raise ValueError(
+            f"algorithm {algorithm!r} is sequential-by-round and cannot use "
+            "trial_shards fan-out; use parallel_trials for within-round "
+            "concurrency"
+        )
+    space = resolve_search_space(ctx.exec_properties, module_file)
+    parallel, isolate = _trial_exec_mode(ctx)
+    train_steps = int(ctx.exec_properties.get("train_steps", 100))
+    max_trials = int(ctx.exec_properties["max_trials"] or 0)
+
+    def run_batch(cands, steps, first_id):
+        overlaid = {**ctx.exec_properties, "train_steps": steps}
+
+        def fn_args(i: int) -> FnArgs:
+            return build_trial_fn_args(
+                **uris,
+                trial_dir=os.path.join(out.uri, "trials", str(first_id + i)),
+                hyperparameters={**base_hp, **cands[i]},
+                exec_properties=overlaid,
+            )
+
+        todo = list(range(len(cands)))
+        if parallel > 1:
+            outcomes = _run_trials_parallel(
+                todo, cands, module_file, fn_args, parallel
+            )
+        else:
+            outcomes = _run_trials_inprocess(
+                todo, cands, module_file, fn_args, isolate
+            )
+        ordered = []
+        for i in todo:
+            o = outcomes[i]
+            o["trial"] = first_id + i
+            ordered.append(o)
+        return ordered
+
+    if algorithm in ("halving", "hyperband"):
+        n0 = max_trials or 9
+        trials, best = ta.successive_halving(
+            space,
+            run_batch=run_batch,
+            max_steps=train_steps,
+            n0=n0,
+            eta=int(ctx.exec_properties["halving_eta"]),
+            min_steps=int(ctx.exec_properties["min_train_steps"]),
+            objective=objective,
+            direction=direction,
+            seed=int(ctx.exec_properties["seed"]),
+        )
+    else:
+        trials, best = ta.tpe(
+            space,
+            run_batch=run_batch,
+            train_steps=train_steps,
+            max_trials=max_trials or 16,
+            batch_size=int(ctx.exec_properties["tpe_batch"]),
+            startup=int(ctx.exec_properties["tpe_startup"]),
+            gamma=float(ctx.exec_properties["tpe_gamma"]),
+            objective=objective,
+            direction=direction,
+            seed=int(ctx.exec_properties["seed"]),
+        )
+
+    n_failed = sum(1 for t in trials if t["status"] != "ok")
+    if best is None:
+        raise RuntimeError(
+            f"all {len(trials)} tuner trials failed; see trial error logs "
+            f"under {out.uri}/trials/"
+        )
+    if n_failed:
+        logger.warning(
+            "tuner: %d/%d trials failed; best of the %d survivors wins",
+            n_failed, len(trials), len(trials) - n_failed,
+        )
+    best_hp = {**base_hp, **best["hyperparameters"]}
+    return _publish_results(
+        out, best_hp, trials, best["trial"], best.get("score"), n_failed
+    )

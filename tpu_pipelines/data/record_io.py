@@ -209,17 +209,16 @@ def parse_tf_example(payload: bytes) -> Dict[str, object]:
 # ------------------------------------------------------------ batch builder
 
 
-def _column(values: list, name: str,
-            bytes_types: Dict[str, pa.DataType]) -> pa.Array:
+def _column(values: list, name: str, pins: Dict[str, dict]) -> pa.Array:
     """Rows of a feature -> a pyarrow column.
 
     Every row must have the same value count (scalar, or fixed-length list
-    — the reference's fixed-shape feature-spec contract).  Byte features
-    decode to UTF-8 strings when the FIRST chunk decodes, else stay binary;
-    the choice is pinned in ``bytes_types`` so every later chunk carries the
-    same schema (the same first-block pinning the streaming CSV reader
-    documents) — a later chunk that violates the pinned string type raises
-    with that context instead of crashing the Parquet writer mid-file.
+    — the reference's fixed-shape feature-spec contract), and the count is
+    PINNED by the first chunk: a later chunk whose count differs raises a
+    contextual error instead of crashing the Parquet writer mid-file with a
+    raw schema mismatch.  Byte features likewise pin string-vs-binary from
+    whether the FIRST chunk decodes as UTF-8 (the same first-block typing
+    contract as the streaming CSV reader).
     """
     lengths = {len(v) for v in values}
     if len(lengths) != 1:
@@ -230,20 +229,28 @@ def _column(values: list, name: str,
     (n,) = lengths
     if n == 0:
         raise ValueError(f"feature {name!r} has empty values")
+    pin = pins.get(name)
+    if pin is not None and pin["n"] != n:
+        raise ValueError(
+            f"feature {name!r} has {n} values per row in a later chunk but "
+            f"{pin['n']} in the first chunk; fixed-length features required "
+            "— the shape is pinned by the first chunk (like streaming CSV "
+            "inference)"
+        )
     first = values[0]
     if isinstance(first, list):                       # bytes rows
         flat = [b for row in values for b in row]
-        pinned = bytes_types.get(name)
-        if pinned is None:
+        pinned_type = pin["type"] if pin else None
+        if pinned_type is None:
             try:
                 col: pa.Array = pa.array(
                     [b.decode("utf-8") for b in flat], pa.string()
                 )
-                bytes_types[name] = pa.string()
+                pins[name] = {"n": n, "type": pa.string()}
             except UnicodeDecodeError:
                 col = pa.array(flat, pa.binary())
-                bytes_types[name] = pa.binary()
-        elif pinned == pa.string():
+                pins[name] = {"n": n, "type": pa.binary()}
+        elif pinned_type == pa.string():
             try:
                 col = pa.array([b.decode("utf-8") for b in flat], pa.string())
             except UnicodeDecodeError as e:
@@ -259,6 +266,8 @@ def _column(values: list, name: str,
             col = pa.array(flat, pa.binary())
     else:
         col = pa.array(np.concatenate(values))
+        if pin is None:
+            pins[name] = {"n": n, "type": None}
     if n == 1:
         return col
     return pa.FixedSizeListArray.from_arrays(col, n)
@@ -269,7 +278,7 @@ def tf_example_batches(
 ) -> Iterator[pa.RecordBatch]:
     """Parse a record stream into bounded-size pyarrow RecordBatches."""
     rows: List[Dict[str, object]] = []
-    bytes_types: Dict[str, pa.DataType] = {}
+    pins: Dict[str, dict] = {}
 
     def flush() -> pa.RecordBatch:
         names = list(rows[0])
@@ -280,7 +289,7 @@ def tf_example_batches(
                     f"inconsistent feature sets across examples: {missing}"
                 )
         cols = {
-            name: _column([r[name] for r in rows], name, bytes_types)
+            name: _column([r[name] for r in rows], name, pins)
             for name in names
         }
         return pa.RecordBatch.from_pydict(cols)
