@@ -581,3 +581,83 @@ def test_server_generate_endpoint(tmp_path):
         assert "generate" in json.load(exc.value)["error"]
     finally:
         server2.stop()
+
+
+def test_grpc_generate(tmp_path):
+    """gRPC Generate mirrors REST :generate; forward-only payloads get
+    FAILED_PRECONDITION."""
+    import grpc
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_pipelines.models.t5 import T5
+    from tpu_pipelines.serving import ModelServer
+    from tpu_pipelines.serving.grpc_server import (
+        PredictionClient,
+        start_grpc_server,
+    )
+
+    module = _seq2seq_module(tmp_path)
+    model = T5(vocab_size=32, d_model=8, n_layers=1, n_heads=2, head_dim=4,
+               d_ff=16, dropout_rate=0.0, dtype=jnp.float32)
+    params = model.init(
+        jax.random.key(0),
+        {"inputs": np.zeros((1, 4), np.int32),
+         "targets": np.zeros((1, 3), np.int32)},
+    )["params"]
+    export_model(
+        serving_model_dir=str(tmp_path / "gs2s" / "1"),
+        params=params, module_file=module,
+    )
+    server = ModelServer("gs2s", str(tmp_path / "gs2s"))
+    grpc_server, port = start_grpc_server(server)
+    client = PredictionClient(f"127.0.0.1:{port}")
+    try:
+        tokens, version = client.generate(
+            "gs2s", {"inputs": np.asarray([[5, 9, 3, 2], [7, 1, 4, 4]],
+                                          np.int32)}
+        )
+        assert version == "1"
+        assert tokens.shape == (2, 5)
+        assert tokens.dtype.kind == "i"
+    finally:
+        client.close()
+        grpc_server.stop(0)
+        server.stop()
+
+    # Forward-only model: Generate must fail with FAILED_PRECONDITION.
+    base = tmp_path / "gtoy" / "toy"
+    _export(tmp_path, "gtoy/toy/1")
+    server2 = ModelServer("toy", str(base))
+    grpc_server2, port2 = start_grpc_server(server2)
+    client2 = PredictionClient(f"127.0.0.1:{port2}")
+    try:
+        with pytest.raises(grpc.RpcError) as err:
+            client2.generate("toy", {"x": np.eye(3, dtype=np.float32)})
+        assert err.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+    finally:
+        client2.close()
+        grpc_server2.stop(0)
+        server2.stop()
+
+
+def test_generate_empty_request_still_checks_capability(tmp_path):
+    """{'instances': []} against a forward-only payload errors (400), not
+    200 [] — the capability check runs before payload parsing."""
+    base = tmp_path / "served3" / "toy"
+    _export(tmp_path, "served3/toy/1")
+    from tpu_pipelines.serving import ModelServer
+
+    server = ModelServer("toy", str(base))
+    port = server.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/toy:generate",
+            data=json.dumps({"instances": []}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        assert exc.value.code == 400
+    finally:
+        server.stop()

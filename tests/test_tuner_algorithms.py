@@ -205,3 +205,16 @@ def test_adaptive_rejects_trial_shards(tmp_path):
     )
     with pytest.raises(PipelineRunError, match="trial_shards"):
         LocalDagRunner().run(p)
+
+
+def test_halving_stops_once_budget_saturates():
+    """min_steps near max_steps: the schedule must not re-run survivors at
+    an identical full budget (zero information for a full training run)."""
+    log = []
+    ta.successive_halving(
+        {"x": list(range(9))},
+        run_batch=_fake_run_batch(lambda c, s: c["x"], log),
+        max_steps=90, n0=9, eta=3, min_steps=50, seed=0,
+    )
+    assert [s for _, s in log] == [50, 90]
+    assert [n for n, _ in log] == [9, 3]

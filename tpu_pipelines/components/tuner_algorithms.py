@@ -95,18 +95,27 @@ def successive_halving(
     if min_steps <= 0:
         min_steps = max(1, max_steps // (eta ** (rungs - 1)))
 
+    # Budget schedule upfront, capped at max_steps: once a rung reaches the
+    # full budget there is nothing further to promote INTO — re-running the
+    # survivor at the same budget would buy zero information — so the
+    # schedule ends there even if the width plan had more rungs.
+    budgets: List[int] = []
+    for r in range(rungs):
+        steps = min(max_steps, max(min_steps, min_steps * (eta ** r)))
+        if r == rungs - 1:
+            steps = max_steps
+        budgets.append(steps)
+        if steps >= max_steps:
+            break
+    rungs = len(budgets)
+
     survivors = _random(space, n0, seed)
     trials: List[Dict[str, Any]] = []
     obj = objective
     best: Optional[Dict[str, Any]] = None
     best_score: Optional[float] = None
     trial_id = 0
-    for r in range(rungs):
-        steps = min(
-            max_steps, max(min_steps, min_steps * (eta ** r))
-        )
-        if r == rungs - 1:
-            steps = max_steps
+    for r, steps in enumerate(budgets):
         outcomes = run_batch(survivors, steps, trial_id)
         trial_id += len(outcomes)
         obj = obj or _resolve_objective(outcomes, objective)

@@ -5,6 +5,7 @@ the gRPC half.  One ``GrpcPredictionService`` wraps an existing
 ``ModelServer`` and exposes:
 
     /tpu_pipelines.serving.PredictionService/Predict
+    /tpu_pipelines.serving.PredictionService/Generate
     /tpu_pipelines.serving.PredictionService/GetModelStatus
 
 Requests route through ``ModelServer``'s predict path, so micro-batching
@@ -77,12 +78,14 @@ def tensor_to_array(t: "pb.TensorValue") -> np.ndarray:
 
 class GrpcPredictionService:
     """The servicer: validates the model name, decodes tensors, and predicts
-    through the shared ``ModelServer`` (batcher included)."""
+    through the shared ``ModelServer`` (batcher included).  Predict and
+    Generate share the wire messages and the decode/encode halves; only the
+    middle call differs."""
 
     def __init__(self, server: ModelServer):
         self._server = server
 
-    def Predict(self, request: "pb.PredictRequest", context):
+    def _decode_inputs(self, request, context) -> Dict[str, Any]:
         import grpc
 
         if request.model_name and request.model_name != self._server.model_name:
@@ -97,12 +100,38 @@ class GrpcPredictionService:
             }
             if not batch:
                 raise ValueError("request has no inputs")
+            return batch
         except Exception as e:  # noqa: BLE001 — request decode/shape faults
             context.abort(
                 grpc.StatusCode.INVALID_ARGUMENT, f"{type(e).__name__}: {e}"
             )
+
+    def _encode_response(self, arr, context) -> "pb.PredictResponse":
+        import grpc
+
         try:
-            preds = self._server.predict_batch(batch)
+            return pb.PredictResponse(
+                model_version=self._server.version or "",
+                predictions=array_to_tensor(np.asarray(arr)),
+            )
+        except Exception as e:  # noqa: BLE001 — encode fault is server-side
+            context.abort(
+                grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}"
+            )
+
+    def _call(self, fn, batch, context):
+        import grpc
+
+        from tpu_pipelines.serving.server import GenerateUnsupported
+
+        try:
+            return fn(batch)
+        except GenerateUnsupported as e:
+            # Typed contract with ModelServer: the deployment cannot serve
+            # this RPC at all — not retryable, not the request's fault.
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION, f"{type(e).__name__}: {e}"
+            )
         except (ValueError, KeyError, TypeError) as e:
             # The model rejecting this batch (missing feature, wrong shape)
             # is still the caller's fault.
@@ -116,15 +145,19 @@ class GrpcPredictionService:
             context.abort(
                 grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}"
             )
-        try:
-            return pb.PredictResponse(
-                model_version=self._server.version or "",
-                predictions=array_to_tensor(np.asarray(preds)),
-            )
-        except Exception as e:  # noqa: BLE001 — encode fault is server-side
-            context.abort(
-                grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}"
-            )
+
+    def Predict(self, request: "pb.PredictRequest", context):
+        batch = self._decode_inputs(request, context)
+        preds = self._call(self._server.predict_batch, batch, context)
+        return self._encode_response(preds, context)
+
+    def Generate(self, request: "pb.PredictRequest", context):
+        """Seq2seq decoding — same wire messages as Predict (inputs map ->
+        token tensor); FAILED_PRECONDITION when the served payload has no
+        make_generate_fn hook."""
+        batch = self._decode_inputs(request, context)
+        tokens = self._call(self._server.generate_batch, batch, context)
+        return self._encode_response(tokens, context)
 
     def GetModelStatus(self, request: "pb.ModelStatusRequest", context):
         import grpc
@@ -145,6 +178,11 @@ def _method_handlers(service: GrpcPredictionService):
     return {
         "Predict": grpc.unary_unary_rpc_method_handler(
             service.Predict,
+            request_deserializer=pb.PredictRequest.FromString,
+            response_serializer=pb.PredictResponse.SerializeToString,
+        ),
+        "Generate": grpc.unary_unary_rpc_method_handler(
+            service.Generate,
             request_deserializer=pb.PredictRequest.FromString,
             response_serializer=pb.PredictResponse.SerializeToString,
         ),
@@ -203,6 +241,11 @@ class PredictionClient:
             request_serializer=pb.ModelStatusRequest.SerializeToString,
             response_deserializer=pb.ModelStatusResponse.FromString,
         )
+        self._generate = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/Generate",
+            request_serializer=pb.PredictRequest.SerializeToString,
+            response_deserializer=pb.PredictResponse.FromString,
+        )
 
     def predict(
         self, model_name: str, batch: Dict[str, Any], timeout: float = 30.0
@@ -211,6 +254,15 @@ class PredictionClient:
         for k, v in batch.items():
             req.inputs[k].CopyFrom(array_to_tensor(np.asarray(v)))
         resp = self._predict(req, timeout=timeout)
+        return tensor_to_array(resp.predictions), resp.model_version
+
+    def generate(
+        self, model_name: str, batch: Dict[str, Any], timeout: float = 60.0
+    ) -> Tuple[np.ndarray, str]:
+        req = pb.PredictRequest(model_name=model_name)
+        for k, v in batch.items():
+            req.inputs[k].CopyFrom(array_to_tensor(np.asarray(v)))
+        resp = self._generate(req, timeout=timeout)
         return tensor_to_array(resp.predictions), resp.model_version
 
     def model_status(

@@ -36,6 +36,11 @@ from tpu_pipelines.trainer.export import LoadedModel, load_exported_model
 log = logging.getLogger("tpu_pipelines.serving")
 
 
+class GenerateUnsupported(ValueError):
+    """This server/payload cannot serve generate requests (no
+    make_generate_fn hook, or raw=False with an embedded transform)."""
+
+
 def latest_version_dir(base_dir: str) -> Optional[str]:
     """Highest numeric subdirectory — the TF Serving version convention."""
     if not os.path.isdir(base_dir):
@@ -160,32 +165,45 @@ class ModelServer:
             return {"predictions": []}
         return {"predictions": self.predict_batch(batch).tolist()}
 
-    def generate(self, payload: Dict[str, Any]) -> Dict[str, Any]:
-        """Seq2seq decoding (models exported with a make_generate_fn hook —
-        trainer/export.py): returns generated token-id sequences.  Decoding
-        batches whole requests (the beam/greedy fn is itself batched), so
-        this path bypasses the forward-pass micro-batcher."""
+    def _generate_fn(self):
+        """The loaded model's generate callable; raises GenerateUnsupported
+        (a ValueError) when this server/payload cannot decode — the typed
+        contract the gRPC surface maps to FAILED_PRECONDITION."""
         with self._lock:
             loaded = self._loaded
         if loaded is None:
             raise RuntimeError("no model loaded")
         if loaded.generate is None:
-            raise ValueError(
-                f"model {self.model_name!r} does not support :generate "
+            raise GenerateUnsupported(
+                f"model {self.model_name!r} does not support generate "
                 "(exported module has no make_generate_fn)"
             )
         if not self.raw and loaded.transform is not None:
             # Same hazard bulk_inferrer.py rejects: loaded.generate applies
             # the embedded transform, so a raw=False server (callers send
             # already-materialized features) would double-tokenize.
-            raise ValueError(
-                ":generate requires raw features (server is raw=False but "
+            raise GenerateUnsupported(
+                "generate requires raw features (server is raw=False but "
                 "the payload embeds a transform)"
             )
+        return loaded.generate
+
+    def generate_batch(self, batch: Dict[str, Any]) -> np.ndarray:
+        """Seq2seq decoding (models exported with a make_generate_fn hook —
+        trainer/export.py) on a columnar feature batch: the shared entry for
+        REST :generate and gRPC Generate.  Decoding batches whole requests
+        (the beam/greedy fn is itself batched), so this path bypasses the
+        forward-pass micro-batcher."""
+        return np.asarray(self._generate_fn()(batch))
+
+    def generate(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        # Capability check BEFORE payload parsing: an empty request against
+        # a server that cannot generate at all must error, not 200 [].
+        generate_fn = self._generate_fn()
         batch = self._payload_to_batch(payload)
         if batch is None:
             return {"outputs": []}
-        return {"outputs": np.asarray(loaded.generate(batch)).tolist()}
+        return {"outputs": np.asarray(generate_fn(batch)).tolist()}
 
     # ---------------------------------------------------------------- HTTP
 
