@@ -1,0 +1,167 @@
+"""ctypes binding over native/record_core.cc: fast tf.Example batch parse.
+
+Same architecture as the native metadata/tokenizer cores (SURVEY.md §2b —
+C++ engine, thin Python client, Python semantics-reference fallback): the
+per-record protobuf wire decode is the irreducibly serial host stage of
+record ingest (the role Beam's C++-runner workers play under the
+reference's ExampleGen), and the C++ loop runs it far faster than the
+interpreter.
+
+Strictness contract (record_core.cc): the engine parses against the schema
+the caller pinned from the FIRST chunk; ANY deviation — unknown/missing
+feature, count mismatch, malformed bytes — fails the whole chunk and the
+caller re-parses it with the Python decoder, whose errors/output are the
+semantics.  The native path can only ever produce byte-identical data
+faster, never different data.
+
+``parse_chunk`` returns None when the shared object cannot be built or the
+chunk deviates — callers fall back to Python.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+LIB_NAME = "libtpprec.so"
+
+KIND_BYTES, KIND_FLOAT, KIND_INT64 = 0, 1, 2
+
+_lib = None
+_lib_failed = False
+_lib_lock = threading.Lock()
+
+
+def _load_library():
+    global _lib, _lib_failed
+    with _lib_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            subprocess.run(
+                ["make", "-s", LIB_NAME], cwd=NATIVE_DIR, check=True,
+                capture_output=True,
+            )
+            lib = ctypes.CDLL(os.path.join(NATIVE_DIR, LIB_NAME))
+        except (OSError, subprocess.CalledProcessError) as e:
+            log.info("native record parser unavailable (%s); using python", e)
+            _lib_failed = True
+            return None
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.rec_parser_create.restype = ctypes.c_void_p
+        lib.rec_parser_create.argtypes = [
+            ctypes.c_char_p, i64p, ctypes.POINTER(ctypes.c_int32), i64p,
+            ctypes.c_int64,
+        ]
+        lib.rec_parser_destroy.argtypes = [ctypes.c_void_p]
+        lib.rec_set_float_out.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        ]
+        lib.rec_set_int64_out.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ]
+        lib.rec_parse_batch.restype = ctypes.c_int64
+        lib.rec_parse_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, i64p, ctypes.c_int64,
+        ]
+        lib.rec_bytes_size.restype = ctypes.c_int64
+        lib.rec_bytes_size.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.rec_bytes_count.restype = ctypes.c_int64
+        lib.rec_bytes_count.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.rec_copy_bytes.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ]
+        _lib = lib
+        return _lib
+
+
+# Schema: [(name, kind, count)], pinned by the caller from the first chunk.
+Schema = List[Tuple[str, int, int]]
+
+
+def parse_chunk(
+    records: Sequence[bytes], schema: Schema
+) -> Optional[Dict[str, object]]:
+    """Parse records strictly against ``schema``.
+
+    Returns {name: float32/int64 ndarray [n, count]} for numeric features
+    and {name: (bytes_data uint8 ndarray, offsets int64 ndarray)} for bytes
+    features — or None when the native core is unavailable or the chunk
+    deviates from the schema (caller re-parses in Python).
+    """
+    lib = _load_library()
+    if lib is None or not records or not schema:
+        return None
+    n = len(records)
+    names = "".join(name for name, _, _ in schema).encode("utf-8")
+    name_offsets = np.zeros(len(schema) + 1, np.int64)
+    np.cumsum(
+        [len(name.encode("utf-8")) for name, _, _ in schema],
+        out=name_offsets[1:],
+    )
+    kinds = np.asarray([k for _, k, _ in schema], np.int32)
+    counts = np.asarray([c for _, _, c in schema], np.int64)
+    h = lib.rec_parser_create(
+        names,
+        name_offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        kinds.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(schema),
+    )
+    try:
+        out: Dict[str, object] = {}
+        for i, (name, kind, count) in enumerate(schema):
+            if kind == KIND_FLOAT:
+                arr = np.empty((n, count), np.float32)
+                lib.rec_set_float_out(h, i, arr)
+                out[name] = arr
+            elif kind == KIND_INT64:
+                arr = np.empty((n, count), np.int64)
+                lib.rec_set_int64_out(h, i, arr)
+                out[name] = arr
+
+        data = b"".join(records)
+        offsets = np.zeros(n + 1, np.int64)
+        np.cumsum(
+            np.fromiter((len(r) for r in records), np.int64, count=n),
+            out=offsets[1:],
+        )
+        rc = lib.rec_parse_batch(
+            h, data, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n
+        )
+        if rc != 0:
+            log.debug(
+                "native record parse fell back at row %d", -int(rc) - 1
+            )
+            return None
+
+        for i, (name, kind, count) in enumerate(schema):
+            if kind != KIND_BYTES:
+                continue
+            total = int(lib.rec_bytes_size(h, i))
+            n_vals = int(lib.rec_bytes_count(h, i))
+            if n_vals != n * count:
+                return None
+            bdata = np.empty(max(1, total), np.uint8)
+            boffsets = np.empty(n_vals + 1, np.int64)
+            lib.rec_copy_bytes(h, i, bdata, boffsets)
+            out[name] = (bdata[:total], boffsets)
+        return out
+    finally:
+        lib.rec_parser_destroy(h)

@@ -130,22 +130,22 @@ def _length_delimited(buf: bytes, pos: int) -> bytes:
 
 
 def _decode_float_list(buf: bytes) -> np.ndarray:
-    """FloatList: repeated float value = 1 — packed or unpacked."""
-    packed: List[bytes] = []
-    singles: List[float] = []
+    """FloatList: repeated float value = 1 — packed or unpacked, in WIRE
+    ORDER (mixed encodings concatenate as encountered, matching the proto
+    spec and the native parser byte-for-byte)."""
+    parts: List[np.ndarray] = []
     for num, wt, b, pos in _iter_fields(buf):
         if num != 1:
             continue
         if wt == 2:
-            packed.append(_length_delimited(b, pos))
+            parts.append(np.frombuffer(_length_delimited(b, pos), "<f4"))
         elif wt == 5:
-            singles.append(struct.unpack_from("<f", b, pos)[0])
-    if packed:
-        arr = np.frombuffer(b"".join(packed), dtype="<f4")
-        if singles:
-            arr = np.concatenate([arr, np.asarray(singles, "<f4")])
-        return arr
-    return np.asarray(singles, "<f4")
+            parts.append(
+                np.asarray([struct.unpack_from("<f", b, pos)[0]], "<f4")
+            )
+    if not parts:
+        return np.asarray([], "<f4")
+    return parts[0] if len(parts) == 1 else np.concatenate(parts)
 
 
 def _decode_int64_list(buf: bytes) -> np.ndarray:
@@ -246,10 +246,10 @@ def _column(values: list, name: str, pins: Dict[str, dict]) -> pa.Array:
                 col: pa.Array = pa.array(
                     [b.decode("utf-8") for b in flat], pa.string()
                 )
-                pins[name] = {"n": n, "type": pa.string()}
+                pins[name] = {"n": n, "type": pa.string(), "kind": 0}
             except UnicodeDecodeError:
                 col = pa.array(flat, pa.binary())
-                pins[name] = {"n": n, "type": pa.binary()}
+                pins[name] = {"n": n, "type": pa.binary(), "kind": 0}
         elif pinned_type == pa.string():
             try:
                 col = pa.array([b.decode("utf-8") for b in flat], pa.string())
@@ -265,39 +265,116 @@ def _column(values: list, name: str, pins: Dict[str, dict]) -> pa.Array:
         else:
             col = pa.array(flat, pa.binary())
     else:
-        col = pa.array(np.concatenate(values))
+        flat_num = np.concatenate(values)
+        col = pa.array(flat_num)
         if pin is None:
-            pins[name] = {"n": n, "type": None}
+            pins[name] = {
+                "n": n, "type": None,
+                "kind": 1 if flat_num.dtype == np.float32 else 2,
+            }
     if n == 1:
         return col
     return pa.FixedSizeListArray.from_arrays(col, n)
 
 
+def _python_chunk(raw: List[bytes], pins: Dict[str, dict],
+                  order: List[str]) -> pa.RecordBatch:
+    """Reference decode path: per-record Python wire parse + _column."""
+    rows = [parse_tf_example(rec) for rec in raw]
+    if not order:
+        order.extend(rows[0])
+    for r in rows:
+        if set(r) != set(order):
+            missing = set(order) ^ set(r)
+            raise ValueError(
+                f"inconsistent feature sets across examples: {missing}"
+            )
+    cols = {
+        name: _column([r[name] for r in rows], name, pins)
+        for name in order
+    }
+    return pa.RecordBatch.from_pydict(cols)
+
+
+def _native_chunk(raw: List[bytes], pins: Dict[str, dict],
+                  order: List[str]) -> Optional[pa.RecordBatch]:
+    """C++ fast path (native/record_core.cc) against the pinned schema;
+    None on any deviation — the caller re-parses the chunk in Python, whose
+    output and errors are the semantics."""
+    from tpu_pipelines.data import native_record
+
+    schema = [(name, pins[name]["kind"], pins[name]["n"]) for name in order]
+    parsed = native_record.parse_chunk(raw, schema)
+    if parsed is None:
+        return None
+    cols: Dict[str, pa.Array] = {}
+    for name in order:
+        pin = pins[name]
+        val = parsed[name]
+        if pin["kind"] == 0:
+            bdata, boffsets = val
+            if pin["type"] == pa.string():
+                # Zero-copy UTF-8 validation: the whole buffer must decode
+                # AND every value boundary must be a character boundary
+                # (valid pieces cannot start with a continuation byte).
+                # Deviations fall back to Python for its contextual error.
+                try:
+                    bdata.tobytes().decode("utf-8")
+                except UnicodeDecodeError:
+                    return None
+                inner = boffsets[1:-1]
+                starts = inner[inner < len(bdata)]
+                if starts.size and (
+                    (bdata[starts] & 0xC0) == 0x80
+                ).any():
+                    return None
+                target = pa.string()
+            else:
+                target = pa.binary()
+            arr = pa.Array.from_buffers(
+                pa.large_binary(), len(boffsets) - 1,
+                [None, pa.py_buffer(boffsets), pa.py_buffer(bdata)],
+            )
+            col = arr.cast(
+                pa.large_string() if target == pa.string() else target
+            ).cast(target)
+        else:
+            col = pa.array(val.reshape(-1))
+        if pin["n"] > 1:
+            col = pa.FixedSizeListArray.from_arrays(col, pin["n"])
+        cols[name] = col
+    return pa.RecordBatch.from_pydict(cols)
+
+
 def tf_example_batches(
     records: Iterable[bytes], batch_rows: int = 8192
 ) -> Iterator[pa.RecordBatch]:
-    """Parse a record stream into bounded-size pyarrow RecordBatches."""
-    rows: List[Dict[str, object]] = []
+    """Parse a record stream into bounded-size pyarrow RecordBatches.
+
+    The FIRST chunk always decodes in Python, which pins the schema
+    (feature kinds, value counts, string-vs-binary — see _column); later
+    chunks go through the native C++ parser against that pinned schema,
+    falling back to the Python decoder chunk-by-chunk on any deviation.
+    """
     pins: Dict[str, dict] = {}
+    order: List[str] = []
+    raw: List[bytes] = []
+    first = True
 
     def flush() -> pa.RecordBatch:
-        names = list(rows[0])
-        for r in rows:
-            if set(r) != set(names):
-                missing = set(names) ^ set(r)
-                raise ValueError(
-                    f"inconsistent feature sets across examples: {missing}"
-                )
-        cols = {
-            name: _column([r[name] for r in rows], name, pins)
-            for name in names
-        }
-        return pa.RecordBatch.from_pydict(cols)
+        nonlocal first
+        batch = None
+        if not first:
+            batch = _native_chunk(raw, pins, order)
+        if batch is None:
+            batch = _python_chunk(raw, pins, order)
+        first = False
+        return batch
 
     for rec in records:
-        rows.append(parse_tf_example(rec))
-        if len(rows) >= batch_rows:
+        raw.append(rec)
+        if len(raw) >= batch_rows:
             yield flush()
-            rows = []
-    if rows:
+            raw = []
+    if raw:
         yield flush()
