@@ -48,7 +48,10 @@ struct Slice {
 
   Slice delimited() {
     uint64_t n = varint();
-    if (!ok || p + n > end) {
+    // Compare against the REMAINING size, never `p + n > end`: a crafted
+    // length varint near 2^64 wraps that pointer sum below `end` and the
+    // cursor would move backward — an infinite loop on malformed input.
+    if (!ok || n > static_cast<uint64_t>(end - p)) {
       ok = false;
       return {end, end, false};
     }

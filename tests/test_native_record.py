@@ -192,3 +192,19 @@ def test_mixed_packed_unpacked_floats_decode_in_wire_order(native_available):
     )
     assert out is not None
     np.testing.assert_allclose(out["f"][0], [1.0, 2.0, 3.0])
+
+
+def test_native_rejects_wrapping_length_varint(native_available):
+    """A length-delimited field whose length varint is near 2^64 must fail
+    the batch (Python fallback), not wrap the cursor into an infinite loop."""
+    def varint(v):
+        out = b""
+        while True:
+            b7 = v & 0x7F
+            v >>= 7
+            out += bytes([b7 | (0x80 if v else 0)])
+            if not v:
+                return out
+
+    evil = b"\x0a" + varint((1 << 64) - 11)
+    assert native_record.parse_chunk([evil], SCHEMA) is None

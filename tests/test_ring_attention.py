@@ -118,3 +118,77 @@ def test_make_param_partition_rules():
     bad = make_param_partition(params, [(r"head/kernel", P(None, "model"))])
     probs = validate_partition(params, bad, mesh)
     assert len(probs) == 1 and "head/kernel" in probs[0]
+
+
+# --------------------------------------------------------------- ulysses
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("mesh_shape", [dict(data=2, seq=4),
+                                        dict(data=1, seq=8),
+                                        dict(data=2, seq=2, model=2)])
+def test_ulysses_matches_dense(causal, mesh_shape):
+    from tpu_pipelines.parallel.ring_attention import ulysses_attention
+
+    mesh = make_mesh(MeshConfig(**mesh_shape))
+    q, k, v = _qkv(h=8)   # local heads stay divisible by seq on every mesh
+    want = dense_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                           causal=causal)
+    spec = NamedSharding(mesh, P("data", "seq", "model", None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    got = jax.jit(
+        lambda q, k, v: ulysses_attention(q, k, v, mesh=mesh, causal=causal)
+    )(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_with_padding_mask():
+    from tpu_pipelines.parallel.ring_attention import ulysses_attention
+
+    mesh = make_mesh(MeshConfig(data=2, seq=4))
+    q, k, v = _qkv(h=8)
+    rng = np.random.default_rng(1)
+    mask = (rng.random((2, 16)) > 0.4).astype(np.int32)
+    mask[:, 0] = 1
+    want = dense_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                           kv_mask=jnp.asarray(mask))
+    got = jax.jit(
+        lambda q, k, v, m: ulysses_attention(q, k, v, mesh=mesh, kv_mask=m)
+    )(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    from tpu_pipelines.parallel.ring_attention import ulysses_attention
+
+    mesh = make_mesh(MeshConfig(data=2, seq=4))
+    q, k, v = _qkv(h=2)   # 2 local heads, seq axis 4 -> reject
+    with pytest.raises(ValueError, match="head count"):
+        jax.jit(
+            lambda q, k, v: ulysses_attention(q, k, v, mesh=mesh)
+        )(q, k, v)
+
+
+def test_ulysses_grad_matches_dense():
+    from tpu_pipelines.parallel.ring_attention import ulysses_attention
+
+    mesh = make_mesh(MeshConfig(data=2, seq=4))
+    q, k, v = _qkv(h=4)
+
+    def loss_u(q, k, v):
+        return ulysses_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mesh=mesh
+        ).astype(jnp.float32).sum()
+
+    def loss_d(q, k, v):
+        return dense_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+        ).astype(jnp.float32).sum()
+
+    gu = jax.jit(jax.grad(loss_u, argnums=(0, 1, 2)))(q, k, v)
+    gd = jax.jit(jax.grad(loss_d, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(gu, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)

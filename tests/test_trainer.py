@@ -283,3 +283,68 @@ def test_tensorboard_scalar_sink(tmp_path):
     acc.Reload()
     tags = acc.Tags()["tensors"] + acc.Tags().get("scalars", [])
     assert any("loss" in t for t in tags), tags
+
+
+def test_grad_accumulation_matches_full_batch():
+    """accum=4 must produce the same parameters as accum=1 on the same
+    batch: equal-size microbatch mean-loss average == full-batch mean loss,
+    so the averaged grads are identical (deterministic model, no dropout)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_pipelines.trainer import TrainLoopConfig, train_loop
+
+    rng = np.random.default_rng(0)
+    data = {
+        "x": rng.normal(size=(16, 4)).astype(np.float32),
+        "y": rng.normal(size=(16,)).astype(np.float32),
+    }
+
+    def batches():
+        while True:
+            yield data
+
+    def loss_fn(params, b, _rng):
+        pred = jnp.asarray(b["x"]) @ params["w"]
+        return jnp.mean((pred - jnp.asarray(b["y"])) ** 2), {}
+
+    def init_fn(_rng, b):
+        return {"w": jnp.ones((4,), jnp.float32)}
+
+    def run(accum):
+        params, result = train_loop(
+            loss_fn=loss_fn,
+            init_params_fn=init_fn,
+            optimizer=optax.sgd(0.1),
+            train_iter=batches(),
+            config=TrainLoopConfig(
+                train_steps=5, batch_size=16, log_every=0,
+                grad_accum_steps=accum, seed=3,
+            ),
+        )
+        return np.asarray(params["w"]), result
+
+    w1, r1 = run(1)
+    w4, r4 = run(4)
+    np.testing.assert_allclose(w4, w1, rtol=1e-5, atol=1e-6)
+    assert abs(
+        r1.final_metrics["loss"] - r4.final_metrics["loss"]
+    ) < 1e-5
+
+
+def test_grad_accumulation_rejects_indivisible():
+    import optax
+
+    from tpu_pipelines.trainer import TrainLoopConfig, train_loop
+
+    with pytest.raises(ValueError, match="divisible"):
+        train_loop(
+            loss_fn=lambda p, b, r: (0.0, {}),
+            init_params_fn=lambda r, b: {},
+            optimizer=optax.sgd(0.1),
+            train_iter=iter([{"x": np.zeros((10, 2), np.float32)}]),
+            config=TrainLoopConfig(
+                train_steps=1, batch_size=10, grad_accum_steps=4,
+            ),
+        )

@@ -313,31 +313,20 @@ def _native_chunk(raw: List[bytes], pins: Dict[str, dict],
         val = parsed[name]
         if pin["kind"] == 0:
             bdata, boffsets = val
-            if pin["type"] == pa.string():
-                # Zero-copy UTF-8 validation: the whole buffer must decode
-                # AND every value boundary must be a character boundary
-                # (valid pieces cannot start with a continuation byte).
-                # Deviations fall back to Python for its contextual error.
-                try:
-                    bdata.tobytes().decode("utf-8")
-                except UnicodeDecodeError:
-                    return None
-                inner = boffsets[1:-1]
-                starts = inner[inner < len(bdata)]
-                if starts.size and (
-                    (bdata[starts] & 0xC0) == 0x80
-                ).any():
-                    return None
-                target = pa.string()
-            else:
-                target = pa.binary()
             arr = pa.Array.from_buffers(
                 pa.large_binary(), len(boffsets) - 1,
                 [None, pa.py_buffer(boffsets), pa.py_buffer(bdata)],
             )
-            col = arr.cast(
-                pa.large_string() if target == pa.string() else target
-            ).cast(target)
+            if pin["type"] == pa.string():
+                # Arrow's safe cast validates each VALUE is UTF-8 — one
+                # pass, no buffer copy; a violation falls back to Python
+                # for its contextual pinned-string error.
+                try:
+                    col = arr.cast(pa.large_string()).cast(pa.string())
+                except pa.lib.ArrowInvalid:
+                    return None
+            else:
+                col = arr.cast(pa.binary())
         else:
             col = pa.array(val.reshape(-1))
         if pin["n"] > 1:

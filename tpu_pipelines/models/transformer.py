@@ -63,16 +63,20 @@ class MultiHeadAttention(nn.Module):
 
     ``attn_impl``:
       - "dense": plain XLA attention (any mask/bias/cross).
-      - "ring":  sequence-parallel ring attention over the mesh ``seq`` axis.
+      - "ring":  sequence-parallel ring attention over the mesh ``seq``
+        axis (ppermute pipeline; scales past one chip's memory).
+      - "ulysses": all-to-all sequence parallelism over ``seq`` (two
+        collectives, full-sequence dense math per head slice; lower latency
+        at moderate lengths, needs local heads divisible by the axis).
       - "flash": the Pallas blockwise kernel (ops/flash_attention.py) — no
         O(L²) score tensor in HBM, fwd and bwd.
       - "auto":  dense below FLASH_MIN_SEQ_LEN, flash at/above it.  Measured
         on v5e: at L=128 dense is ~30% faster (one KV block makes the
         blockwise kernel pure overhead), while flash wins once the score
         tensor stops fitting fused in VMEM.
-    Ring/flash require self-attention without an additive bias; cross
-    attention and biased attention (T5 relative positions) always take the
-    dense path.
+    Ring/ulysses/flash require self-attention without an additive bias;
+    cross attention and biased attention (T5 relative positions) always
+    take the dense path.
     """
 
     n_heads: int
@@ -167,18 +171,24 @@ class MultiHeadAttention(nn.Module):
             impl = (
                 "flash" if x_q.shape[1] >= FLASH_MIN_SEQ_LEN else "dense"
             )
-        use_ring = (
-            impl == "ring"
-            and is_self
-            and bias is None
-            and self.mesh is not None
-            and self.mesh.shape.get("seq", 1) > 1
+        has_seq_axis = (
+            self.mesh is not None and self.mesh.shape.get("seq", 1) > 1
+        )
+        use_ring = impl == "ring" and is_self and bias is None and has_seq_axis
+        use_ulysses = (
+            impl == "ulysses" and is_self and bias is None and has_seq_axis
         )
         use_flash = (
             impl == "flash" and is_self and bias is None
         )
         if use_ring:
             out = ring_attention(
+                q, k, v, mesh=self.mesh, causal=self.causal, kv_mask=kv_mask
+            )
+        elif use_ulysses:
+            from tpu_pipelines.parallel.ring_attention import ulysses_attention
+
+            out = ulysses_attention(
                 q, k, v, mesh=self.mesh, causal=self.causal, kv_mask=kv_mask
             )
         elif use_flash:

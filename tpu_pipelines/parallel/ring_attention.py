@@ -1,15 +1,28 @@
-"""Ring attention: sequence/context parallelism over the mesh ``seq`` axis.
+"""Sequence/context parallelism over the mesh ``seq`` axis: ring + Ulysses.
 
-Long-context scaling the TPU way (SURVEY.md §5 long-context): the sequence
-dimension is sharded over mesh axis ``seq``; each device holds one Q block and
-streams K/V blocks around the ring with ``ppermute`` over ICI, accumulating
-softmax online (flash-attention style running max/denominator).  Peak memory
-per chip is O(L/n · L/n) score tiles instead of O(L²), and the K/V transfer
-overlaps with the block matmuls — XLA pipelines the ``ppermute`` against the
-einsums.
+Long-context scaling the TPU way (SURVEY.md §5 long-context), two
+complementary strategies over the same sharding layout:
 
-No NCCL/MPI equivalents: the collective is a single ``lax.ppermute`` emitted
-inside ``shard_map``; the same code runs on the CPU test mesh and a TPU slice.
+  - :func:`ring_attention` — each device holds one Q block and streams K/V
+    blocks around the ring with ``ppermute`` over ICI, accumulating softmax
+    online (flash-attention style running max/denominator).  Peak memory
+    per chip is O(L/n · L/n) score tiles instead of O(L²), and the K/V
+    transfer overlaps with the block matmuls — XLA pipelines the
+    ``ppermute`` against the einsums.  Scales to sequences that never fit
+    one chip; n-1 pipelined hops.
+
+  - :func:`ulysses_attention` — two ``all_to_all`` collectives re-shard
+    from sequence-parallel to HEAD-parallel and back: each device then
+    holds the FULL sequence for h/n heads and runs plain dense attention
+    locally.  Lower latency at moderate sequence lengths (2 collectives vs
+    n-1 hops) and exactly reproduces dense attention per head; requires
+    local head count divisible by the ``seq`` axis, and per-chip memory is
+    O(L²/n) scores — the full-sequence tile, so the ceiling is lower than
+    ring's.
+
+No NCCL/MPI equivalents: the collectives are single ``lax.ppermute`` /
+``lax.all_to_all`` ops emitted inside ``shard_map``; the same code runs on
+the CPU test mesh and a TPU slice.
 """
 
 from __future__ import annotations
@@ -151,6 +164,78 @@ def ring_attention(
     qkv_spec = P(batch_axis, axis, head_axis, None)
     mask_spec = P(batch_axis, axis)
     if has_mask:
+        return jax.shard_map(
+            local_fn,
+            mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+            out_specs=qkv_spec,
+            check_vma=False,
+        )(q, k, v, kv_mask)
+    return jax.shard_map(
+        lambda q, k, v: local_fn(q, k, v, None),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    axis: str = "seq",
+    causal: bool = False,
+    kv_mask: Optional[jnp.ndarray] = None,
+    batch_axis: str = "data",
+    head_axis: str = "model",
+) -> jnp.ndarray:
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
+
+    Same global shapes/shardings as :func:`ring_attention`: q,k,v
+    [batch, seq, heads, head_dim] sharded batch→``batch_axis``,
+    seq→``axis``, heads→``head_axis``; kv_mask [batch, seq].
+
+    Per device: ``all_to_all`` re-shards [b, L/n, h, d] → [b, L, h/n, d]
+    (full sequence, a head slice), plain dense attention runs locally —
+    bit-for-bit the dense math per head — and a second ``all_to_all``
+    restores sequence sharding.  Requires the LOCAL head count (after any
+    ``head_axis`` TP split) to divide by the ``seq`` axis size.
+    """
+    n = mesh.shape[axis]
+    if n == 1:
+        return dense_attention(q, k, v, causal=causal, kv_mask=kv_mask)
+    if q.shape[1] % n:
+        raise ValueError(
+            f"seq len {q.shape[1]} not divisible by mesh axis {axis}={n}"
+        )
+
+    def local_fn(q, k, v, kmask):
+        # q,k,v local: [b, L/n, h_local, d]
+        if q.shape[2] % n:
+            raise ValueError(
+                f"local head count {q.shape[2]} not divisible by mesh axis "
+                f"{axis}={n} (ulysses re-shards heads across the seq axis; "
+                "use ring attention for head counts below the axis size)"
+            )
+        a2a = lambda x, split, concat: jax.lax.all_to_all(
+            x, axis, split_axis=split, concat_axis=concat, tiled=True
+        )
+        qf = a2a(q, 2, 1)                 # [b, L, h_local/n, d]
+        kf = a2a(k, 2, 1)
+        vf = a2a(v, 2, 1)
+        mask_f = (
+            None if kmask is None
+            else jax.lax.all_gather(kmask, axis, axis=1, tiled=True)
+        )
+        out = dense_attention(qf, kf, vf, causal=causal, kv_mask=mask_f)
+        return a2a(out, 1, 2)             # back to [b, L/n, h_local, d]
+
+    qkv_spec = P(batch_axis, axis, head_axis, None)
+    mask_spec = P(batch_axis, axis)
+    if kv_mask is not None:
         return jax.shard_map(
             local_fn,
             mesh=mesh,

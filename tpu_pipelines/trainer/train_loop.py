@@ -82,6 +82,13 @@ class TrainLoopConfig:
     # sequence parallelism.  Keys not listed shard dim 0 over "data".
     batch_partition: Optional[Dict[str, Any]] = None
     donate_state: bool = True
+    # Gradient accumulation: the per-step batch splits into this many
+    # microbatches, scanned inside ONE jitted step (grads averaged, one
+    # optimizer update) — the large-effective-batch story when the full
+    # batch's activations exceed HBM.  Microbatches interleave rows
+    # (every a-th row) so each stays evenly sharded over the mesh ``data``
+    # axis.  batch_size must divide evenly.
+    grad_accum_steps: int = 1
     # Sync-anchored throughput windows: every ``anchor_every`` post-compile
     # steps, force a device-to-host read of that step's loss (the same
     # cannot-lie transfer used for t_start below) and time the span since the
@@ -253,17 +260,73 @@ def train_loop(
         for k, v in first_batch.items()
     }
 
-    def step_fn(state: TrainState, batch) -> Tuple[TrainState, Dict[str, jax.Array]]:
-        step_rng = jax.random.fold_in(state.rng, state.step)
+    accum = max(1, int(config.grad_accum_steps))
+    if accum > 1 and config.batch_size % accum:
+        raise ValueError(
+            f"batch_size {config.batch_size} not divisible by "
+            f"grad_accum_steps {accum}"
+        )
+
+    def forward_backward(params, mstate, mb, rng):
         if has_model_state:
             (loss, (metrics, new_mstate)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
-            )(state.params, state.model_state, batch, step_rng)
+            )(params, mstate, mb, rng)
         else:
             (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params, batch, step_rng
+                params, mb, rng
             )
-            new_mstate = state.model_state
+            new_mstate = mstate
+        return loss, metrics, grads, new_mstate
+
+    def step_fn(state: TrainState, batch) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        step_rng = jax.random.fold_in(state.rng, state.step)
+        if accum == 1:
+            loss, metrics, grads, new_mstate = forward_backward(
+                state.params, state.model_state, batch, step_rng
+            )
+        else:
+            # Microbatch i takes every accum-th row: an interleaved split
+            # keeps each microbatch evenly spread across the contiguous
+            # per-device blocks of the batch-dim sharding (a blocked split
+            # would put whole microbatches on single devices).
+            def split(x):
+                if x.shape[0] % accum:
+                    raise ValueError(
+                        f"batch dim {x.shape[0]} not divisible by "
+                        f"grad_accum_steps {accum}"
+                    )
+                return jnp.moveaxis(
+                    x.reshape(x.shape[0] // accum, accum, *x.shape[1:]), 1, 0
+                )
+
+            micro = jax.tree_util.tree_map(split, batch)
+
+            def mb_step(carry, idx_mb):
+                g_acc, l_acc, m_acc, mstate = carry
+                i, mb = idx_mb
+                loss, metrics, grads, mstate = forward_backward(
+                    state.params, mstate, mb,
+                    jax.random.fold_in(step_rng, i),
+                )
+                g_acc = jax.tree_util.tree_map(jnp.add, g_acc, grads)
+                m_acc = {k: m_acc[k] + v for k, v in metrics.items()}
+                return (g_acc, l_acc + loss, m_acc, mstate), None
+
+            loss0, metrics0, grads0, mstate0 = forward_backward(
+                state.params, state.model_state,
+                jax.tree_util.tree_map(lambda x: x[0], micro),
+                jax.random.fold_in(step_rng, 0),
+            )
+            rest = jax.tree_util.tree_map(lambda x: x[1:], micro)
+            (g_sum, l_sum, m_sum, new_mstate), _ = jax.lax.scan(
+                mb_step, (grads0, loss0, metrics0, mstate0),
+                (jnp.arange(1, accum), rest),
+            )
+            inv = 1.0 / accum
+            grads = jax.tree_util.tree_map(lambda g: g * inv, g_sum)
+            loss = l_sum * inv
+            metrics = {k: v * inv for k, v in m_sum.items()}
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss, **metrics}
