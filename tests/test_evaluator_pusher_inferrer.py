@@ -276,3 +276,46 @@ def test_infra_validator_latency_gate_blocks(dag_result, tmp_path):
     assert out["blessed"] is False
     assert "latency" in out["error"]
     assert os.path.exists(blessing_dir / "NOT_BLESSED")
+
+
+def test_extended_metric_library():
+    """New TFMA-familiar metrics: f1/prauc/calibration (binary), macro_f1 +
+    topk (multiclass), r2 (regression) — checked against hand computations
+    and sklearn-definition invariants."""
+    from tpu_pipelines.evaluation.metrics import compute_metrics
+
+    # Binary: perfectly separable scores.
+    scores = np.asarray([-4.0, -2.0, 2.0, 4.0])
+    labels = np.asarray([0, 0, 1, 1])
+    m = compute_metrics("binary_classification", scores, labels)
+    assert m["auc"] == 1.0
+    assert m["prauc"] == 1.0
+    assert m["f1"] == 1.0
+    assert 0.5 < m["calibration"] < 1.5
+
+    # Binary: anti-separable -> AUC 0, PR-AUC at base-rate floor.
+    m = compute_metrics("binary_classification", -scores, labels)
+    assert m["auc"] == 0.0
+    assert m["prauc"] < 0.7
+    assert m["f1"] == 0.0
+
+    # Multiclass: 6 classes so top5 emits; one perfect, one wrong.
+    rng = np.random.default_rng(0)
+    labels6 = rng.integers(0, 6, size=200)
+    logits = np.eye(6)[labels6] * 5.0
+    m = compute_metrics("multiclass", logits, labels6)
+    assert m["accuracy"] == 1.0
+    assert m["top5_accuracy"] == 1.0
+    assert m["macro_f1"] == 1.0
+
+    shifted = np.roll(logits, 1, axis=-1)   # every argmax wrong
+    m = compute_metrics("multiclass", shifted, labels6)
+    assert m["accuracy"] == 0.0
+    assert m["macro_f1"] == 0.0
+    assert m["top5_accuracy"] >= 0.5        # true class still in top-5
+
+    # Regression: r2 == 1 for exact, 0 for predicting the mean.
+    y = np.asarray([1.0, 2.0, 3.0, 4.0])
+    assert compute_metrics("regression", y, y)["r2"] == 1.0
+    mean_pred = np.full_like(y, y.mean())
+    assert abs(compute_metrics("regression", mean_pred, y)["r2"]) < 1e-12

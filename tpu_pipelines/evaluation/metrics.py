@@ -77,11 +77,23 @@ def _binary_metrics(scores: np.ndarray, labels: np.ndarray) -> Dict[str, float]:
     tp = float(np.sum((pred == 1) & (labels == 1)))
     fp = float(np.sum((pred == 1) & (labels == 0)))
     fn = float(np.sum((pred == 0) & (labels == 1)))
+    precision = tp / (tp + fp) if tp + fp else 0.0
+    recall = tp / (tp + fn) if tp + fn else 0.0
     out = {
         "loss": loss,
         "accuracy": float(np.mean(pred == labels)),
-        "precision": tp / (tp + fp) if tp + fp else 0.0,
-        "recall": tp / (tp + fn) if tp + fn else 0.0,
+        "precision": precision,
+        "recall": recall,
+        "f1": (
+            2 * precision * recall / (precision + recall)
+            if precision + recall else 0.0
+        ),
+        # Calibration at the coarsest grain (TFMA's calibration metric):
+        # mean predicted probability over the label base rate — 1.0 is
+        # perfectly calibrated in aggregate.
+        "calibration": (
+            float(probs.mean() / labels.mean()) if labels.mean() else 0.0
+        ),
     }
     n_pos, n_neg = int(labels.sum()), int(len(labels) - labels.sum())
     if n_pos and n_neg:
@@ -101,6 +113,14 @@ def _binary_metrics(scores: np.ndarray, labels: np.ndarray) -> Dict[str, float]:
             i = j + 1
         auc = (ranks[labels == 1].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
         out["auc"] = float(auc)
+        # PR-AUC by average precision (step-wise integral of the PR curve
+        # in descending-score order — the TFMA/sklearn AP definition).
+        desc = np.argsort(-scores, kind="mergesort")
+        tp_cum = np.cumsum(labels[desc])
+        prec_at_k = tp_cum / np.arange(1, len(labels) + 1)
+        out["prauc"] = float(
+            (prec_at_k * labels[desc]).sum() / n_pos
+        )
     return out
 
 
@@ -110,17 +130,40 @@ def _multiclass_metrics(logits: np.ndarray, labels: np.ndarray) -> Dict[str, flo
     logp = z - np.log(np.exp(z).sum(axis=-1, keepdims=True))
     loss = float(-np.mean(logp[np.arange(len(labels)), labels]))
     pred = logits.argmax(axis=-1)
-    return {"loss": loss, "accuracy": float(np.mean(pred == labels))}
+    out = {"loss": loss, "accuracy": float(np.mean(pred == labels))}
+    n_classes = logits.shape[-1]
+    if n_classes > 2:
+        k = min(5, n_classes - 1)
+        topk = np.argsort(-logits, axis=-1)[:, :k]
+        out[f"top{k}_accuracy"] = float(
+            np.mean((topk == labels[:, None]).any(axis=-1))
+        )
+        # Macro F1 over classes present in labels or predictions.
+        f1s = []
+        for c in range(n_classes):
+            tp = float(np.sum((pred == c) & (labels == c)))
+            fp = float(np.sum((pred == c) & (labels != c)))
+            fn = float(np.sum((pred != c) & (labels == c)))
+            if tp + fp + fn == 0:
+                continue            # class absent everywhere: skip, not 0
+            f1s.append(2 * tp / (2 * tp + fp + fn) if tp else 0.0)
+        if f1s:
+            out["macro_f1"] = float(np.mean(f1s))
+    return out
 
 
 def _regression_metrics(preds: np.ndarray, labels: np.ndarray) -> Dict[str, float]:
     preds = preds.astype(np.float64)
     labels = labels.astype(np.float64)
     err = preds - labels
-    return {
+    out = {
         "mse": float(np.mean(err ** 2)),
         "mae": float(np.mean(np.abs(err))),
     }
+    var = float(np.mean((labels - labels.mean()) ** 2))
+    if var > 0:
+        out["r2"] = float(1.0 - np.mean(err ** 2) / var)
+    return out
 
 
 def compute_metrics(

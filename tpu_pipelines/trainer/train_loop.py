@@ -313,15 +313,25 @@ def train_loop(
                 m_acc = {k: m_acc[k] + v for k, v in metrics.items()}
                 return (g_acc, l_acc + loss, m_acc, mstate), None
 
-            loss0, metrics0, grads0, mstate0 = forward_backward(
-                state.params, state.model_state,
-                jax.tree_util.tree_map(lambda x: x[0], micro),
-                jax.random.fold_in(step_rng, 0),
+            # Zero-seeded carry via eval_shape: tracing the forward once for
+            # shapes only, so the fwd+bwd graph compiles ONCE (as the scan
+            # body) instead of once unrolled + once scanned.
+            out_shape = jax.eval_shape(
+                lambda: forward_backward(
+                    state.params, state.model_state,
+                    jax.tree_util.tree_map(lambda x: x[0], micro),
+                    jax.random.fold_in(step_rng, 0),
+                )
             )
-            rest = jax.tree_util.tree_map(lambda x: x[1:], micro)
+            loss_s, metrics_s, grads_s, _ = out_shape
+            zeros = lambda tree: jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype), tree
+            )
             (g_sum, l_sum, m_sum, new_mstate), _ = jax.lax.scan(
-                mb_step, (grads0, loss0, metrics0, mstate0),
-                (jnp.arange(1, accum), rest),
+                mb_step,
+                (zeros(grads_s), zeros(loss_s), zeros(metrics_s),
+                 state.model_state),
+                (jnp.arange(accum), micro),
             )
             inv = 1.0 / accum
             grads = jax.tree_util.tree_map(lambda g: g * inv, g_sum)
