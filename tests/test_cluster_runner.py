@@ -321,3 +321,51 @@ def test_tuner_trial_shards_in_workflow(tmp_path):
         assert f"({tn}.Succeeded || {tn}.Failed || {tn}.Errored)" in depends
     env = {e["name"]: e["value"] for e in templates["tuner"]["container"]["env"]}
     assert env["TPP_TUNER_SHARD_DIR"] == "/pipeline/root/.tuner_shards/Tuner"
+
+
+def test_adaptive_tuner_with_shards_rejected_at_compile(tmp_path):
+    """algorithm='tpe' + trial_shards must fail at manifest compile time,
+    not inside every emitted shard pod at runtime."""
+    import pytest
+    import textwrap
+
+    from tpu_pipelines.orchestration import TPUJobRunner, TPUJobRunnerConfig
+    from tpu_pipelines.utils.module_loader import load_fn
+
+    csv = tmp_path / "d.csv"
+    csv.write_text("a\n1\n2\n")
+    trainer_mod = tmp_path / "toy_trainer_adapt.py"
+    trainer_mod.write_text(textwrap.dedent("""
+        from tpu_pipelines.trainer.fn_args import TrainResult
+        def run_fn(fn_args):
+            return TrainResult(final_metrics={"loss": 0.0},
+                               steps_completed=1)
+    """))
+    mod = tmp_path / "adaptive_pipeline.py"
+    mod.write_text(textwrap.dedent(f"""
+        from tpu_pipelines.components import CsvExampleGen, Tuner
+        from tpu_pipelines.dsl.pipeline import Pipeline
+
+        def create_pipeline():
+            gen = CsvExampleGen(input_path={str(csv)!r})
+            tuner = Tuner(
+                examples=gen.outputs["examples"],
+                module_file={str(trainer_mod)!r},
+                search_space={{"x": [1, 2, 3]}},
+                algorithm="tpe",
+                trial_shards=2,
+            )
+            return Pipeline(
+                "adaptive-fanout", [tuner],
+                pipeline_root="/pipeline/root",
+                metadata_path="/pipeline/md.sqlite",
+            )
+    """))
+    pipeline = load_fn(str(mod), "create_pipeline")()
+    with pytest.raises(ValueError, match="enumerable algorithm"):
+        TPUJobRunner(TPUJobRunnerConfig(
+            image="img:latest",
+            pipeline_module="/app/adaptive_pipeline.py",
+            output_dir=str(tmp_path / "manifests"),
+            shared_volume_claim="shared-pvc",
+        )).run(pipeline)

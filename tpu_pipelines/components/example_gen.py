@@ -11,6 +11,7 @@ is vectorized over a string join of the row.
 from __future__ import annotations
 
 import os
+import time
 from typing import Dict
 
 import numpy as np
@@ -156,6 +157,7 @@ def CsvExampleGen(ctx):
     else:
         files = [path]
     out = ctx.output("examples")
+    t0 = time.monotonic()
     total_bytes = sum(os.path.getsize(f) for f in files)
     if total_bytes > threshold:
         first = pacsv.open_csv(files[0], convert_options=convert)
@@ -197,7 +199,13 @@ def CsvExampleGen(ctx):
     if version is not None:
         out.properties["version"] = version
     n = sum(counts.values())
-    props = {"num_examples": n, **{f"rows_{k}": v for k, v in counts.items()}}
+    elapsed = max(1e-9, time.monotonic() - t0)
+    props = {
+        "num_examples": n,
+        # Observability parity with the per-stage counters Beam jobs expose.
+        "ingest_rows_per_sec": round(n / elapsed, 1),
+        **{f"rows_{k}": v for k, v in counts.items()},
+    }
     if span is not None:
         props["span"] = span
     if version is not None:
@@ -297,6 +305,7 @@ def ImportExampleGen(ctx):
     """
     path = ctx.exec_properties["input_path"]
     out = ctx.output("examples")
+    t0 = time.monotonic()
     counts: Dict[str, int] = {}
     if os.path.isdir(path):
         import pyarrow.parquet as pq
@@ -346,4 +355,10 @@ def ImportExampleGen(ctx):
         raise ValueError(f"unsupported import source: {path!r}")
     out.properties["split_names"] = sorted(counts)
     out.properties["split_counts"] = counts
-    return {"num_examples": sum(counts.values())}
+    n = sum(counts.values())
+    return {
+        "num_examples": n,
+        "ingest_rows_per_sec": round(
+            n / max(1e-9, time.monotonic() - t0), 1
+        ),
+    }

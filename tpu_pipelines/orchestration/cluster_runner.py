@@ -147,6 +147,19 @@ class TPUJobRunner:
             v = int(v)
         except (TypeError, ValueError):
             return 0
+        if v > 1:
+            algo = node.exec_properties.get("algorithm", "grid")
+            # A literal adaptive algorithm can NEVER run with shard fan-out
+            # (sequential-by-round; the Tuner rejects it at runtime) — fail
+            # at compile time instead of in every emitted shard pod.  A
+            # RuntimeParameter algorithm is deferred to the runtime check:
+            # its launch-time value may be either way, so compile cannot
+            # decide for it.
+            if not is_runtime_param(algo) and algo not in ("grid", "random"):
+                raise ValueError(
+                    f"Tuner node {node.id!r}: trial_shards={v} requires an "
+                    f"enumerable algorithm (grid/random), got {algo!r}"
+                )
         return v if v > 1 else 0
 
     @staticmethod
