@@ -1,0 +1,129 @@
+"""GPipe pipeline parallelism == sequential stage application (fwd + grad)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_pipelines.parallel.mesh import MeshConfig, make_mesh
+from tpu_pipelines.parallel.pipeline_parallel import gpipe
+
+
+def _mlp_stage(params, x):
+    """One residual MLP stage: shape/dtype-preserving."""
+    return x + jnp.tanh(x @ params["w"]) @ params["v"]
+
+
+def _stacked_params(s, d, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "w": (rng.normal(size=(s, d, d)) * 0.3).astype(np.float32),
+        "v": (rng.normal(size=(s, d, d)) * 0.3).astype(np.float32),
+    }
+
+
+def _sequential(params, x):
+    for i in range(params["w"].shape[0]):
+        x = _mlp_stage(
+            {k: v[i] for k, v in params.items()}, x
+        )
+    return x
+
+
+@pytest.mark.parametrize("stages,micro", [(4, 4), (4, 6), (2, 6)])
+def test_gpipe_matches_sequential(stages, micro):
+    mesh = make_mesh(MeshConfig(data=8 // stages, pipe=stages))
+    d, b = 16, 24
+    params = _stacked_params(stages, d)
+    x = np.random.default_rng(1).normal(size=(b, d)).astype(np.float32)
+    want = _sequential(params, jnp.asarray(x))
+
+    sp = jax.tree_util.tree_map(
+        lambda p: jax.device_put(p, NamedSharding(mesh, P("pipe"))), params
+    )
+    got = jax.jit(
+        lambda p, x: gpipe(
+            _mlp_stage, p, x, mesh=mesh, num_microbatches=micro
+        )
+    )(sp, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gpipe_grad_matches_sequential():
+    """The backward pipeline (transposed ppermutes) computes the same
+    parameter gradients as differentiating the sequential composition."""
+    stages, micro, d, b = 4, 4, 8, 16
+    mesh = make_mesh(MeshConfig(data=2, pipe=stages))
+    params = _stacked_params(stages, d, seed=2)
+    x = np.random.default_rng(3).normal(size=(b, d)).astype(np.float32)
+
+    def loss_p(p):
+        return gpipe(
+            _mlp_stage, p, jnp.asarray(x), mesh=mesh, num_microbatches=micro
+        ).sum()
+
+    def loss_s(p):
+        return _sequential(p, jnp.asarray(x)).sum()
+
+    gp = jax.jit(jax.grad(loss_p))(params)
+    gs = jax.jit(jax.grad(loss_s))(params)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(gp[k]), np.asarray(gs[k]), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_gpipe_transformer_stages_match_direct():
+    """Four transformer blocks as four pipeline stages reproduce the plain
+    layer-by-layer forward — PP on the real model building block."""
+    from tpu_pipelines.models.transformer import TransformerBlock
+
+    stages, d_model, seq, b = 4, 16, 8, 8
+    block = TransformerBlock(
+        n_heads=2, head_dim=8, d_ff=32, dropout_rate=0.0,
+        dtype=jnp.float32,
+    )
+    x = np.random.default_rng(4).normal(
+        size=(b, seq, d_model)
+    ).astype(np.float32)
+    keys = jax.random.split(jax.random.key(0), stages)
+    per_stage = [
+        block.init(keys[i], jnp.asarray(x))["params"] for i in range(stages)
+    ]
+    stacked = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage
+    )
+
+    want = jnp.asarray(x)
+    for i in range(stages):
+        want = block.apply({"params": per_stage[i]}, want)
+
+    mesh = make_mesh(MeshConfig(data=2, pipe=stages))
+    sp = jax.tree_util.tree_map(
+        lambda p: jax.device_put(
+            p, NamedSharding(mesh, P("pipe", *([None] * (p.ndim - 1))))
+        ),
+        stacked,
+    )
+
+    def stage_fn(params, act):
+        return block.apply({"params": params}, act)
+
+    got = jax.jit(
+        lambda p, x: gpipe(stage_fn, p, x, mesh=mesh, num_microbatches=4)
+    )(sp, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_gpipe_rejects_indivisible_microbatches():
+    mesh = make_mesh(MeshConfig(data=2, pipe=4))
+    params = _stacked_params(4, 8)
+    with pytest.raises(ValueError, match="divisible"):
+        gpipe(
+            _mlp_stage, params,
+            jnp.zeros((10, 8), jnp.float32),
+            mesh=mesh, num_microbatches=4,
+        )

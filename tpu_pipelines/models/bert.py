@@ -39,6 +39,10 @@ class BertEncoder(nn.Module):
     dtype: Any = jnp.bfloat16
     attn_impl: str = "dense"
     mesh: Optional[Mesh] = None
+    # > 0 makes every other layer (odd i — the Switch convention) a
+    # mixture-of-experts MLP with this many experts, expert-parallel over
+    # the mesh ``expert`` axis.
+    moe_experts: int = 0
 
     @nn.compact
     def __call__(
@@ -73,6 +77,7 @@ class BertEncoder(nn.Module):
                 mesh=self.mesh,
                 causal=False,
                 prenorm=False,          # original BERT is post-LN
+                moe_experts=self.moe_experts if i % 2 == 1 else 0,
                 name=f"layer_{i}",
             )(x, kv_mask=attention_mask, deterministic=deterministic)
         return x
@@ -139,6 +144,7 @@ DEFAULT_HPARAMS = {
     "dropout_rate": 0.1,
     "num_classes": 2,
     "attn_impl": "auto",
+    "moe_experts": 0,
     "learning_rate": 3e-5,
     "batch_size": 64,
     "head": "classifier",     # or "mlm"
@@ -158,6 +164,7 @@ def build_bert_model(hparams: Dict, mesh: Optional[Mesh] = None):
         dropout_rate=float(hp["dropout_rate"]),
         attn_impl=str(hp["attn_impl"]),
         mesh=mesh,
+        moe_experts=int(hp.get("moe_experts", 0)),
     )
     if hp["head"] == "mlm":
         return BertMLMHead(encoder=encoder)

@@ -20,6 +20,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import linen as nn
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -55,6 +56,104 @@ class MlpBlock(nn.Module):
         out = nn.Dense(d_model, dtype=self.dtype, name="wo")(h)
         if self.dropout_rate and self.dropout_site == "output":
             out = nn.Dropout(self.dropout_rate)(out, deterministic=deterministic)
+        return out
+
+
+class MoEMlpBlock(nn.Module):
+    """Switch-Transformer-style mixture-of-experts MLP (expert parallelism).
+
+    Top-1 routing with a fixed per-expert capacity, implemented as DENSE
+    dispatch/combine einsums over a [tokens, experts, capacity] one-hot —
+    the Mesh-TF/Switch algorithm: no ragged shapes, everything tiles onto
+    the MXU, and sharding the expert dim of ``wi``/``wo`` over the mesh
+    ``expert`` axis (TRANSFORMER_PARTITION_RULES) makes XLA insert the
+    dispatch all-to-alls from the shardings alone — no hand-written
+    collectives, consistent with the rest of this module.
+
+    Tokens routed past an expert's capacity are DROPPED (output zero);
+    the surrounding residual connection carries them through unchanged —
+    standard Switch behavior.  Routing is PER GROUP (default: one group
+    per sequence row, the Mesh-TF convention): capacity and the dispatch
+    one-hot scale with the group size, not the whole flattened batch, so
+    dispatch cost stays linear in total tokens.  The load-balancing
+    auxiliary loss (E * sum over experts of token_fraction * prob_fraction;
+    1.0 at perfect balance) is sown into the ``losses`` collection as
+    ``moe_aux_loss``; training objectives that want it add
+    ``aux_weight * (sum of sown values)``.
+    """
+
+    num_experts: int
+    d_ff: int
+    capacity_factor: float = 1.25
+    dtype: Dtype = jnp.bfloat16
+    activation: str = "gelu"
+    dropout_rate: float = 0.0
+    group_size: int = 0     # tokens per routing group; 0 = sequence length
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        b, l, d = x.shape
+        n = b * l
+        e = self.num_experts
+        g_size = self.group_size or l
+        if n % g_size:
+            raise ValueError(
+                f"{n} tokens not divisible by MoE group_size {g_size}"
+            )
+        n_groups = n // g_size
+        t = x.reshape(n_groups, g_size, d)
+        # Router in f32: tiny matmul, and argmax ties/softmax stability
+        # matter more than MXU throughput here.
+        logits = nn.Dense(e, dtype=jnp.float32, name="router")(
+            t.astype(jnp.float32)
+        )
+        probs = jax.nn.softmax(logits, axis=-1)            # [G, g, e]
+        expert = jnp.argmax(probs, axis=-1)                # [G, g]
+        gate = jnp.take_along_axis(probs, expert[..., None], axis=-1)[..., 0]
+
+        capacity = max(1, int(np.ceil(self.capacity_factor * g_size / e)))
+        sel = jax.nn.one_hot(expert, e, dtype=jnp.int32)   # [G, g, e]
+        # Position of each token in its expert's per-group queue.
+        pos = jnp.cumsum(sel, axis=1) * sel                # 1-based where sel
+        pos_in_expert = pos.sum(axis=-1) - 1               # [G, g], -1 if none
+        keep = (pos_in_expert >= 0) & (pos_in_expert < capacity)
+        dispatch = (
+            sel.astype(self.dtype)[..., None]
+            * jax.nn.one_hot(
+                jnp.where(keep, pos_in_expert, capacity),
+                capacity, dtype=self.dtype,
+            )[:, :, None, :]
+        )                                                   # [G, g, e, c]
+
+        wi = self.param(
+            "wi", nn.initializers.lecun_normal(), (e, d, self.d_ff)
+        ).astype(self.dtype)
+        wo = self.param(
+            "wo", nn.initializers.lecun_normal(), (e, self.d_ff, d)
+        ).astype(self.dtype)
+        expert_in = jnp.einsum(
+            "gnec,gnd->gecd", dispatch, t.astype(self.dtype)
+        )
+        h = getattr(nn, self.activation)(
+            jnp.einsum("gecd,edf->gecf", expert_in, wi)
+        )
+        expert_out = jnp.einsum("gecf,efd->gecd", h, wo)
+        combine = dispatch * gate.astype(self.dtype)[..., None, None]
+        out = jnp.einsum("gnec,gecd->gnd", combine, expert_out)
+
+        # Switch aux loss: e * sum_e(fraction_of_tokens * mean_router_prob).
+        frac_tokens = sel.astype(jnp.float32).mean(axis=(0, 1))  # [e]
+        frac_probs = probs.mean(axis=(0, 1))                     # [e]
+        self.sow(
+            "losses", "moe_aux_loss",
+            e * jnp.sum(frac_tokens * frac_probs),
+        )
+        out = out.reshape(b, l, d)
+        if self.dropout_rate:
+            # Same output-site dropout as the dense MlpBlock it replaces.
+            out = nn.Dropout(self.dropout_rate)(
+                out, deterministic=deterministic
+            )
         return out
 
 
@@ -224,6 +323,10 @@ class TransformerBlock(nn.Module):
     use_cross: bool = False
     norm: str = "layernorm"   # "layernorm" (BERT) or "rmsnorm" (T5)
     mlp_dropout_site: str = "output"   # see MlpBlock.dropout_site
+    # > 0 replaces the dense MLP with a MoEMlpBlock of this many experts
+    # (expert-parallel over the mesh ``expert`` axis).
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(
@@ -261,10 +364,19 @@ class TransformerBlock(nn.Module):
                 h, encoded, kv_mask=enc_mask, deterministic=deterministic,
                 decode_pos=decode_pos,
             ))
-        x = sub(x, "mlp", lambda h: MlpBlock(
-            d_ff=self.d_ff, dropout_rate=self.dropout_rate,
-            dtype=self.dtype, dropout_site=self.mlp_dropout_site, name="mlp",
-        )(h, deterministic=deterministic))
+        if self.moe_experts > 0:
+            x = sub(x, "mlp", lambda h: MoEMlpBlock(
+                num_experts=self.moe_experts, d_ff=self.d_ff,
+                capacity_factor=self.moe_capacity_factor,
+                dropout_rate=self.dropout_rate,
+                dtype=self.dtype, name="moe",
+            )(h, deterministic=deterministic))
+        else:
+            x = sub(x, "mlp", lambda h: MlpBlock(
+                d_ff=self.d_ff, dropout_rate=self.dropout_rate,
+                dtype=self.dtype, dropout_site=self.mlp_dropout_site,
+                name="mlp",
+            )(h, deterministic=deterministic))
         return x
 
 
@@ -278,6 +390,10 @@ TRANSFORMER_PARTITION_RULES = [
     (r"cross/out/kernel", P("model", None, None)),
     (r"mlp/wi/kernel", P(None, "model")),
     (r"mlp/wo/kernel", P("model", None)),
+    # MoE experts shard over `expert` (EP), their ff dim over `model` (TP);
+    # the router stays replicated (tiny).
+    (r"moe/wi", P("expert", None, "model")),
+    (r"moe/wo", P("expert", "model", None)),
     # token embeddings only (vocab dim sharded); positional/type tables are
     # small and replicate — (^|/) anchors to a whole path segment so
     # e.g. "type_embed" does not match.

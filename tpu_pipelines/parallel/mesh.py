@@ -2,9 +2,11 @@
 
 The reference's only scaling axis is synchronous data parallelism
 (``MultiWorkerMirroredStrategy`` — SURVEY.md §2c); here that is batch-dim
-sharding over the mesh's ``data`` axis, with gradient ``psum`` emitted by XLA.
-The mesh keeps extra named axes (``model``, ``seq``) so tensor/sequence
-parallelism for the BERT/T5 configs slots in without reshaping the design.
+sharding over the mesh's ``data`` axis, with gradient ``psum`` emitted by
+XLA.  The mesh carries the full set of named parallelism axes — ``model``
+(TP), ``seq`` (ring/ulysses SP), ``expert`` (MoE EP), ``pipe`` (GPipe PP)
+— all defaulting to 1, so any combination slots in without reshaping the
+design.
 """
 
 from __future__ import annotations
@@ -18,8 +20,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # Canonical axis names, in fixed order.  data = batch/DP, model = tensor
-# parallelism, seq = sequence/context parallelism.
-AXES = ("data", "model", "seq")
+# parallelism, seq = sequence/context parallelism, expert = MoE expert
+# parallelism, pipe = pipeline-stage parallelism.
+AXES = ("data", "model", "seq", "expert", "pipe")
 
 
 @dataclasses.dataclass
@@ -29,9 +32,12 @@ class MeshConfig:
     data: int = -1      # -1 = all remaining devices
     model: int = 1
     seq: int = 1
+    expert: int = 1
+    pipe: int = 1
 
     def resolve(self, n_devices: int) -> Dict[str, int]:
-        sizes = {"data": self.data, "model": self.model, "seq": self.seq}
+        sizes = {"data": self.data, "model": self.model, "seq": self.seq,
+                 "expert": self.expert, "pipe": self.pipe}
         fixed = math.prod(v for v in sizes.values() if v > 0)
         free = [k for k, v in sizes.items() if v == -1]
         if len(free) > 1:
