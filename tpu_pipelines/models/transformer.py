@@ -78,8 +78,11 @@ class MoEMlpBlock(nn.Module):
     dispatch cost stays linear in total tokens.  The load-balancing
     auxiliary loss (E * sum over experts of token_fraction * prob_fraction;
     1.0 at perfect balance) is sown into the ``losses`` collection as
-    ``moe_aux_loss``; training objectives that want it add
-    ``aux_weight * (sum of sown values)``.
+    ``moe_aux_loss`` — training objectives MUST consume it or routing can
+    collapse onto one expert; use :func:`apply_with_moe_aux` in a loss_fn:
+
+        logits, aux = apply_with_moe_aux(model, {"params": p}, batch, ...)
+        loss = task_loss(logits) + 0.01 * aux
     """
 
     num_experts: int
@@ -125,11 +128,18 @@ class MoEMlpBlock(nn.Module):
             )[:, :, None, :]
         )                                                   # [G, g, e, c]
 
+        # batch_axis=0: fan is computed PER EXPERT slice — plain
+        # lecun_normal would count the expert dim as receptive field and
+        # under-scale every expert by sqrt(e) vs the dense MLP it replaces.
+        expert_init = nn.initializers.variance_scaling(
+            1.0, "fan_in", "truncated_normal", in_axis=-2, out_axis=-1,
+            batch_axis=0,
+        )
         wi = self.param(
-            "wi", nn.initializers.lecun_normal(), (e, d, self.d_ff)
+            "wi", expert_init, (e, d, self.d_ff)
         ).astype(self.dtype)
         wo = self.param(
-            "wo", nn.initializers.lecun_normal(), (e, self.d_ff, d)
+            "wo", expert_init, (e, self.d_ff, d)
         ).astype(self.dtype)
         expert_in = jnp.einsum(
             "gnec,gnd->gecd", dispatch, t.astype(self.dtype)
@@ -155,6 +165,20 @@ class MoEMlpBlock(nn.Module):
                 out, deterministic=deterministic
             )
         return out
+
+
+def apply_with_moe_aux(model, variables, *args, **kwargs):
+    """``model.apply`` that also returns the summed MoE auxiliary loss.
+
+    The supported way to train MoE models: runs apply with the ``losses``
+    collection mutable and sums every sown ``moe_aux_loss`` (one per MoE
+    layer; 0.0 when the model has none), so loss functions can add
+    ``aux_weight * aux`` without touching flax collection plumbing.
+    """
+    out, state = model.apply(variables, *args, mutable=["losses"], **kwargs)
+    leaves = jax.tree_util.tree_leaves(state.get("losses", {}))
+    aux = sum(leaves) if leaves else jnp.zeros((), jnp.float32)
+    return out, aux
 
 
 class MultiHeadAttention(nn.Module):
