@@ -362,16 +362,9 @@ def bench_t5_decode(smoke: bool) -> dict:
     return out
 
 
-def bench_pipeline_e2e(smoke: bool) -> dict:
-    """End-to-end pipeline wall-clock — the second BASELINE metric
-    ("TFX Trainer examples/sec/chip; end-to-end pipeline wall-clock").
-
-    Runs the canonical taxi DAG (CsvExampleGen -> Stats -> Schema ->
-    Validator -> Transform -> Trainer -> Evaluator -> InfraValidator ->
-    Pusher, examples/taxi/pipeline.py) fresh (empty pipeline home, so no
-    execution-cache hits) under LocalDagRunner, and reports total
-    wall-clock plus the per-component breakdown the metadata store records.
-    """
+def _run_example_pipeline(name: str, env: dict) -> dict:
+    """One example pipeline end-to-end in a fresh home (no cache hits);
+    returns total wall-clock + the per-component breakdown."""
     import tempfile
 
     from tpu_pipelines.orchestration import LocalDagRunner
@@ -379,11 +372,10 @@ def bench_pipeline_e2e(smoke: bool) -> dict:
 
     module = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
-        "examples", "taxi", "pipeline.py",
+        "examples", name, "pipeline.py",
     )
-    steps = "4" if smoke else "200"
-    saved = {k: os.environ.get(k) for k in ("TAXI_TRAIN_STEPS",)}
-    os.environ["TAXI_TRAIN_STEPS"] = steps
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
     try:
         with tempfile.TemporaryDirectory() as td:
             pipeline = load_fn(module, "create_pipeline")(td)
@@ -397,15 +389,35 @@ def bench_pipeline_e2e(smoke: bool) -> dict:
             else:
                 os.environ[k] = v
     return {
-        "pipeline": "taxi",
         "green": result.succeeded,
         "wall_clock_s": round(total, 2),
-        "train_steps": int(steps),
+        "env": env,
         "nodes": {
             nid: {"status": nr.status, "wall_s": round(nr.wall_clock_s, 2)}
             for nid, nr in result.nodes.items()
         },
     }
+
+
+def bench_pipeline_e2e(smoke: bool) -> dict:
+    """End-to-end pipeline wall-clock — the second BASELINE metric, for
+    BOTH north-star configs ("Chicago-Taxi and BERT-base pipelines green
+    on v5e"): the canonical 9-node taxi DAG and the BERT-base fine-tune
+    DAG (tokenizing Transform -> Trainer -> Evaluator -> Pusher), each in
+    a fresh pipeline home under LocalDagRunner.  The two run under
+    separate guards so one failing cannot discard the other's evidence.
+    """
+    out: dict = {}
+    taxi_env = {"TAXI_TRAIN_STEPS": "4" if smoke else "200"}
+    bert_env = {"BERT_TRAIN_STEPS": "4" if smoke else "30"}
+    if smoke:
+        bert_env["BERT_TINY"] = "1"
+    for name, env in (("taxi", taxi_env), ("bert", bert_env)):
+        try:
+            out[name] = _run_example_pipeline(name, env)
+        except Exception as e:  # noqa: BLE001 — isolate per pipeline
+            out[name] = {"green": False, "error": _clean_err(str(e))}
+    return out
 
 
 def bench_flash_probe(smoke: bool) -> dict:

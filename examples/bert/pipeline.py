@@ -37,7 +37,10 @@ def _ensure_data(base: str) -> str:
         neg = ["terrible boring mess", "awful waste dull",
                "boring and awful", "dull terrible film"]
         rows = ["text,label"]
-        for i in range(512):
+        # Enough rows that the ~1/3 eval split clears BERT_BASE's batch of
+        # 256 under drop_remainder — 512 rows left eval at ~200 and the
+        # full-geometry pipeline failed out of the box.
+        for i in range(1536):
             bank, label = (pos, 1) if i % 2 == 0 else (neg, 0)
             rows.append(f'"{bank[rng.integers(len(bank))]}",{label}')
         with open(path, "w") as f:

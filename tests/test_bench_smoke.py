@@ -40,6 +40,8 @@ def test_bench_smoke_emits_full_report():
     # On a healthy host the smoke workloads all succeed outright.
     assert report["errors"] == {}, report["errors"]
     assert report["value"] > 0
-    assert report["pipeline_e2e"]["green"] is True
-    assert report["pipeline_e2e"]["wall_clock_s"] > 0
-    assert len(report["pipeline_e2e"]["nodes"]) >= 9
+    for name, min_nodes in (("taxi", 9), ("bert", 4)):
+        e2e = report["pipeline_e2e"][name]
+        assert e2e["green"] is True, (name, e2e)
+        assert e2e["wall_clock_s"] > 0
+        assert len(e2e["nodes"]) >= min_nodes

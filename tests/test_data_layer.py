@@ -133,7 +133,8 @@ def test_mesh_and_shard_batch():
 
     assert len(jax.devices()) == 8  # conftest forces 8 CPU devices
     mesh = make_mesh(MeshConfig(data=-1))
-    assert mesh.shape == {"data": 8, "model": 1, "seq": 1}
+    assert mesh.shape == {"data": 8, "model": 1, "seq": 1,
+                          "expert": 1, "pipe": 1}
 
     batch = {"x": np.ones((16, 3), np.float32), "y": np.zeros((16,), np.int32)}
     on_dev = shard_batch(batch, mesh)
@@ -142,7 +143,8 @@ def test_mesh_and_shard_batch():
     assert shards[0].data.shape == (2, 3)  # 16/8 per device
 
     mesh2 = make_mesh(MeshConfig(data=-1, model=2))
-    assert mesh2.shape == {"data": 4, "model": 2, "seq": 1}
+    assert mesh2.shape == {"data": 4, "model": 2, "seq": 1,
+                           "expert": 1, "pipe": 1}
     with pytest.raises(ValueError, match="not divisible"):
         make_mesh(MeshConfig(data=-1, model=3))
 
