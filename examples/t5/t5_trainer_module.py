@@ -17,10 +17,12 @@ def build_model(hyperparameters):
     return build_t5_model(hyperparameters)
 
 
-def make_generate_fn(model, params, hyperparameters):
+def make_generate_step(model, hyperparameters):
     """Export hook (trainer/export.py): jitted beam-search decoding over
     transformed feature batches — the BulkInferrer predict_method="generate"
-    path.  Decode length/beam ride the exported hyperparameters."""
+    path.  Returns ``fn(params, batch)`` so the loader passes params as a jit
+    argument (never baked into the compiled program as constants).  Decode
+    length/beam ride the exported hyperparameters."""
     from tpu_pipelines.models.t5 import make_beam_generate
 
     # End-of-sequence is the tokenizer's [SEP] (id 3): tft.tokenize emits
@@ -34,7 +36,7 @@ def make_generate_fn(model, params, hyperparameters):
         eos_id=int(hyperparameters.get("eos_id", 3)),
     )
 
-    def fn(batch):
+    def fn(params, batch):
         mask = (
             jnp.asarray(batch["input_mask"], jnp.int32)
             if "input_mask" in batch else None

@@ -39,8 +39,9 @@ PREDICTIONS_FILE = "predictions"
         "raw_examples": Parameter(type=bool, default=True),
         # "forward": the model's forward pass (classification/regression).
         # "generate": autoregressive decoding for seq2seq models — requires
-        # the exported module to define make_generate_fn (models/t5.py
-        # make_greedy_generate / make_beam_generate build the decode fn).
+        # the exported module to define make_generate_step (or the legacy
+        # make_generate_fn; models/t5.py make_greedy_generate /
+        # make_beam_generate build the decode fn).
         "predict_method": Parameter(type=str, default="forward"),
     },
 )
@@ -60,7 +61,8 @@ def BulkInferrer(ctx):
         if loaded.generate is None:
             raise ValueError(
                 "predict_method='generate' but the exported module defines "
-                "no make_generate_fn(model, params, hyperparameters)"
+                "no make_generate_step(model, hyperparameters) (or legacy "
+                "make_generate_fn)"
             )
         if not ctx.exec_properties["raw_examples"] and loaded.transform:
             # loaded.generate runs the embedded transform; feeding it

@@ -154,7 +154,7 @@ class GrpcPredictionService:
     def Generate(self, request: "pb.PredictRequest", context):
         """Seq2seq decoding — same wire messages as Predict (inputs map ->
         token tensor); FAILED_PRECONDITION when the served payload has no
-        make_generate_fn hook."""
+        make_generate_step hook."""
         batch = self._decode_inputs(request, context)
         tokens = self._call(self._server.generate_batch, batch, context)
         return self._encode_response(tokens, context)

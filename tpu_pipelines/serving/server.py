@@ -8,7 +8,7 @@ jitted function — trainer/export.py), and answers TF-Serving-style REST:
     GET  /v1/models/<name>            -> version status
     POST /v1/models/<name>:predict    -> {"predictions": [...]}
     POST /v1/models/<name>:generate   -> {"outputs": [[token ids], ...]}
-         (seq2seq payloads exported with a make_generate_fn hook)
+         (seq2seq payloads exported with a make_generate_step hook)
          body: {"instances": [{feature: value, ...}, ...]}
          or    {"inputs": {feature: [values...], ...}}
 
@@ -38,7 +38,7 @@ log = logging.getLogger("tpu_pipelines.serving")
 
 class GenerateUnsupported(ValueError):
     """This server/payload cannot serve generate requests (no
-    make_generate_fn hook, or raw=False with an embedded transform)."""
+    make_generate_step hook, or raw=False with an embedded transform)."""
 
 
 def latest_version_dir(base_dir: str) -> Optional[str]:
@@ -176,7 +176,7 @@ class ModelServer:
         if loaded.generate is None:
             raise GenerateUnsupported(
                 f"model {self.model_name!r} does not support generate "
-                "(exported module has no make_generate_fn)"
+                "(exported module has no make_generate_step or legacy make_generate_fn)"
             )
         if not self.raw and loaded.transform is not None:
             # Same hazard bulk_inferrer.py rejects: loaded.generate applies
@@ -189,7 +189,7 @@ class ModelServer:
         return loaded.generate
 
     def generate_batch(self, batch: Dict[str, Any]) -> np.ndarray:
-        """Seq2seq decoding (models exported with a make_generate_fn hook —
+        """Seq2seq decoding (models exported with a make_generate_step hook —
         trainer/export.py) on a columnar feature batch: the shared entry for
         REST :generate and gRPC Generate.  Decoding batches whole requests
         (the beam/greedy fn is itself batched), so this path bypasses the

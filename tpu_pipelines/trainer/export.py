@@ -95,17 +95,56 @@ class LoadedModel:
     predict: Callable[[Dict[str, np.ndarray]], Any]
     predict_transformed: Callable[[Dict[str, np.ndarray]], Any]
     # Autoregressive generation (seq2seq models): present when the exported
-    # module defines ``make_generate_fn(model, params, hyperparameters)``
-    # returning a callable over TRANSFORMED feature batches (e.g. a jitted
-    # T5 beam/greedy decode from models/t5.py).  ``generate`` takes raw
-    # batches (host transform applied first); None for non-seq2seq models.
+    # module defines ``make_generate_step(model, hyperparameters)`` (preferred;
+    # returns ``fn(params, transformed_batch)``) or the legacy
+    # ``make_generate_fn(model, params, hyperparameters)``.  ``generate``
+    # takes raw batches (host transform applied first); None otherwise.
     generate: Optional[Callable[[Dict[str, np.ndarray]], Any]] = None
     # The two halves of `predict`, exposed for exporters (serving/
     # saved_model.py): host string stage (numpy, identity when no transform)
-    # and the single jitted device computation (numeric transform fused with
-    # the forward pass).
+    # and the device computation (numeric transform fused with the forward
+    # pass).  ``device_predict`` binds the loaded params, so tracing it
+    # (jax2tf) embeds the weights — correct for SavedModel export.
     host_preprocess: Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]] = None
     device_predict: Callable[[Dict[str, Any]], Any] = None
+    # The raw jitted step underlying predict/predict_transformed, taking
+    # ``(params, batch)``.  Params are ARGUMENTS of the compiled program —
+    # never closed over — so the compiled predict program is weight-free
+    # (a closure would bake every weight into the HLO as a literal constant:
+    # one copy per compiled entry point, and oversized compile payloads on
+    # remote-compile platforms).  Tested by test_export_no_weight_constants.
+    forward_step: Callable[[Any, Dict[str, Any]], Any] = None
+    device_step: Callable[[Any, Dict[str, Any]], Any] = None
+
+
+def restore_exported_params(uri: str) -> Any:
+    """Restore the params checkpoint of an exported payload, device-resident.
+
+    The checkpoint is restored against an abstract target reconstructed from
+    the checkpoint's own metadata (shape/dtype tree), avoiding orbax's
+    untyped-restore path and its UNSAFE warnings, then ``device_put`` once so
+    every subsequent jitted call ships no host arrays.
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(os.path.join(uri, CHECKPOINT_DIR))
+    with ocp.StandardCheckpointer() as ckptr:
+        try:
+            meta = ckptr.metadata(path).item_metadata.tree
+            sharding = jax.sharding.SingleDeviceSharding(
+                jax.local_devices()[0]
+            )
+            target = jax.tree.map(
+                lambda m: jax.ShapeDtypeStruct(
+                    tuple(m.shape), m.dtype, sharding=sharding
+                ),
+                meta,
+            )
+        except Exception:  # metadata layout drift across orbax versions
+            target = None
+        if target is not None:
+            return ckptr.restore(path, target)
+        return jax.device_put(ckptr.restore(path))
 
 
 def load_exported_model(uri: str) -> LoadedModel:
@@ -128,45 +167,53 @@ def load_exported_model(uri: str) -> LoadedModel:
         lambda model, params, batch: model.apply({"params": params}, batch),
     )
 
-    import orbax.checkpoint as ocp
-
-    with ocp.StandardCheckpointer() as ckptr:
-        params = ckptr.restore(
-            os.path.abspath(os.path.join(uri, CHECKPOINT_DIR))
-        )
+    params = restore_exported_params(uri)
 
     transform = None
     if spec.get("has_transform"):
         transform = TransformGraph.load(os.path.join(uri, TRANSFORM_DIR))
 
     @jax.jit
-    def _forward(transformed: Dict[str, Any]):
+    def _forward(params, transformed: Dict[str, Any]):
         return apply_fn(model, params, transformed)
 
     if transform is not None:
         host_fn, device_fn, _ = transform.split_host_device()
 
         @jax.jit
-        def _transform_and_forward(iface: Dict[str, Any]):
+        def _transform_and_forward(params, iface: Dict[str, Any]):
             # Numeric transform + model forward in ONE compiled computation.
             return apply_fn(model, params, device_fn(iface))
 
         def predict(raw_batch: Dict[str, np.ndarray]):
-            return _transform_and_forward(host_fn(raw_batch))
+            return _transform_and_forward(params, host_fn(raw_batch))
 
-        host_preprocess, device_predict = host_fn, _transform_and_forward
+        host_preprocess = host_fn
+        device_step = _transform_and_forward
     else:
         def predict(raw_batch: Dict[str, np.ndarray]):
-            return _forward(raw_batch)
+            return _forward(params, raw_batch)
 
-        host_preprocess, device_predict = (lambda b: b), _forward
+        host_preprocess = lambda b: b  # noqa: E731
+        device_step = _forward
 
     generate = None
+    step_builder = getattr(module, "make_generate_step", None)
     gen_builder = getattr(module, "make_generate_fn", None)
-    if gen_builder is not None:
+    if step_builder is not None:
+        # Preferred hook: fn(params, transformed_batch) — params stay a jit
+        # argument all the way down.
+        generate_step = step_builder(model, spec.get("hyperparameters", {}))
+        device_generate = lambda b: generate_step(params, b)  # noqa: E731
+    elif gen_builder is not None:
+        # Legacy hook closes over params inside the user module; still
+        # supported, but large models should migrate to make_generate_step.
         device_generate = gen_builder(
             model, params, spec.get("hyperparameters", {})
         )
+    else:
+        device_generate = None
+    if device_generate is not None:
         if transform is not None:
             _transform_dev = jax.jit(device_fn)
 
@@ -181,8 +228,10 @@ def load_exported_model(uri: str) -> LoadedModel:
         spec=spec,
         transform=transform,
         predict=predict,
-        predict_transformed=_forward,
+        predict_transformed=lambda batch: _forward(params, batch),
         host_preprocess=host_preprocess,
-        device_predict=device_predict,
+        device_predict=lambda batch: device_step(params, batch),
+        forward_step=_forward,
+        device_step=device_step,
         generate=generate,
     )
