@@ -45,3 +45,37 @@ def test_bench_smoke_emits_full_report():
         assert e2e["green"] is True, (name, e2e)
         assert e2e["wall_clock_s"] > 0
         assert len(e2e["nodes"]) >= min_nodes
+
+    # Survivability: every workload flushed the cumulative report (one line
+    # per flush, later lines strictly more complete), and the last flush is
+    # mirrored to BENCH_PARTIAL.json — what a SIGKILL would leave behind.
+    assert len(lines) >= 6, f"expected per-workload flushes, got {len(lines)}"
+    with open(os.path.join(REPO, "BENCH_PARTIAL.json")) as f:
+        assert json.load(f) == report
+    # The A100 comparison point is pinned with provenance (auditable ratio).
+    ref = report["a100_reference"]
+    assert ref["ex_per_sec"] > 0
+    assert "source" in ref and "provenance" in ref
+
+
+def test_bench_budget_skips_but_emits():
+    """BENCH_BUDGET_S=0: every leg must be skipped for budget, yet the
+    process still exits 0 with a parseable, self-describing report —
+    the driver-timeout path can never yield nothing again."""
+    env = {
+        **os.environ,
+        "BENCH_SMOKE": "1",
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_BUDGET_S": "0",
+    }
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")]
+    report = json.loads(lines[-1])
+    assert report["metric"] == "bench_failed"
+    assert report["taxi"]["skipped_budget"] is True
+    assert report["bert"]["skipped_budget"] is True
+    assert report["pipeline_e2e"]["bert"]["skipped_budget"] is True

@@ -126,6 +126,11 @@ class TrainResult:
     # device goodput (1.0 when the run was too short to measure).
     goodput: float = 0.0
     goodput_source: str = "host_input_wait_proxy"
+    # Goodput over the post-compile window only (1 - input-wait/elapsed,
+    # both measured after step 1 retires).  At bench scale the strict
+    # figure above is dominated by one-time compile; this one is the
+    # steady-state number a long run would converge to.
+    goodput_post_compile: float = 0.0
     # {badput_kind: fraction of job wall-clock}, e.g. {"tpu_initialization":
     # 0.02, "training_prep": 0.01, "data_loading_sync": 0.05, "other": ...}.
     badput: Dict[str, float] = dataclasses.field(default_factory=dict)

@@ -384,6 +384,13 @@ def train_loop(
             eval_step = jax.jit(eval_fn, in_shardings=(p_shard, batch_shard))
 
     # ---- checkpoint manager (resume support)
+    # TPP_DISABLE_MID_CHECKPOINT=1 suppresses mid-run saves regardless of
+    # config (bench legs: orbax's blocking wait-for-previous-save serializes
+    # against µs-scale steps and burns the wall-clock budget); the final
+    # checkpoint is still written, so export and resume behave the same.
+    checkpoint_every = config.checkpoint_every
+    if os.environ.get("TPP_DISABLE_MID_CHECKPOINT", "") == "1":
+        checkpoint_every = 0
     mngr = None
     start_step = 0
     if checkpoint_dir:
@@ -393,7 +400,7 @@ def train_loop(
             os.path.abspath(checkpoint_dir),
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=config.keep_checkpoints,
-                save_interval_steps=max(1, config.checkpoint_every),
+                save_interval_steps=max(1, checkpoint_every),
             ),
         )
         latest = mngr.latest_step()
@@ -505,7 +512,7 @@ def train_loop(
                 metrics_cb(step, host_metrics)
             tb_write("train", step, host_metrics)
             log.info("step %d: %s", step, host_metrics)
-        if mngr is not None and config.checkpoint_every:
+        if mngr is not None and checkpoint_every:
             mngr.save(step, args=_ocp_save_args(state))
         if (
             eval_step is not None
@@ -608,6 +615,7 @@ def train_loop(
         goodput_source=(
             "ml_goodput_measurement" if gsum else "host_input_wait_proxy"
         ),
+        goodput_post_compile=proxy_goodput,
         badput=gsum.get("badput", {}),
     )
     final = (
