@@ -258,3 +258,110 @@ def test_value_count_pinned_by_first_chunk(tmp_path):
         list(record_io.tf_example_batches(
             record_io.iter_tfrecords(path), batch_rows=2
         ))
+
+
+def test_crc_verification_catches_payload_bitflip(tmp_path):
+    """ADVICE r3: a bit flip inside a packed payload parses cleanly, so the
+    masked crc32c fields are the format's only integrity check — verify
+    them by default, exactly like the reference readers."""
+    path = str(tmp_path / "ok.tfrecord")
+    _write_tfrecord(path, 4)
+    # Sanity: the untouched file passes verification.
+    assert len(list(record_io.iter_tfrecords(path))) == 4
+
+    data = bytearray(open(path, "rb").read())
+    # Flip one bit inside the FIRST record's payload (after the 12-byte
+    # header), leaving framing intact.
+    data[20] ^= 0x01
+    bad = str(tmp_path / "bad.tfrecord")
+    open(bad, "wb").write(bytes(data))
+    with pytest.raises(ValueError, match="payload-crc mismatch"):
+        list(record_io.iter_tfrecords(bad))
+    # Opt-out still reads it (trusted-source fast path).
+    assert len(list(record_io.iter_tfrecords(bad, verify_crc=False))) == 4
+
+
+def test_crc_verification_catches_corrupt_length(tmp_path):
+    """A corrupt length field must fail on the length-crc (or the sanity
+    cap), never trigger an unbounded allocation."""
+    path = str(tmp_path / "ok.tfrecord")
+    _write_tfrecord(path, 2)
+    data = bytearray(open(path, "rb").read())
+    data[6] = 0x7F  # blow up the u64le length field
+    bad = str(tmp_path / "badlen.tfrecord")
+    open(bad, "wb").write(bytes(data))
+    with pytest.raises(ValueError, match="length-crc mismatch"):
+        list(record_io.iter_tfrecords(bad))
+    # Even unverified, the sanity cap rejects it before allocating.
+    with pytest.raises(ValueError, match="exceeds"):
+        list(record_io.iter_tfrecords(bad, verify_crc=False))
+
+
+def test_masked_crc32c_known_vector():
+    """crc32c("123456789") = 0xE3069283 is the canonical test vector; the
+    TFRecord masking is rot15 + 0xA282EAD8."""
+    crc = record_io._crc32c(b"123456789")
+    assert crc == 0xE3069283
+    want = (((crc >> 15) | ((crc << 17) & 0xFFFFFFFF)) + 0xA282EAD8) & 0xFFFFFFFF
+    assert record_io._masked_crc32c(b"123456789") == want
+
+
+def test_noncanonical_varint_truncates_like_protobuf():
+    """ADVICE r3: a non-canonical 10-byte varint whose final byte exceeds 1
+    must truncate mod 2^64 (protobuf/C++ semantics), not overflow int64."""
+    # Hand-build an Int64List Feature: field 1 (int64_list), wire type 2,
+    # containing field 1 unpacked varint with 10 bytes, final byte 0x03.
+    varint10 = bytes([0xFF] * 9 + [0x03])      # decodes to >= 2^64
+    int64_list = bytes([0x08]) + varint10      # field 1, wt 0
+    vals = record_io._decode_int64_list(int64_list)
+    # 0x03 at shift 63: only bit 63 survives the 64-bit mask; with all
+    # lower bits set this is -1 after two's complement.
+    assert vals.dtype == np.int64
+    assert vals.tolist() == [-1]
+
+
+def test_numeric_kind_pinned_by_first_chunk(tmp_path):
+    """ADVICE r3: a feature drifting int64 -> float32 between chunks must
+    raise the contextual pinning error on the PYTHON path too (the native
+    parser already strictly rejects it), not crash the Parquet writer."""
+    path = str(tmp_path / "kind_flip.tfrecord")
+    with tf.io.TFRecordWriter(path) as w:
+        for i in range(4):
+            if i < 2:
+                feat = {"x": tf.train.Feature(
+                    int64_list=tf.train.Int64List(value=[i])
+                )}
+            else:
+                feat = {"x": tf.train.Feature(
+                    float_list=tf.train.FloatList(value=[float(i)])
+                )}
+            w.write(tf.train.Example(
+                features=tf.train.Features(feature=feat)
+            ).SerializeToString())
+    with pytest.raises(ValueError, match="pinned by the first chunk"):
+        list(record_io.tf_example_batches(
+            record_io.iter_tfrecords(path), batch_rows=2
+        ))
+
+
+def test_bytes_vs_numeric_drift_pinned(tmp_path):
+    """Numeric-pinned feature drifting to bytes raises the pinning error
+    rather than silently re-pinning as a string column."""
+    path = str(tmp_path / "btype_flip.tfrecord")
+    with tf.io.TFRecordWriter(path) as w:
+        for i in range(4):
+            if i < 2:
+                feat = {"x": tf.train.Feature(
+                    float_list=tf.train.FloatList(value=[float(i)])
+                )}
+            else:
+                feat = {"x": tf.train.Feature(
+                    bytes_list=tf.train.BytesList(value=[b"drift"])
+                )}
+            w.write(tf.train.Example(
+                features=tf.train.Features(feature=feat)
+            ).SerializeToString())
+    with pytest.raises(ValueError, match="pinned by the first chunk"):
+        list(record_io.tf_example_batches(
+            record_io.iter_tfrecords(path), batch_rows=2
+        ))

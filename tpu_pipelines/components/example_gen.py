@@ -216,16 +216,17 @@ def CsvExampleGen(ctx):
 RECORD_SUFFIXES = (".tfrecord", ".tfrecords", ".array_record", ".arrayrecord")
 
 
-def _record_reader(path: str):
+def _record_reader(path: str, verify_crc: bool = True):
     from tpu_pipelines.data import record_io
 
     if path.endswith((".array_record", ".arrayrecord")):
         return record_io.iter_array_records(path)
-    return record_io.iter_tfrecords(path)
+    return record_io.iter_tfrecords(path, verify_crc=verify_crc)
 
 
 def _import_record_files(files, out_uri: str, splits: Dict[str, int],
-                         per_split: bool) -> Dict[str, int]:
+                         per_split: bool,
+                         verify_crc: bool = True) -> Dict[str, int]:
     """tf.train.Example record files → Parquet splits, O(chunk) memory.
 
     ``per_split=True``: each file IS a split (``<split>.tfrecord``).
@@ -249,7 +250,8 @@ def _import_record_files(files, out_uri: str, splits: Dict[str, int],
             writer = None
             counts[split] = 0
             try:
-                for batch in record_io.tf_example_batches(_record_reader(f)):
+                for batch in record_io.tf_example_batches(
+                        _record_reader(f, verify_crc)):
                     if writer is None:
                         writer = examples_io.open_split_writer(
                             out_uri, split, batch.schema
@@ -265,7 +267,8 @@ def _import_record_files(files, out_uri: str, splits: Dict[str, int],
 
     def batches():
         for f in files:
-            yield from record_io.tf_example_batches(_record_reader(f))
+            yield from record_io.tf_example_batches(
+                _record_reader(f, verify_crc))
 
     it = batches()
     first = next(it, None)
@@ -288,6 +291,10 @@ def _import_record_files(files, out_uri: str, splits: Dict[str, int],
         # the first are flattened into fixed-length list columns).
         "input_path": Parameter(type=str, required=True),
         "splits": Parameter(type=dict, default=None),
+        # TFRecord masked-crc32c verification (record_io module note).
+        # False = trusted-source opt-out, also the escape hatch for
+        # third-party writers that zero or mis-mask the crc fields.
+        "verify_record_crc": Parameter(type=bool, default=True),
     },
     external_input_parameters=("input_path",),
 )
@@ -327,6 +334,7 @@ def ImportExampleGen(ctx):
             counts = _import_record_files(
                 [os.path.join(path, f) for f in record_files],
                 out.uri, {}, per_split=True,
+                verify_crc=ctx.exec_properties["verify_record_crc"],
             )
             files = []
         for f in files:
@@ -336,7 +344,10 @@ def ImportExampleGen(ctx):
             counts[split] = table.num_rows
     elif path.endswith(RECORD_SUFFIXES):
         splits = ctx.exec_properties["splits"] or dict(DEFAULT_SPLITS)
-        counts = _import_record_files([path], out.uri, splits, per_split=False)
+        counts = _import_record_files(
+            [path], out.uri, splits, per_split=False,
+            verify_crc=ctx.exec_properties["verify_record_crc"],
+        )
     elif path.endswith(".npz"):
         data = np.load(path)
         arrays = {}

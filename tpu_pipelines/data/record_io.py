@@ -21,10 +21,14 @@ streaming CSV path).  Scalar features become scalar columns; fixed-length
 multi-value features become fixed-size list columns; UTF-8 byte features
 decode to strings (non-UTF-8 payloads stay binary).
 
-CRC verification note: TFRecord's masked crc32c fields are SKIPPED on read
-(the reference's readers verify them; corruption here surfaces as a parse
-error instead).  This module does not write either format — the framework's
-own example container is Parquet.
+CRC verification: TFRecord's masked crc32c fields (the format's only
+integrity check — a bit flip inside a packed float/int64/bytes payload
+parses cleanly and yields silently wrong training data) are VERIFIED on
+read by default, matching the reference readers; ``verify_crc=False`` opts
+out for trusted local re-reads.  The crc32c kernel is the installed
+``google_crc32c`` C extension, with a table-based Python fallback.  This
+module does not write either format — the framework's own example container
+is Parquet.
 """
 
 from __future__ import annotations
@@ -38,12 +42,45 @@ import pyarrow as pa
 
 # ------------------------------------------------------------------ framing
 
+# Sanity cap on the framed record length: a corrupt length field must fail
+# fast, not trigger an unbounded multi-GB f.read allocation first.
+MAX_RECORD_BYTES = 1 << 30
 
-def iter_tfrecords(path: str) -> Iterator[bytes]:
+try:
+    from google_crc32c import value as _crc32c
+except ImportError:  # table-based fallback (slow but correct)
+    _CRC32C_TABLE = None
+
+    def _crc32c(data: bytes) -> int:
+        global _CRC32C_TABLE
+        if _CRC32C_TABLE is None:
+            table = []
+            for i in range(256):
+                c = i
+                for _ in range(8):
+                    c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+                table.append(c)
+            _CRC32C_TABLE = table
+        crc = 0xFFFFFFFF
+        for byte in data:
+            crc = (crc >> 8) ^ _CRC32C_TABLE[(crc ^ byte) & 0xFF]
+        return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc32c(data: bytes) -> int:
+    """TFRecord's masked crc: rotate-right-15 of crc32c, plus a constant."""
+    crc = _crc32c(data)
+    return ((crc >> 15) | ((crc << 17) & 0xFFFFFFFF)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+def iter_tfrecords(path: str, verify_crc: bool = True) -> Iterator[bytes]:
     """Yield raw record payloads from one TFRecord file.
 
     Container framing per record: u64le length, u32le masked length-crc,
-    payload, u32le masked payload-crc.  CRCs are skipped (see module note).
+    payload, u32le masked payload-crc.  Both masked crc32c fields are
+    verified by default (see module note); the length is additionally
+    sanity-capped before allocation so a corrupt length field cannot
+    trigger an unbounded read.
     """
     with open(path, "rb") as f:
         while True:
@@ -56,14 +93,34 @@ def iter_tfrecords(path: str) -> Iterator[bytes]:
                     f"({len(header)} trailing bytes)"
                 )
             (length,) = struct.unpack("<Q", header[:8])
+            if verify_crc:
+                (length_crc,) = struct.unpack("<I", header[8:12])
+                if _masked_crc32c(header[:8]) != length_crc:
+                    raise ValueError(
+                        f"TFRecord length-crc mismatch in {path!r} at "
+                        f"offset {f.tell() - 12} — file is corrupt"
+                    )
+            if length > MAX_RECORD_BYTES:
+                raise ValueError(
+                    f"TFRecord length field {length} in {path!r} exceeds "
+                    f"the {MAX_RECORD_BYTES}-byte cap — corrupt framing"
+                )
             payload = f.read(length)
             if len(payload) < length:
                 raise ValueError(
                     f"truncated TFRecord payload in {path!r} "
                     f"(wanted {length}, got {len(payload)})"
                 )
-            if len(f.read(4)) < 4:
+            footer = f.read(4)
+            if len(footer) < 4:
                 raise ValueError(f"truncated TFRecord footer in {path!r}")
+            if verify_crc:
+                (payload_crc,) = struct.unpack("<I", footer)
+                if _masked_crc32c(payload) != payload_crc:
+                    raise ValueError(
+                        f"TFRecord payload-crc mismatch in {path!r} at "
+                        f"offset {f.tell() - 4 - length} — data is corrupt"
+                    )
             yield payload
 
 
@@ -148,6 +205,16 @@ def _decode_float_list(buf: bytes) -> np.ndarray:
     return parts[0] if len(parts) == 1 else np.concatenate(parts)
 
 
+def _to_i64(v: int) -> int:
+    """Truncate a decoded varint to int64 exactly like protobuf/C++ readers:
+    a non-canonical 10-byte varint whose final byte exceeds 1 decodes to
+    v >= 2^64; masking first keeps the Python semantics-reference
+    byte-identical with the native parser (record_core.cc) instead of
+    raising OverflowError where native succeeds."""
+    v &= (1 << 64) - 1
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
 def _decode_int64_list(buf: bytes) -> np.ndarray:
     """Int64List: repeated int64 value = 1 — packed varints or unpacked."""
     out: List[int] = []
@@ -159,10 +226,10 @@ def _decode_int64_list(buf: bytes) -> np.ndarray:
             p = 0
             while p < len(chunk):
                 v, p = _read_varint(chunk, p)
-                out.append(v - (1 << 64) if v >= (1 << 63) else v)
+                out.append(_to_i64(v))
         elif wt == 0:
             v, _ = _read_varint(b, pos)
-            out.append(v - (1 << 64) if v >= (1 << 63) else v)
+            out.append(_to_i64(v))
     return np.asarray(out, np.int64)
 
 
@@ -239,6 +306,14 @@ def _column(values: list, name: str, pins: Dict[str, dict]) -> pa.Array:
         )
     first = values[0]
     if isinstance(first, list):                       # bytes rows
+        if pin is not None and pin["kind"] != 0:
+            names = {1: "float32", 2: "int64"}
+            raise ValueError(
+                f"feature {name!r} is bytes in a later chunk but "
+                f"{names.get(pin['kind'], pin['kind'])} in the first chunk; "
+                "the column type is pinned by the first chunk (like "
+                "streaming CSV inference) — fix the drifting rows upstream"
+            )
         flat = [b for row in values for b in row]
         pinned_type = pin["type"] if pin else None
         if pinned_type is None:
@@ -266,12 +341,19 @@ def _column(values: list, name: str, pins: Dict[str, dict]) -> pa.Array:
             col = pa.array(flat, pa.binary())
     else:
         flat_num = np.concatenate(values)
+        kind = 1 if flat_num.dtype == np.float32 else 2
+        if pin is not None and pin["kind"] != kind:
+            names = {0: "bytes", 1: "float32", 2: "int64"}
+            raise ValueError(
+                f"feature {name!r} is {names.get(kind, kind)} in a later "
+                f"chunk but {names.get(pin['kind'], pin['kind'])} in the "
+                "first chunk; the column type is pinned by the first chunk "
+                "(like streaming CSV inference) — fix the drifting rows "
+                "upstream"
+            )
         col = pa.array(flat_num)
         if pin is None:
-            pins[name] = {
-                "n": n, "type": None,
-                "kind": 1 if flat_num.dtype == np.float32 else 2,
-            }
+            pins[name] = {"n": n, "type": None, "kind": kind}
     if n == 1:
         return col
     return pa.FixedSizeListArray.from_arrays(col, n)
