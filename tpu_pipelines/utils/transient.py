@@ -1,0 +1,25 @@
+"""Transient platform-error classification, shared by every retry site.
+
+One list, one predicate: the tunneled test chip flakes with
+``remote_compile: read body`` INTERNAL errors and similar network-shaped
+failures mid-run; retrying those is worth chip time, retrying deterministic
+failures (ImportError, shape errors, OOM) is not.  bench.py and the
+Evaluator's batch loop both classify with THIS helper so a newly observed
+flake signature added here changes both at once.
+"""
+
+from __future__ import annotations
+
+TRANSIENT_MARKERS = (
+    "internal", "read body", "remote_compile", "unavailable",
+    "deadline", "connection", "socket",
+)
+
+
+def is_transient_error(msg: str) -> bool:
+    """Platform flakes worth retrying — never RESOURCE_EXHAUSTED (a retry
+    at the same size would just burn chip time twice)."""
+    low = msg.lower()
+    return any(m in low for m in TRANSIENT_MARKERS) and (
+        "resource_exhausted" not in low
+    )
