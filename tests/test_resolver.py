@@ -1,0 +1,232 @@
+"""Resolver: latest-blessed-model resolution across runs (TFX Resolver
+equivalent, SURVEY.md:133 — the model-diff gate compares against the
+previously blessed model pulled from metadata)."""
+
+import os
+
+import pytest
+
+from tpu_pipelines.components import (
+    CsvExampleGen,
+    Evaluator,
+    Resolver,
+    SchemaGen,
+    StatisticsGen,
+    Trainer,
+    Transform,
+)
+from tpu_pipelines.components.resolver import resolve_artifacts
+from tpu_pipelines.dsl.pipeline import Pipeline
+from tpu_pipelines.metadata import MetadataStore
+from tpu_pipelines.metadata.types import (
+    Artifact,
+    ArtifactState,
+    Context,
+    Execution,
+    ExecutionState,
+)
+from tpu_pipelines.orchestration import LocalDagRunner
+
+HERE = os.path.dirname(__file__)
+TAXI_CSV = os.path.join(HERE, "testdata", "taxi_sample.csv")
+EXAMPLES_DIR = os.path.join(os.path.dirname(HERE), "examples", "taxi")
+PREPROCESS_MODULE = os.path.join(EXAMPLES_DIR, "taxi_preprocessing.py")
+TRAINER_MODULE = os.path.join(EXAMPLES_DIR, "taxi_trainer_module.py")
+
+
+# ------------------------------------------------------------ strategy unit
+
+
+def _publish_eval(store, pipeline_ctx, model_uri, blessed):
+    """Synthetic Evaluator lineage: model -> execution -> blessing."""
+    model = Artifact(type_name="Model", uri=model_uri,
+                     state=ArtifactState.LIVE)
+    store.put_artifact(model)
+    store.attribute(pipeline_ctx.id, model.id)
+    blessing = Artifact(
+        type_name="ModelBlessing", uri=model_uri + "/blessing",
+        properties={"blessed": blessed},
+    )
+    ex = Execution(type_name="Evaluator", node_id="Evaluator",
+                   state=ExecutionState.COMPLETE)
+    store.publish_execution(
+        ex, {"model": [model]}, {"blessing": [blessing]}, [pipeline_ctx]
+    )
+    return model, blessing
+
+
+def test_latest_blessed_strategy_unit():
+    store = MetadataStore(":memory:")
+    ctx = Context("pipeline", "p1")
+    store.put_context(ctx)
+
+    # No blessed model yet: resolves empty.
+    out = resolve_artifacts(
+        store, strategy="latest_blessed_model", pipeline_name="p1"
+    )
+    assert out == {"model": []}
+
+    m1, _ = _publish_eval(store, ctx, "/m1", blessed=True)
+    m2, _ = _publish_eval(store, ctx, "/m2", blessed=False)   # gate failed
+    out = resolve_artifacts(
+        store, strategy="latest_blessed_model", pipeline_name="p1"
+    )
+    assert [a.id for a in out["model"]] == [m1.id]   # newest BLESSED, not m2
+
+    m3, _ = _publish_eval(store, ctx, "/m3", blessed=True)
+    out = resolve_artifacts(
+        store, strategy="latest_blessed_model", pipeline_name="p1"
+    )
+    assert [a.id for a in out["model"]] == [m3.id]
+
+    # latest_created ignores blessing entirely.
+    out = resolve_artifacts(store, strategy="latest_created",
+                            pipeline_name="p1")
+    assert [a.id for a in out["model"]] == [m3.id]
+
+    # Scoping: another pipeline's context sees nothing of p1's artifacts.
+    out = resolve_artifacts(
+        store, strategy="latest_blessed_model", pipeline_name="other"
+    )
+    assert out == {"model": []}
+    # ... unless scoping is disabled.
+    out = resolve_artifacts(
+        store, strategy="latest_blessed_model", pipeline_name="other",
+        within_pipeline=False,
+    )
+    assert [a.id for a in out["model"]] == [m3.id]
+
+    with pytest.raises(ValueError, match="unknown resolver strategy"):
+        resolve_artifacts(store, strategy="nope", pipeline_name="p1")
+    store.close()
+
+
+# ------------------------------------------------------- two-run e2e (taxi)
+
+
+def _pipeline(tmp, change_thresholds):
+    gen = CsvExampleGen(input_path=TAXI_CSV)
+    stats = StatisticsGen(examples=gen.outputs["examples"])
+    schema = SchemaGen(statistics=stats.outputs["statistics"])
+    transform = Transform(
+        examples=gen.outputs["examples"],
+        schema=schema.outputs["schema"],
+        module_file=PREPROCESS_MODULE,
+    )
+    trainer = Trainer(
+        examples=transform.outputs["transformed_examples"],
+        transform_graph=transform.outputs["transform_graph"],
+        module_file=TRAINER_MODULE,
+        train_steps=20,
+        hyperparameters={"batch_size": 32, "hidden_dims": [8]},
+    )
+    baseline = Resolver(strategy="latest_blessed_model")
+    evaluator = Evaluator(
+        examples=transform.outputs["transformed_examples"],
+        model=trainer.outputs["model"],
+        baseline_model=baseline.outputs["model"],
+        label_key="label_big_tip",
+        batch_size=32,
+        change_thresholds=change_thresholds,
+    )
+    return Pipeline(
+        "taxi-continuous", [evaluator],
+        pipeline_root=str(tmp / "root"),
+        metadata_path=str(tmp / "md.sqlite"),
+    )
+
+
+def test_continuous_training_blessing_gate(tmp_path):
+    """VERDICT r3 next#4 'Done' criterion: the same pipeline run twice —
+    run 2's Evaluator automatically diffs against run 1's blessed model,
+    and a strict change threshold can fail the gate."""
+    # Run 1: no prior blessed model.  The resolver yields nothing, change
+    # thresholds are skipped (bootstrap), value-gate blesses.
+    r1 = LocalDagRunner().run(_pipeline(
+        tmp_path, {"accuracy": {"min_improvement": 0.0}}
+    ))
+    assert r1.succeeded
+    ev1 = r1.nodes["Evaluator"]
+    assert r1.nodes["Resolver"].outputs["model"] == []
+    blessing1 = r1.outputs_of("Evaluator", "blessing")[0]
+    assert blessing1.properties["blessed"] is True
+    model1 = r1.outputs_of("Trainer", "model")[0]
+
+    # Run 2: the resolver finds run 1's blessed model; the candidate (cached
+    # trainer => identical model) improves by exactly 0.0 >= 0.0 -> blessed.
+    r2 = LocalDagRunner().run(_pipeline(
+        tmp_path, {"accuracy": {"min_improvement": 0.0}}
+    ))
+    assert r2.succeeded
+    resolved = r2.nodes["Resolver"].outputs["model"]
+    assert [a.id for a in resolved] == [model1.id]
+    assert r2.outputs_of("Evaluator", "blessing")[0].properties["blessed"] is True
+
+    # The Evaluator execution recorded WHICH baseline it diffed against.
+    store = MetadataStore(str(tmp_path / "md.sqlite"))
+    ex2 = store.get_execution(r2.nodes["Evaluator"].execution_id)
+    assert ex2.properties["baseline_model_uri"] == model1.uri
+    store.close()
+
+    # Run 3: an unmeetable improvement bar -> the diff gate FAILS the model.
+    r3 = LocalDagRunner().run(_pipeline(
+        tmp_path, {"accuracy": {"min_improvement": 0.5}}
+    ))
+    assert r3.succeeded
+    blessing3 = r3.outputs_of("Evaluator", "blessing")[0]
+    assert blessing3.properties["blessed"] is False
+    ex3_props = r3.nodes["Evaluator"].outputs
+    store = MetadataStore(str(tmp_path / "md.sqlite"))
+    ex3 = store.get_execution(r3.nodes["Evaluator"].execution_id)
+    assert any(
+        "improvement" in reason
+        for reason in ex3.properties["not_blessed_reasons"]
+    )
+    # Resolver executions are never cached: one COMPLETE execution per run.
+    resolver_exs = store.get_executions(node_id="Resolver")
+    assert len(resolver_exs) == 3
+    assert all(e.state == ExecutionState.COMPLETE for e in resolver_exs)
+    store.close()
+
+
+def test_unwired_baseline_with_change_thresholds_fails_closed(tmp_path):
+    """A change threshold with NO baseline_model channel wired must fail the
+    gate (a forgotten/typoed channel cannot silently bless a regressed
+    model); only the wired-but-empty resolver bootstrap may skip it."""
+    gen = CsvExampleGen(input_path=TAXI_CSV)
+    stats = StatisticsGen(examples=gen.outputs["examples"])
+    schema = SchemaGen(statistics=stats.outputs["statistics"])
+    transform = Transform(
+        examples=gen.outputs["examples"],
+        schema=schema.outputs["schema"],
+        module_file=PREPROCESS_MODULE,
+    )
+    trainer = Trainer(
+        examples=transform.outputs["transformed_examples"],
+        transform_graph=transform.outputs["transform_graph"],
+        module_file=TRAINER_MODULE,
+        train_steps=10,
+        hyperparameters={"batch_size": 32, "hidden_dims": [8]},
+    )
+    evaluator = Evaluator(
+        examples=transform.outputs["transformed_examples"],
+        model=trainer.outputs["model"],
+        label_key="label_big_tip",
+        batch_size=32,
+        change_thresholds={"accuracy": {"min_improvement": 0.0}},
+        # NOTE: no baseline_model wired.
+    )
+    result = LocalDagRunner().run(Pipeline(
+        "taxi-nobaseline", [evaluator],
+        pipeline_root=str(tmp_path / "root"),
+        metadata_path=str(tmp_path / "md.sqlite"),
+    ))
+    assert result.succeeded
+    blessing = result.outputs_of("Evaluator", "blessing")[0]
+    assert blessing.properties["blessed"] is False
+    store = MetadataStore(str(tmp_path / "md.sqlite"))
+    ex = store.get_execution(result.nodes["Evaluator"].execution_id)
+    assert any(
+        "no baseline model" in r for r in ex.properties["not_blessed_reasons"]
+    )
+    store.close()

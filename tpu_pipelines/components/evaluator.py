@@ -43,6 +43,7 @@ def _evaluate(model_uri: str, examples_uri: str, props: Dict) -> EvalOutcome:
         label_key=props["label_key"],
         problem=props["problem"],
         slice_columns=tuple(props["slice_columns"] or ()),
+        auc_buckets=props.get("auc_buckets") or 0,
     )
 
 
@@ -60,10 +61,23 @@ def _evaluate(model_uri: str, examples_uri: str, props: Dict) -> EvalOutcome:
         "eval_split": Parameter(type=str, default="eval"),
         "batch_size": Parameter(type=int, default=512),
         "slice_columns": Parameter(type=list, default=None),
+        # Ranking-metric aggregation: 0 = exact AUC/PR-AUC (per-slice score
+        # copies, 5 bytes/example); N > 0 = N-bucket streaming histogram,
+        # flat memory for eval sets larger than host RAM (metrics.py note).
+        "auc_buckets": Parameter(type=int, default=0),
         # {"accuracy": {"lower_bound": 0.7}, "loss": {"upper_bound": 1.0}}
         "value_thresholds": Parameter(type=dict, default=None),
         # {"accuracy": {"min_improvement": 0.0, "higher_is_better": True}}
         "change_thresholds": Parameter(type=dict, default=None),
+        # Bootstrap semantics apply ONLY when baseline_model is WIRED (e.g.
+        # to a Resolver) but resolved empty — the first run of a
+        # continuous-training pipeline has no blessed baseline yet, so
+        # change thresholds are skipped (TFX LatestBlessedModelStrategy).
+        # An UNWIRED baseline_model with change thresholds configured always
+        # fails the gate (fail-closed: a forgotten channel must not bless a
+        # regressed model).  require_baseline=True tightens further: even
+        # the wired-but-empty bootstrap fails.
+        "require_baseline": Parameter(type=bool, default=False),
     },
 )
 def Evaluator(ctx):
@@ -72,10 +86,10 @@ def Evaluator(ctx):
     outcome = _evaluate(ctx.input("model").uri, examples_uri, props)
 
     baseline_overall = None
+    baseline_uri = ""
     if ctx.inputs.get("baseline_model"):
-        baseline_outcome = _evaluate(
-            ctx.input("baseline_model").uri, examples_uri, props
-        )
+        baseline_uri = ctx.input("baseline_model").uri
+        baseline_outcome = _evaluate(baseline_uri, examples_uri, props)
         baseline_overall = baseline_outcome.overall().metrics
 
     eval_art = ctx.output("evaluation")
@@ -83,11 +97,17 @@ def Evaluator(ctx):
     overall = outcome.overall()
     eval_art.properties["overall_metrics"] = overall.metrics
 
+    # Wired-but-empty (resolver bootstrap) may skip change thresholds;
+    # never-wired must not — see the require_baseline parameter note.
+    baseline_wired = "baseline_model" in ctx.inputs
     blessed, reasons = check_thresholds(
         overall.metrics,
         props["value_thresholds"] or {},
         baseline=baseline_overall,
         change_thresholds=props["change_thresholds"] or {},
+        require_baseline=(
+            bool(props.get("require_baseline")) or not baseline_wired
+        ),
     )
     blessing_art = ctx.output("blessing")
     os.makedirs(blessing_art.uri, exist_ok=True)
@@ -98,6 +118,7 @@ def Evaluator(ctx):
     return {
         "blessed": blessed,
         "not_blessed_reasons": reasons,
+        "baseline_model_uri": baseline_uri,
         **{f"overall_{k}": v for k, v in overall.metrics.items()},
         "num_slices": len(outcome.slices),
     }

@@ -1,0 +1,116 @@
+"""Resolver: driver-level artifact resolution across prior runs.
+
+Capability match for TFX's ``tfx.dsl.Resolver`` with
+``LatestBlessedModelStrategy`` / ``LatestArtifactStrategy`` (SURVEY.md:133:
+the Evaluator's model-diff/blessing gate compares the candidate against the
+*previously blessed* model pulled from metadata, not just an in-pipeline
+channel).  A Resolver node runs in the runner's DRIVER against the metadata
+store — no executor, never cached (its answer changes as runs accumulate) —
+and re-emits EXISTING artifacts: downstream consumers see the same artifact
+ids, so lineage records reuse, not copies.
+
+Canonical continuous-training wiring::
+
+    baseline = Resolver(strategy="latest_blessed_model")
+    evaluator = Evaluator(
+        examples=..., model=trainer.outputs["model"],
+        baseline_model=baseline.outputs["model"],
+        change_thresholds={"accuracy": {"min_improvement": 0.0}},
+    )
+
+Run 1: no blessed model exists, the resolver yields nothing, and Evaluator
+(whose ``baseline_model`` is optional) gates on value thresholds only.
+Run N: the newest blessed model from any prior run becomes the baseline, so
+change thresholds gate against production exactly like TFX.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from tpu_pipelines.dsl.component import Component, ComponentSpec, Parameter
+from tpu_pipelines.metadata.store import MetadataStore
+from tpu_pipelines.metadata.types import Artifact, ArtifactState, EventType
+
+STRATEGY_LATEST_BLESSED = "latest_blessed_model"
+STRATEGY_LATEST = "latest_created"
+
+STRATEGIES = (STRATEGY_LATEST_BLESSED, STRATEGY_LATEST)
+
+
+class Resolver(Component):
+    """Driver-level node resolving a Model artifact from prior runs."""
+
+    SPEC = ComponentSpec(
+        inputs={},
+        outputs={"model": "Model"},
+        parameters={
+            # latest_blessed_model: newest Model that has a blessed=True
+            #   ModelBlessing produced by an execution that consumed it.
+            # latest_created: newest LIVE Model regardless of blessing
+            #   (TFX LatestArtifactStrategy — warm-start wiring).
+            "strategy": Parameter(type=str, default=STRATEGY_LATEST_BLESSED),
+            # Restrict to artifacts attributed to THIS pipeline's context;
+            # False searches every pipeline sharing the metadata store.
+            "within_pipeline": Parameter(type=bool, default=True),
+        },
+    )
+    EXECUTOR = None
+    IS_RESOLVER = True
+
+
+def resolve_artifacts(
+    store: MetadataStore,
+    *,
+    strategy: str,
+    pipeline_name: str,
+    within_pipeline: bool = True,
+) -> Dict[str, List[Artifact]]:
+    """Run a resolver strategy against the store; returns {"model": [...]}
+    with zero or one artifact — the runner publishes this as the node's
+    outputs."""
+    if strategy not in STRATEGIES:
+        raise ValueError(
+            f"unknown resolver strategy {strategy!r}; expected one of "
+            f"{STRATEGIES}"
+        )
+    scope: Optional[set] = None
+    if within_pipeline:
+        ctx = store.get_context("pipeline", pipeline_name)
+        if ctx is None:
+            return {"model": []}
+        scope = {a.id for a in store.get_artifacts_by_context(ctx.id)}
+
+    if strategy == STRATEGY_LATEST:
+        models = [
+            a for a in store.get_artifacts(
+                type_name="Model", state=ArtifactState.LIVE
+            )
+            if scope is None or a.id in scope
+        ]
+        models.sort(key=lambda a: a.id, reverse=True)
+        return {"model": models[:1]}
+
+    # latest_blessed_model: walk from blessing artifacts (newest first) to
+    # the Model the blessing execution consumed at input path "model".
+    blessings = [
+        b for b in store.get_artifacts(
+            type_name="ModelBlessing", state=ArtifactState.LIVE
+        )
+        if b.properties.get("blessed") and (scope is None or b.id in scope)
+    ]
+    blessings.sort(key=lambda a: a.id, reverse=True)
+    for blessing in blessings:
+        producer_ids = [
+            ev.execution_id
+            for ev in store.get_events_by_artifact(blessing.id)
+            if ev.type == EventType.OUTPUT
+        ]
+        for ex_id in producer_ids:
+            for ev in store.get_events_by_execution(ex_id):
+                if ev.type != EventType.INPUT or ev.path != "model":
+                    continue
+                model = store.get_artifact(ev.artifact_id)
+                if model is not None and model.state == ArtifactState.LIVE:
+                    return {"model": [model]}
+    return {"model": []}

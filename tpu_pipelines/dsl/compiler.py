@@ -60,6 +60,13 @@ class NodeIR:
     # Exec-property keys holding external data paths; the driver fingerprints
     # their content into the cache key (stale-cache guard for ingestion).
     external_input_parameters: List[str] = dataclasses.field(default_factory=list)
+    # Input keys allowed to resolve empty (downstream executor sees the key
+    # absent) — how a Resolver that found nothing feeds an optional input.
+    optional_inputs: List[str] = dataclasses.field(default_factory=list)
+    # Driver-level node (TFX Resolver equivalent): the runner resolves its
+    # outputs from the metadata store instead of launching an executor, and
+    # never caches it (its answer changes as runs accumulate).
+    is_resolver: bool = False
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -73,6 +80,8 @@ class NodeIR:
             "executor_version": self.executor_version,
             "upstream": list(self.upstream),
             "external_input_parameters": list(self.external_input_parameters),
+            "optional_inputs": list(self.optional_inputs),
+            "is_resolver": self.is_resolver,
         }
 
 
@@ -141,6 +150,8 @@ class Compiler:
                     external_input_parameters=sorted(
                         comp.EXTERNAL_INPUT_PARAMETERS
                     ),
+                    optional_inputs=sorted(comp.SPEC.optional_inputs),
+                    is_resolver=bool(getattr(comp, "IS_RESOLVER", False)),
                 )
             )
         return PipelineIR(

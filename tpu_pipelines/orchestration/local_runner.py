@@ -348,6 +348,9 @@ class LocalDagRunner:
         store.put_context(node_ctx)
         all_ctx = contexts + [node_ctx]
 
+        if node.is_resolver:
+            return self._run_resolver_node(store, ir, node, all_ctx, t0)
+
         # ---- DRIVER: resolve inputs + cache check
         resolve_error = ""
         try:
@@ -561,6 +564,77 @@ class LocalDagRunner:
             outputs=outputs, wall_clock_s=wall, retries=attempts - 1,
         )
 
+    def _run_resolver_node(
+        self,
+        store: MetadataStore,
+        ir: PipelineIR,
+        node: NodeIR,
+        all_ctx: List[Context],
+        t0: float,
+    ) -> NodeResult:
+        """Driver-level Resolver execution (TFX Resolver semantics): query
+        the metadata store per the configured strategy, publish an execution
+        whose OUTPUT events reference the EXISTING artifacts (same ids — the
+        lineage graph records reuse), and never cache: the strategy's answer
+        changes as runs accumulate, so every run must re-query."""
+        from tpu_pipelines.components.resolver import resolve_artifacts
+
+        error = ""
+        outputs: Dict[str, List[Artifact]] = {}
+        try:
+            outputs = resolve_artifacts(
+                store,
+                strategy=node.exec_properties.get(
+                    "strategy", "latest_blessed_model"
+                ),
+                pipeline_name=ir.name,
+                within_pipeline=bool(
+                    node.exec_properties.get("within_pipeline", True)
+                ),
+            )
+        except Exception:
+            error = traceback.format_exc()
+        if self.spmd_sync:
+            # Process 0's store view is authoritative (same hazard as
+            # _spmd_sync_inputs: snapshot skew across hosts).
+            if _spmd_broadcast_int(0 if error else 1):
+                outputs = _spmd_sync_inputs(outputs)
+                error = ""
+            elif not error:
+                error = "resolver failed on process 0"
+        if error:
+            return NodeResult(node_id=node.id, status="FAILED", error=error)
+
+        resolved_ids = sorted(
+            a.id for arts in outputs.values() for a in arts
+        )
+        wall = time.time() - t0
+        ex = Execution(
+            type_name=node.component_type,
+            node_id=node.id,
+            state=ExecutionState.COMPLETE,
+            properties={
+                "strategy": node.exec_properties.get("strategy"),
+                "resolved_artifact_ids": resolved_ids,
+                "wall_clock_s": round(wall, 4),
+            },
+        )
+        primary = True
+        if self.spmd_sync:
+            import jax
+
+            primary = jax.process_index() == 0
+        if primary:
+            store.publish_execution(ex, {}, outputs, all_ctx)
+        log.info(
+            "node %s: RESOLVED %s (execution %d)",
+            node.id, resolved_ids or "nothing", ex.id,
+        )
+        return NodeResult(
+            node_id=node.id, status="COMPLETE", execution_id=ex.id,
+            outputs=outputs, wall_clock_s=wall,
+        )
+
     @staticmethod
     def _resolve_inputs(
         node: NodeIR, produced: Dict[str, Dict[str, List[Artifact]]]
@@ -587,6 +661,13 @@ class LocalDagRunner:
                     )
                 got = up.get(ref.output_key)
                 if not got:
+                    # A Resolver that found nothing publishes an EMPTY output
+                    # list; an optional downstream input then resolves to an
+                    # empty list — the key stays PRESENT, so the executor can
+                    # distinguish wired-but-empty (resolver bootstrap) from
+                    # never-wired (a configuration gap).  Anything else fails.
+                    if key in node.optional_inputs:
+                        continue
                     raise KeyError(
                         f"{node.id}: upstream {ref.producer} has no output "
                         f"{ref.output_key!r}"
