@@ -1,0 +1,118 @@
+"""Pipeline-parallel trainer module: dp×pp through the Trainer component.
+
+The run_fn contract with a ``mesh={"data": D, "pipe": S}`` Trainer config:
+the staged classifier (models/staged.py) trains with GPipe microbatching
+over the ``pipe`` axis, stage params sharded ``P("pipe", ...)`` via
+``param_partition``.  With no mesh (or pipe=1) the same module trains the
+same network sequentially — and the exported payload always serves
+sequentially, so consumers need no pipe mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tpu_pipelines.data.input_pipeline import BatchIterator, InputConfig
+from tpu_pipelines.models.staged import (
+    DEFAULT_HPARAMS,
+    build_staged_model,
+    staged_partition_rules,
+)
+from tpu_pipelines.parallel.mesh import MeshConfig, make_mesh
+from tpu_pipelines.parallel.partition import make_param_partition
+from tpu_pipelines.trainer import TrainLoopConfig, export_model, train_loop
+
+LABEL = "label"
+
+
+def build_model(hyperparameters):
+    return build_staged_model(hyperparameters)
+
+
+def apply_fn(model, params, batch):
+    # Serving/eval hook (trainer/export.py): sequential path, no mesh.
+    return model.apply(params, batch["tokens"])
+
+
+def run_fn(fn_args):
+    hp = {**DEFAULT_HPARAMS, **fn_args.hyperparameters}
+    mesh_cfg = (
+        MeshConfig(**fn_args.mesh_config) if fn_args.mesh_config else None
+    )
+    mesh = make_mesh(mesh_cfg)
+    # The stage count IS the pipe axis: params must split exactly across
+    # the pipeline devices.
+    hp["n_stages"] = mesh.shape.get("pipe", 1) or 1
+    if hp["n_stages"] == 1:
+        hp["n_stages"] = int(
+            fn_args.hyperparameters.get("n_stages", DEFAULT_HPARAMS["n_stages"])
+        )
+    model = build_staged_model(hp)
+    batch_size = int(hp["batch_size"])
+
+    train_iter = BatchIterator(
+        fn_args.train_examples_uri, "train",
+        InputConfig(batch_size=batch_size, shuffle=True, seed=0,
+                    drop_remainder=True),
+    )
+
+    def eval_iter_fn():
+        return BatchIterator(
+            fn_args.eval_examples_uri, "eval",
+            InputConfig(batch_size=batch_size, shuffle=False, num_epochs=1,
+                        drop_remainder=True),
+        )
+
+    use_pipe = mesh.shape.get("pipe", 1) > 1
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply(
+            params, batch["tokens"], mesh=mesh if use_pipe else None
+        )
+        labels = jnp.asarray(batch[LABEL], jnp.int32)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+        accuracy = jnp.mean(jnp.argmax(logits, -1) == labels)
+        return loss, {"accuracy": accuracy}
+
+    def init_params_fn(rng, sample_batch):
+        return model.init(rng, sample_batch["tokens"])
+
+    params_shape = jax.eval_shape(
+        lambda: model.init(
+            jax.random.key(0),
+            jnp.zeros((batch_size, int(hp["max_len"])), jnp.int32),
+        )
+    )
+    param_partition = make_param_partition(
+        params_shape, staged_partition_rules()
+    )
+
+    params, result = train_loop(
+        loss_fn=loss_fn,
+        init_params_fn=init_params_fn,
+        optimizer=optax.adam(hp["learning_rate"]),
+        train_iter=train_iter,
+        eval_iter_fn=eval_iter_fn,
+        config=TrainLoopConfig(
+            train_steps=fn_args.train_steps,
+            batch_size=batch_size,
+            eval_steps=fn_args.eval_steps,
+            checkpoint_every=max(1, fn_args.train_steps // 4),
+            log_every=max(1, fn_args.train_steps // 10),
+            mesh_config=mesh_cfg,
+            param_partition=param_partition,
+        ),
+        checkpoint_dir=fn_args.model_run_dir,
+        mesh=mesh,
+    )
+
+    export_model(
+        serving_model_dir=fn_args.serving_model_dir,
+        params=params,
+        module_file=__file__,
+        hyperparameters=hp,
+        extra_spec={"label": LABEL},
+    )
+    return result

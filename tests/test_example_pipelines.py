@@ -9,6 +9,8 @@ import os
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 HERE = os.path.dirname(__file__)
 EXAMPLES = os.path.join(os.path.dirname(HERE), "examples")
 
@@ -31,6 +33,7 @@ def _run_cli(monkeypatch, tmp_path, name, env):
                 "RESNET_IMAGE_SIZE": "8", "RESNET_BATCH": "8"}),
     ("bert", {"BERT_TRAIN_STEPS": "4", "BERT_TINY": "1"}),
     ("t5", {"T5_TRAIN_STEPS": "2", "T5_TINY": "1"}),
+    ("staged", {"STAGED_TRAIN_STEPS": "4"}),   # dp2×pp4 on the CPU mesh
 ])
 def test_example_pipeline_runs_and_caches(monkeypatch, tmp_path, capsys,
                                           name, env):

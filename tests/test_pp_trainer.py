@@ -1,0 +1,94 @@
+"""Pipeline parallelism through the Trainer COMPONENT (VERDICT r3 next#5):
+dp2×pp4 on the 8-device CPU mesh trains the staged classifier via the
+ordinary run_fn contract, with loss parity against the sequential path."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_pipelines.components import ImportExampleGen, Trainer
+from tpu_pipelines.dsl.pipeline import Pipeline
+from tpu_pipelines.metadata import MetadataStore
+from tpu_pipelines.orchestration import LocalDagRunner
+
+pytestmark = pytest.mark.slow
+
+HERE = os.path.dirname(__file__)
+MODULE = os.path.join(
+    os.path.dirname(HERE), "examples", "staged", "staged_trainer_module.py"
+)
+
+
+@pytest.fixture(scope="module")
+def token_npz(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("staged") / "tokens.npz")
+    rng = np.random.default_rng(7)
+    n, seq_len, vocab, classes = 1024, 16, 64, 4
+    tokens = rng.integers(2, vocab, size=(n, seq_len))
+    np.savez(
+        path,
+        tokens=tokens.astype(np.int64),
+        label=(tokens[:, 0] % classes).astype(np.int64),
+    )
+    return path
+
+
+def _train(tmp, npz, mesh, steps=12):
+    gen = ImportExampleGen(input_path=npz)
+    trainer = Trainer(
+        examples=gen.outputs["examples"],
+        module_file=MODULE,
+        train_steps=steps,
+        hyperparameters={"batch_size": 32},
+        mesh=mesh,
+    )
+    result = LocalDagRunner().run(Pipeline(
+        "staged-pp-test", [trainer],
+        pipeline_root=str(tmp / "root"),
+        metadata_path=str(tmp / "md.sqlite"),
+        enable_cache=False,
+    ))
+    assert result.succeeded, result.nodes["Trainer"].error
+    store = MetadataStore(str(tmp / "md.sqlite"))
+    ex = store.get_execution(result.nodes["Trainer"].execution_id)
+    props = dict(ex.properties)
+    store.close()
+    return result, props
+
+
+@pytest.mark.skipif(jax.device_count() < 8, reason="needs 8-device mesh")
+def test_dp2_pp4_through_trainer_component(token_npz, tmp_path):
+    result, props = _train(
+        tmp_path / "pp", token_npz, {"data": 2, "pipe": 4}
+    )
+    assert props["steps_completed"] == 12
+    assert np.isfinite(props["final_loss"])
+
+    # Loss parity vs the SEQUENTIAL path (same module, pipe=1): identical
+    # data order (shuffle seed fixed), identical init seed, float32 —
+    # the gpipe schedule must train the same network.
+    _, props_seq = _train(
+        tmp_path / "seq", token_npz, {"data": 8, "pipe": 1}
+    )
+    assert props_seq["final_loss"] == pytest.approx(
+        props["final_loss"], rel=2e-4, abs=2e-5
+    ), (props["final_loss"], props_seq["final_loss"])
+
+    # The exported payload serves WITHOUT a pipe mesh (sequential path).
+    from tpu_pipelines.trainer.export import load_exported_model
+
+    model_uri = result.outputs_of("Trainer", "model")[0].uri
+    loaded = load_exported_model(model_uri)
+    rng = np.random.default_rng(0)
+    batch = {"tokens": rng.integers(2, 64, size=(8, 16)).astype(np.int64)}
+    logits = np.asarray(loaded.predict(batch))
+    assert logits.shape == (8, 4)
+    assert np.isfinite(logits).all()
+
+    # Stage params actually sharded over pipe: the checkpointed stages
+    # carry the leading stage dim = 4.
+    stages = loaded.params["stages"]
+    lead = {np.shape(leaf)[0] for leaf in jax.tree.leaves(stages)}
+    assert lead == {4}
