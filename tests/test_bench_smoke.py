@@ -9,8 +9,12 @@ with every workload either measured or carrying an error field.
 
 import json
 import os
+
+import pytest
 import subprocess
 import sys
+
+pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -6,7 +6,10 @@ import subprocess
 import sys
 import textwrap
 
+import pytest
 import yaml
+
+pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -26,6 +26,8 @@ from tpu_pipelines.evaluation.metrics import (
 )
 from tpu_pipelines.orchestration import LocalDagRunner
 
+pytestmark = pytest.mark.slow
+
 HERE = os.path.dirname(__file__)
 TAXI_CSV = os.path.join(HERE, "testdata", "taxi_sample.csv")
 EXAMPLES_DIR = os.path.join(os.path.dirname(HERE), "examples", "taxi")

@@ -11,6 +11,8 @@ from tpu_pipelines.ops.flash_attention import flash_attention
 from tpu_pipelines.parallel.ring_attention import dense_attention
 
 
+pytestmark = pytest.mark.slow
+
 def _qkv(b=2, l=64, h=2, d=16, seed=0):
     rng = np.random.default_rng(seed)
     mk = lambda: rng.normal(size=(b, l, h, d)).astype(np.float32)

@@ -11,6 +11,10 @@ from jax.sharding import PartitionSpec as P
 from tpu_pipelines.parallel.mesh import MeshConfig
 from tpu_pipelines.trainer import TrainLoopConfig, train_loop
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 
 def test_dryrun_multichip_8():
     """The driver's multi-chip validation path: dp*tp*sp on 8 CPU devices."""

@@ -10,6 +10,8 @@ from tpu_pipelines.models.transformer import MoEMlpBlock
 from tpu_pipelines.parallel.mesh import MeshConfig, make_mesh
 
 
+pytestmark = pytest.mark.slow
+
 def _block(e=4, d=8, ff=16, cap=8.0):
     return MoEMlpBlock(
         num_experts=e, d_ff=ff, capacity_factor=cap, dtype=jnp.float32,

@@ -10,6 +10,8 @@ from tpu_pipelines.parallel.mesh import MeshConfig, make_mesh
 from tpu_pipelines.parallel.pipeline_parallel import gpipe
 
 
+pytestmark = pytest.mark.slow
+
 def _mlp_stage(params, x):
     """One residual MLP stage: shape/dtype-preserving."""
     return x + jnp.tanh(x @ params["w"]) @ params["v"]

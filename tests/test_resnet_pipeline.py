@@ -4,8 +4,12 @@ cluster runner emitting the multi-host JobSet for the same pipeline."""
 
 import os
 
+import pytest
+
 import numpy as np
 import yaml
+
+pytestmark = pytest.mark.slow
 
 HERE = os.path.dirname(__file__)
 EXAMPLES = os.path.join(os.path.dirname(HERE), "examples")

@@ -27,6 +27,7 @@ from tpu_pipelines.metadata.types import (
 )
 from tpu_pipelines.orchestration import LocalDagRunner
 
+
 HERE = os.path.dirname(__file__)
 TAXI_CSV = os.path.join(HERE, "testdata", "taxi_sample.csv")
 EXAMPLES_DIR = os.path.join(os.path.dirname(HERE), "examples", "taxi")
@@ -136,6 +137,7 @@ def _pipeline(tmp, change_thresholds):
     )
 
 
+@pytest.mark.slow
 def test_continuous_training_blessing_gate(tmp_path):
     """VERDICT r3 next#4 'Done' criterion: the same pipeline run twice —
     run 2's Evaluator automatically diffs against run 1's blessed model,
@@ -189,6 +191,7 @@ def test_continuous_training_blessing_gate(tmp_path):
     store.close()
 
 
+@pytest.mark.slow
 def test_unwired_baseline_with_change_thresholds_fails_closed(tmp_path):
     """A change threshold with NO baseline_model channel wired must fail the
     gate (a forgotten/typoed channel cannot silently bless a regressed
@@ -229,4 +232,25 @@ def test_unwired_baseline_with_change_thresholds_fails_closed(tmp_path):
     assert any(
         "no baseline model" in r for r in ex.properties["not_blessed_reasons"]
     )
+    store.close()
+
+
+def test_resolver_runtime_parameter(tmp_path):
+    """Resolver exec-properties honor RuntimeParameter like any component."""
+    from tpu_pipelines.dsl.component import RuntimeParameter
+
+    r = Resolver(strategy=RuntimeParameter("strat", default="latest_created"))
+    result = LocalDagRunner().run(
+        Pipeline(
+            "resolver-rp", [r],
+            pipeline_root=str(tmp_path / "root"),
+            metadata_path=str(tmp_path / "md.sqlite"),
+        ),
+        runtime_parameters={"strat": "latest_blessed_model"},
+    )
+    assert result.succeeded
+    assert result.nodes["Resolver"].outputs["model"] == []
+    store = MetadataStore(str(tmp_path / "md.sqlite"))
+    ex = store.get_execution(result.nodes["Resolver"].execution_id)
+    assert ex.properties["strategy"] == "latest_blessed_model"
     store.close()

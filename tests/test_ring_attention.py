@@ -10,6 +10,8 @@ from tpu_pipelines.parallel.mesh import MeshConfig, make_mesh
 from tpu_pipelines.parallel.ring_attention import dense_attention, ring_attention
 
 
+pytestmark = pytest.mark.slow
+
 def _qkv(b=2, l=16, h=4, d=8, seed=0):
     rng = np.random.default_rng(seed)
     mk = lambda: rng.normal(size=(b, l, h, d)).astype(np.float32)

@@ -9,6 +9,8 @@ import pytest
 
 from tpu_pipelines.trainer.export import export_model
 
+pytestmark = pytest.mark.slow
+
 
 def _toy_module(tmp_path):
     mod = tmp_path / "toy_model.py"

@@ -11,10 +11,14 @@ whatever the rest of the test session already peaked at.
 
 import json
 import os
+
+import pytest
 import subprocess
 import sys
 
 HERE = os.path.dirname(__file__)
+
+pytestmark = pytest.mark.slow
 
 N_SMALL = 600_000           # ~36 MB as CSV
 N_LARGE = 1_500_000         # ~90 MB as CSV — 2.5x the rows of N_SMALL

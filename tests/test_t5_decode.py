@@ -18,6 +18,8 @@ from tpu_pipelines.models.t5 import (
     make_greedy_generate,
 )
 
+pytestmark = pytest.mark.slow
+
 TINY = dict(
     vocab_size=64, d_model=16, n_layers=2, n_heads=2, head_dim=8, d_ff=32,
     dropout_rate=0.0, dtype=jnp.float32,

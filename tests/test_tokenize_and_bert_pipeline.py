@@ -8,6 +8,8 @@ import pytest
 from tpu_pipelines.data.schema import Feature, FeatureType, Schema
 from tpu_pipelines.transform.graph import TransformGraph
 
+pytestmark = pytest.mark.slow
+
 HERE = os.path.dirname(__file__)
 EXAMPLES = os.path.join(os.path.dirname(HERE), "examples")
 

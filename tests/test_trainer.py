@@ -20,6 +20,8 @@ from tpu_pipelines.orchestration import LocalDagRunner
 from tpu_pipelines.trainer import TrainLoopConfig, train_loop
 from tpu_pipelines.trainer.export import load_exported_model
 
+pytestmark = pytest.mark.slow
+
 HERE = os.path.dirname(__file__)
 TAXI_CSV = os.path.join(HERE, "testdata", "taxi_sample.csv")
 EXAMPLES = os.path.join(os.path.dirname(HERE), "examples", "taxi")

@@ -3,8 +3,11 @@
 import json
 import os
 
+import pytest
+
 
 from tpu_pipelines.components.tuner import _grid, _random
+
 
 
 def test_grid_enumeration():
@@ -48,6 +51,7 @@ def _examples_gen(tmp_path):
     return CsvExampleGen(input_path=str(csv))
 
 
+@pytest.mark.slow
 def test_tuner_picks_grid_minimum(tmp_path):
     from tpu_pipelines.components import Tuner
     from tpu_pipelines.dsl.pipeline import Pipeline
@@ -79,6 +83,7 @@ def test_tuner_picks_grid_minimum(tmp_path):
     assert min(t["score"] for t in trials) == 1.0
 
 
+@pytest.mark.slow
 def test_tuner_feeds_trainer(tmp_path):
     """Best hyperparameters flow through the channel into Trainer's run_fn."""
     from tpu_pipelines.components import Trainer, Tuner
@@ -146,6 +151,7 @@ def _timed_module(tmp_path, sleep_s=5.0):
     return str(mod)
 
 
+@pytest.mark.slow
 def test_parallel_trials_overlap_and_crash_isolation(tmp_path):
     """N subprocess trials overlap; one hard-crashing trial fails alone."""
     from tpu_pipelines.components import Tuner
@@ -229,6 +235,7 @@ def _counting_pipeline_module(tmp_path, trial_shards=2):
     return str(mod), str(counter)
 
 
+@pytest.mark.slow
 def test_shard_fanout_then_merge(tmp_path, monkeypatch):
     """Cluster trial-shard protocol: shard CLIs score candidates[i::k] from
     the shared store, the Tuner node merges without re-running any trial."""
