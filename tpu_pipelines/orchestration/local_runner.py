@@ -321,13 +321,20 @@ class LocalDagRunner:
                     live = False
                     break
                 candidate.setdefault(ev.path, []).append((ev.index, art))
-            if live and candidate:
+            # A Resolver execution with zero OUTPUT events is a VALID latest
+            # state (it resolved empty — e.g. a blessing was retracted);
+            # falling through to an older non-empty execution would resurrect
+            # a baseline the latest resolution rejected.
+            if live and (candidate or node.is_resolver):
                 # Same event-index ordering as the cache path, so a SKIPPED
                 # node hands downstream the identical artifact order.
                 outputs = {
                     path: [a for _, a in sorted(pairs, key=lambda p: p[0])]
                     for path, pairs in candidate.items()
                 }
+                if node.is_resolver:
+                    for key in node.outputs:
+                        outputs.setdefault(key, [])
                 break
         return outputs
 
@@ -349,7 +356,9 @@ class LocalDagRunner:
         all_ctx = contexts + [node_ctx]
 
         if node.is_resolver:
-            return self._run_resolver_node(store, ir, node, all_ctx, t0)
+            return self._run_resolver_node(
+                store, ir, node, all_ctx, t0, runtime_parameters
+            )
 
         # ---- DRIVER: resolve inputs + cache check
         resolve_error = ""
@@ -571,6 +580,7 @@ class LocalDagRunner:
         node: NodeIR,
         all_ctx: List[Context],
         t0: float,
+        runtime_parameters: Dict[str, Any],
     ) -> NodeResult:
         """Driver-level Resolver execution (TFX Resolver semantics): query
         the metadata store per the configured strategy, publish an execution
@@ -581,16 +591,16 @@ class LocalDagRunner:
 
         error = ""
         outputs: Dict[str, List[Artifact]] = {}
+        props = {
+            k: resolve_property(v, runtime_parameters)
+            for k, v in node.exec_properties.items()
+        }
         try:
             outputs = resolve_artifacts(
                 store,
-                strategy=node.exec_properties.get(
-                    "strategy", "latest_blessed_model"
-                ),
+                strategy=props.get("strategy", "latest_blessed_model"),
                 pipeline_name=ir.name,
-                within_pipeline=bool(
-                    node.exec_properties.get("within_pipeline", True)
-                ),
+                within_pipeline=bool(props.get("within_pipeline", True)),
             )
         except Exception:
             error = traceback.format_exc()
@@ -614,7 +624,7 @@ class LocalDagRunner:
             node_id=node.id,
             state=ExecutionState.COMPLETE,
             properties={
-                "strategy": node.exec_properties.get("strategy"),
+                "strategy": props.get("strategy"),
                 "resolved_artifact_ids": resolved_ids,
                 "wall_clock_s": round(wall, 4),
             },
