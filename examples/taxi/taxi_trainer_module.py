@@ -15,7 +15,9 @@ import optax
 
 from tpu_pipelines.data.input_pipeline import BatchIterator, InputConfig
 from tpu_pipelines.models.taxi import DEFAULT_HPARAMS, build_taxi_model
-from tpu_pipelines.trainer import TrainLoopConfig, export_model, train_loop
+from tpu_pipelines.trainer import (
+    TrainLoopConfig, export_model, train_loop, warm_start_init,
+)
 from tpu_pipelines.parallel.mesh import MeshConfig
 
 
@@ -50,6 +52,10 @@ def run_fn(fn_args):
 
     def init_params_fn(rng, sample_batch):
         return model.init(rng, sample_batch)["params"]
+
+    # Warm start from a Trainer base_model input (Resolver latest_created),
+    # no-op without one.
+    init_params_fn = warm_start_init(fn_args, init_params_fn)
 
     mesh_cfg = MeshConfig(**fn_args.mesh_config) if fn_args.mesh_config else None
     params, result = train_loop(

@@ -254,3 +254,150 @@ def test_resolver_runtime_parameter(tmp_path):
     ex = store.get_execution(result.nodes["Resolver"].execution_id)
     assert ex.properties["strategy"] == "latest_blessed_model"
     store.close()
+
+
+def test_warm_start_init_unit(tmp_path):
+    """warm_start_init: restores the exported payload when base_model_uri
+    rides custom_config, stays a no-op without it, rejects mismatches."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_pipelines.trainer.export import export_model, warm_start_init
+    from tpu_pipelines.trainer.fn_args import FnArgs
+
+    module = tmp_path / "m.py"
+    module.write_text(
+        "import flax.linen as nn\n"
+        "class M(nn.Module):\n"
+        "    @nn.compact\n"
+        "    def __call__(self, b):\n"
+        "        return nn.Dense(3)(b['x'])\n"
+        "def build_model(hp):\n"
+        "    return M()\n"
+    )
+    import numpy as np
+
+    from tpu_pipelines.utils.module_loader import load_fn
+
+    model = load_fn(str(module), "build_model")({})
+    batch = {"x": np.ones((2, 4), np.float32)}
+    trained = model.init(jax.random.PRNGKey(1), batch)["params"]
+    trained = jax.tree.map(lambda x: x + 7.0, trained)
+    mdir = str(tmp_path / "model")
+    export_model(serving_model_dir=mdir, params=trained,
+                 module_file=str(module))
+
+    def init_fn(rng, b):
+        return model.init(rng, b)["params"]
+
+    # No base model: identical function back.
+    assert warm_start_init(FnArgs(), init_fn) is init_fn
+
+    fa = FnArgs(custom_config={"base_model_uri": mdir})
+    warm = warm_start_init(fa, init_fn)(jax.random.PRNGKey(0), batch)
+    for a, b in zip(jax.tree.leaves(warm), jax.tree.leaves(trained)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+    # Architecture drift fails with the offending path, not a silent
+    # partial load.
+    import flax.linen as nn
+
+    class M2(nn.Module):
+        @nn.compact
+        def __call__(self, b):
+            return nn.Dense(5, name="Dense_0")(b["x"])
+
+    def init_fn2(rng, b):
+        return M2().init(rng, b)["params"]
+
+    with pytest.raises(ValueError, match="does not match"):
+        warm_start_init(fa, init_fn2)(jax.random.PRNGKey(0), batch)
+
+
+@pytest.mark.slow
+def test_warm_start_through_trainer_component(tmp_path):
+    """Resolver(latest_created) -> Trainer(base_model=...): run 2 trains
+    from run 1's exported params (loss starts lower than a cold start)."""
+    def pipeline(steps):
+        gen = CsvExampleGen(input_path=TAXI_CSV)
+        stats = StatisticsGen(examples=gen.outputs["examples"])
+        schema = SchemaGen(statistics=stats.outputs["statistics"])
+        transform = Transform(
+            examples=gen.outputs["examples"],
+            schema=schema.outputs["schema"],
+            module_file=PREPROCESS_MODULE,
+        )
+        base = Resolver(strategy="latest_created")
+        trainer = Trainer(
+            examples=transform.outputs["transformed_examples"],
+            transform_graph=transform.outputs["transform_graph"],
+            module_file=TRAINER_MODULE,
+            base_model=base.outputs["model"],
+            train_steps=steps,
+            hyperparameters={"batch_size": 32, "hidden_dims": [8]},
+        )
+        return Pipeline(
+            "taxi-warmstart", [trainer],
+            pipeline_root=str(tmp_path / "root"),
+            metadata_path=str(tmp_path / "md.sqlite"),
+            enable_cache=False,
+        )
+
+    r1 = LocalDagRunner().run(pipeline(60))
+    assert r1.succeeded
+    assert r1.nodes["Resolver"].outputs["model"] == []   # cold start
+
+    r2 = LocalDagRunner().run(pipeline(5))
+    assert r2.succeeded
+    resolved = r2.nodes["Resolver"].outputs["model"]
+    assert [a.uri for a in resolved] == [
+        r1.outputs_of("Trainer", "model")[0].uri
+    ]
+    store = MetadataStore(str(tmp_path / "md.sqlite"))
+    ex1 = store.get_execution(r1.nodes["Trainer"].execution_id)
+    ex2 = store.get_execution(r2.nodes["Trainer"].execution_id)
+    store.close()
+    # 5 warm steps continue from 60 trained steps: the final loss must sit
+    # near run 1's trained loss, nowhere near a cold-start loss.
+    assert ex2.properties["final_loss"] < ex1.properties["final_loss"] * 1.5
+
+
+def test_warm_start_init_model_state_contract(tmp_path):
+    """has_model_state modules: init returns (params, model_state) — warm
+    start restores params from the base payload, model_state stays fresh."""
+    import jax
+    import numpy as np
+
+    from tpu_pipelines.trainer.export import export_model, warm_start_init
+    from tpu_pipelines.trainer.fn_args import FnArgs
+    from tpu_pipelines.utils.module_loader import load_fn
+
+    module = tmp_path / "m.py"
+    module.write_text(
+        "import flax.linen as nn\n"
+        "class M(nn.Module):\n"
+        "    @nn.compact\n"
+        "    def __call__(self, b):\n"
+        "        return nn.Dense(3)(b['x'])\n"
+        "def build_model(hp):\n"
+        "    return M()\n"
+    )
+    model = load_fn(str(module), "build_model")({})
+    batch = {"x": np.ones((2, 4), np.float32)}
+    trained = model.init(jax.random.PRNGKey(1), batch)["params"]
+    trained = jax.tree.map(lambda x: x + 3.0, trained)
+    mdir = str(tmp_path / "model")
+    export_model(serving_model_dir=mdir, params=trained,
+                 module_file=str(module))
+
+    def init_fn(rng, b):
+        params = model.init(rng, b)["params"]
+        return params, {"ema": np.zeros(3, np.float32)}
+
+    fa = FnArgs(custom_config={"base_model_uri": mdir})
+    params, model_state = warm_start_init(fa, init_fn)(
+        jax.random.PRNGKey(0), batch
+    )
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(trained)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(model_state["ema"], np.zeros(3))

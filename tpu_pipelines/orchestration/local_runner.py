@@ -321,20 +321,28 @@ class LocalDagRunner:
                     live = False
                     break
                 candidate.setdefault(ev.path, []).append((ev.index, art))
-            # A Resolver execution with zero OUTPUT events is a VALID latest
-            # state (it resolved empty — e.g. a blessing was retracted);
-            # falling through to an older non-empty execution would resurrect
-            # a baseline the latest resolution rejected.
-            if live and (candidate or node.is_resolver):
+            if node.is_resolver:
+                # The NEWEST resolver execution is authoritative, full stop:
+                # resolved-empty is a valid state, and a resolved artifact
+                # that has since gone non-LIVE means empty NOW — falling
+                # through to an older execution in either case would
+                # resurrect a baseline the latest resolution rejected.
+                outputs = {key: [] for key in node.outputs}
+                if live:
+                    outputs.update({
+                        path: [
+                            a for _, a in sorted(pairs, key=lambda p: p[0])
+                        ]
+                        for path, pairs in candidate.items()
+                    })
+                break
+            if live and candidate:
                 # Same event-index ordering as the cache path, so a SKIPPED
                 # node hands downstream the identical artifact order.
                 outputs = {
                     path: [a for _, a in sorted(pairs, key=lambda p: p[0])]
                     for path, pairs in candidate.items()
                 }
-                if node.is_resolver:
-                    for key in node.outputs:
-                        outputs.setdefault(key, [])
                 break
         return outputs
 

@@ -13,4 +13,8 @@ from tpu_pipelines.trainer.train_loop import (  # noqa: F401
     TrainState,
     train_loop,
 )
-from tpu_pipelines.trainer.export import export_model, load_exported_model  # noqa: F401
+from tpu_pipelines.trainer.export import (  # noqa: F401
+    export_model,
+    load_exported_model,
+    warm_start_init,
+)

@@ -147,6 +147,96 @@ def restore_exported_params(uri: str) -> Any:
         return jax.device_put(ckptr.restore(path))
 
 
+def exported_params_abstract(uri: str) -> Any:
+    """Shape/dtype tree of an exported payload's checkpoint, read from
+    checkpoint metadata — no arrays are materialized.  None when the
+    metadata layout is unreadable (old orbax)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(os.path.join(uri, CHECKPOINT_DIR))
+    try:
+        with ocp.StandardCheckpointer() as ckptr:
+            meta = ckptr.metadata(path).item_metadata.tree
+        return jax.tree.map(
+            lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype), meta
+        )
+    except Exception:
+        return None
+
+
+def warm_start_init(fn_args, init_params_fn):
+    """TFX warm-start semantics for ``run_fn`` modules.
+
+    When the Trainer received a ``base_model`` input (e.g. wired from
+    ``Resolver(strategy="latest_created")``), ``fn_args.custom_config``
+    carries ``base_model_uri``; the returned init fn then restores the
+    exported payload's params instead of random-initializing.  Without a
+    base model it returns ``init_params_fn`` unchanged, so modules can wrap
+    unconditionally::
+
+        init_params_fn = warm_start_init(fn_args, init_params_fn)
+
+    Both init contracts are honored: a plain params tree, and the
+    ``has_model_state`` two-tuple ``(params, model_state)`` — exported
+    payloads carry params only, so model_state stays freshly initialized.
+
+    The restored params must match the module's own init exactly
+    (structure, shapes, dtypes) — warm-starting across architecture changes
+    is a config error surfaced with the offending paths, not a silent
+    partial load.  Validation runs on ``jax.eval_shape`` of the init and
+    the checkpoint's metadata, so no throwaway random init is materialized.
+    """
+    uri = (getattr(fn_args, "custom_config", None) or {}).get(
+        "base_model_uri", ""
+    )
+    if not uri:
+        return init_params_fn
+
+    from tpu_pipelines.parallel.partition import path_str
+
+    def _validate(fresh_params, restored):
+        fresh_flat = jax.tree_util.tree_flatten_with_path(fresh_params)[0]
+        rest_flat = jax.tree_util.tree_flatten_with_path(restored)[0]
+        fresh_map = {path_str(path): leaf for path, leaf in fresh_flat}
+        rest_map = {path_str(path): leaf for path, leaf in rest_flat}
+        problems = []
+        for key in sorted(set(fresh_map) | set(rest_map)):
+            a, b = fresh_map.get(key), rest_map.get(key)
+            if a is None or b is None:
+                problems.append(f"{key}: only in "
+                                f"{'base model' if a is None else 'init'}")
+            elif a.shape != b.shape or a.dtype != b.dtype:
+                problems.append(
+                    f"{key}: init {a.shape}/{a.dtype} vs "
+                    f"base model {b.shape}/{b.dtype}"
+                )
+        if problems:
+            raise ValueError(
+                f"warm-start base model at {uri!r} does not match this "
+                f"module's params: " + "; ".join(problems[:8])
+            )
+
+    def init(rng, sample_batch):
+        shapes = jax.eval_shape(init_params_fn, rng, sample_batch)
+        is_tuple = isinstance(shapes, tuple) and len(shapes) == 2
+        params_shapes = shapes[0] if is_tuple else shapes
+        abstract = exported_params_abstract(uri)
+        if abstract is not None:
+            _validate(params_shapes, abstract)
+        model_state = None
+        if is_tuple:
+            fresh_params, model_state = init_params_fn(rng, sample_batch)
+            # Free the throwaway random params before restoring, so peak
+            # device memory holds one params tree, not two.
+            del fresh_params
+        restored = restore_exported_params(uri)
+        if abstract is None:  # metadata unreadable: concrete validation
+            _validate(params_shapes, restored)
+        return (restored, model_state) if is_tuple else restored
+
+    return init
+
+
 def load_exported_model(uri: str) -> LoadedModel:
     """Reload an exported payload into a ready predict function."""
     with open(os.path.join(uri, SPEC_FILE)) as f:
