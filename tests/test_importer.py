@@ -1,0 +1,180 @@
+"""Importer: external data as first-class artifacts (TFX ImporterNode)."""
+
+import os
+
+import pytest
+
+from tpu_pipelines.components import (
+    CsvExampleGen,
+    ExampleValidator,
+    Importer,
+    StatisticsGen,
+)
+from tpu_pipelines.data.schema import Schema
+from tpu_pipelines.dsl.pipeline import Pipeline
+from tpu_pipelines.metadata import MetadataStore
+from tpu_pipelines.orchestration import LocalDagRunner
+
+HERE = os.path.dirname(__file__)
+TAXI_CSV = os.path.join(HERE, "testdata", "taxi_sample.csv")
+
+
+def _curated_schema(tmp_path) -> str:
+    """A hand-curated schema dir, the canonical Importer payload: inferred
+    from the sample data once, then 'edited by a human' (saved externally)."""
+    gen = CsvExampleGen(input_path=TAXI_CSV)
+    stats = StatisticsGen(examples=gen.outputs["examples"])
+    from tpu_pipelines.components import SchemaGen
+
+    schema_node = SchemaGen(statistics=stats.outputs["statistics"])
+    result = LocalDagRunner().run(Pipeline(
+        "schema-once", [schema_node],
+        pipeline_root=str(tmp_path / "inferroot"),
+        metadata_path=str(tmp_path / "infer.sqlite"),
+    ))
+    assert result.succeeded
+    schema = Schema.load(result.outputs_of("SchemaGen", "schema")[0].uri)
+    curated = str(tmp_path / "curated_schema")
+    schema.save(curated)
+    return curated
+
+
+def _pipeline(tmp_path, curated):
+    gen = Importer(
+        source_uri=TAXI_CSV, artifact_type="ExternalData",
+        instance_name="RawData",
+    )
+    examples = CsvExampleGen(input_path=TAXI_CSV)
+    stats = StatisticsGen(examples=examples.outputs["examples"])
+    schema = Importer(source_uri=curated, artifact_type="Schema")
+    validator = ExampleValidator(
+        statistics=stats.outputs["statistics"],
+        schema=schema.outputs["result"],
+    )
+    return Pipeline(
+        "importer-flow", [gen, validator],
+        pipeline_root=str(tmp_path / "root"),
+        metadata_path=str(tmp_path / "md.sqlite"),
+    )
+
+
+def test_importer_registers_external_artifact(tmp_path):
+    curated = _curated_schema(tmp_path)
+    r1 = LocalDagRunner().run(_pipeline(tmp_path, curated))
+    assert r1.succeeded
+
+    # The artifact's uri IS the external path — no copy was made.
+    imported = r1.outputs_of("Importer.Schema", "result")[0]
+    assert imported.uri == os.path.abspath(curated)
+    assert imported.fingerprint
+    # Downstream consumed it: the validator ran against the curated schema.
+    assert r1.nodes["ExampleValidator"].status == "COMPLETE"
+
+    # Second run: pure cache.
+    r2 = LocalDagRunner().run(_pipeline(tmp_path, curated))
+    assert all(n.status == "CACHED" for n in r2.nodes.values()), {
+        k: v.status for k, v in r2.nodes.items()
+    }
+
+    # Editing the external payload re-imports and re-runs downstream.
+    schema = Schema.load(curated)
+    schema.save(curated)  # same content -> still cached
+    r3 = LocalDagRunner().run(_pipeline(tmp_path, curated))
+    assert r3.nodes["Importer.Schema"].status == "CACHED"
+
+    with open(os.path.join(curated, os.listdir(curated)[0]), "a") as f:
+        f.write("\n")
+    r4 = LocalDagRunner().run(_pipeline(tmp_path, curated))
+    assert r4.nodes["Importer.Schema"].status == "COMPLETE"   # re-imported
+
+
+def test_importer_missing_source_fails(tmp_path):
+    from tpu_pipelines.orchestration.local_runner import PipelineRunError
+
+    bad = Importer(source_uri=str(tmp_path / "nope"), artifact_type="Schema")
+    with pytest.raises(PipelineRunError):
+        LocalDagRunner().run(Pipeline(
+            "importer-bad", [bad],
+            pipeline_root=str(tmp_path / "root2"),
+            metadata_path=str(tmp_path / "md2.sqlite"),
+        ))
+
+
+def test_importer_retry_never_deletes_source(tmp_path):
+    """The retry clean-slate must reset to the ALLOCATED uri, never rmtree
+    the executor-assigned external path."""
+    import numpy as np
+
+    from tpu_pipelines.dsl.component import Parameter, component
+
+    src = tmp_path / "precious"
+    src.mkdir()
+    (src / "data.txt").write_text("do not delete")
+
+    calls = {"n": 0}
+
+    @component(
+        outputs={"result": "ExternalData"},
+        parameters={"source_uri": Parameter(type=str, required=True)},
+        external_input_parameters=("source_uri",),
+    )
+    def FlakyImporter(ctx):
+        art = ctx.output("result")
+        art.uri = os.path.abspath(ctx.exec_properties["source_uri"])
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient failure AFTER uri reassignment")
+        return {}
+
+    node = FlakyImporter(source_uri=str(src))
+    result = LocalDagRunner(max_retries=1).run(Pipeline(
+        "importer-retry", [node],
+        pipeline_root=str(tmp_path / "root3"),
+        metadata_path=str(tmp_path / "md3.sqlite"),
+    ))
+    assert result.succeeded
+    assert result.nodes["FlakyImporter"].retries == 1
+    assert (src / "data.txt").read_text() == "do not delete"
+    out = result.outputs_of("FlakyImporter", "result")[0]
+    assert out.uri == str(src)
+
+
+def test_failed_import_abandons_allocated_uri_not_source(tmp_path):
+    """Exhausted retries after a uri reassignment: the ABANDONED artifact
+    record must point at the runner-allocated dir, never the external
+    source (ABANDONED is the disposable state a GC may collect)."""
+    from tpu_pipelines.dsl.component import Parameter, component
+    from tpu_pipelines.metadata.types import ArtifactState
+
+    src = tmp_path / "precious2"
+    src.mkdir()
+    (src / "data.txt").write_text("keep")
+
+    @component(
+        outputs={"result": "ExternalData"},
+        parameters={"source_uri": Parameter(type=str, required=True)},
+    )
+    def DoomedImporter(ctx):
+        ctx.output("result").uri = os.path.abspath(
+            ctx.exec_properties["source_uri"]
+        )
+        raise RuntimeError("always fails")
+
+    node = DoomedImporter(source_uri=str(src))
+    result = LocalDagRunner(max_retries=0).run(
+        Pipeline(
+            "importer-doomed", [node],
+            pipeline_root=str(tmp_path / "root4"),
+            metadata_path=str(tmp_path / "md4.sqlite"),
+        ),
+        raise_on_failure=False,
+    )
+    assert not result.succeeded
+    store = MetadataStore(str(tmp_path / "md4.sqlite"))
+    abandoned = store.get_artifacts(state=ArtifactState.ABANDONED)
+    assert abandoned, "failed execution should record ABANDONED outputs"
+    for art in abandoned:
+        assert str(src) not in art.uri
+        assert art.uri.startswith(str(tmp_path / "root4"))
+    store.close()
+    assert (src / "data.txt").read_text() == "keep"

@@ -29,6 +29,18 @@ def _register(name: str, doc: str) -> _ArtifactType:
     return t
 
 
+def register_artifact_type(name: str, doc: str = "") -> _ArtifactType:
+    """Register a custom artifact type (TFX custom-Artifact equivalent).
+
+    Idempotent for a same-named existing type; used by pipeline authors
+    whose components flow domain artifacts the standard taxonomy lacks
+    (and by Importer when pointing at such data)."""
+    existing = ARTIFACT_TYPES.get(name)
+    if existing is not None:
+        return existing
+    return _register(name, doc or "Custom artifact type.")
+
+
 class standard_artifacts:
     """Namespace of the built-in artifact types."""
 

@@ -396,6 +396,7 @@ class LocalDagRunner:
             key: [a.fingerprint or f"artifact:{a.id}" for a in arts]
             for key, arts in inputs.items()
         }
+        external_fps: Dict[str, str] = {}
         # External data named by path-valued exec-properties participates by
         # content, so editing a source file invalidates the cache even though
         # the path string is unchanged.  {SPAN}/{VERSION} patterns resolve to
@@ -411,7 +412,12 @@ class LocalDagRunner:
                 except FileNotFoundError:
                     path = None  # executor will raise with the real error
             if isinstance(path, str) and os.path.exists(path):
-                input_fps[f"__external__:{param}"] = [fingerprint_dir(path)]
+                fp = fingerprint_dir(path)
+                input_fps[f"__external__:{param}"] = [fp]
+                # Memo for the publisher: an executor that re-points an
+                # output at this same external path (Importer) reuses the
+                # driver's hash instead of re-reading the whole payload.
+                external_fps[os.path.abspath(path)] = fp
         cache_key = execution_cache_key(
             node.id, node.executor_version, props, input_fps
         )
@@ -491,6 +497,14 @@ class LocalDagRunner:
         extra_props: Dict[str, Any] = {}
         attempts = 1
         executor = component.EXECUTOR
+        # The runner-allocated output locations.  Executors may REASSIGN an
+        # artifact's uri (Importer points it at external source data); every
+        # retry must reset to — and clean — the ALLOCATED path, never the
+        # executor-assigned one (rmtree of a reassigned uri would delete the
+        # user's source data).
+        allocated_uris = {
+            id(a): a.uri for arts in outputs.values() for a in arts
+        }
         if executor is None:
             error = f"component {node.id} has no executor"
         else:
@@ -500,6 +514,7 @@ class LocalDagRunner:
                 try:
                     for arts in outputs.values():
                         for a in arts:
+                            a.uri = allocated_uris[id(a)]
                             # spmd_sync: shared dirs were wiped pre-barrier;
                             # deleting here would race other processes.
                             if not self.spmd_sync and os.path.isdir(a.uri):
@@ -561,6 +576,12 @@ class LocalDagRunner:
             {"wall_clock_s": round(wall, 4), "retries": attempts - 1}
         )
         if error:
+            # A failed attempt may have left an executor-reassigned uri on
+            # an output (Importer); the ABANDONED record must point at the
+            # ALLOCATED location, never at the user's external source data.
+            for arts in outputs.values():
+                for a in arts:
+                    a.uri = allocated_uris[id(a)]
             ex.state = ExecutionState.FAILED
             ex.properties["error"] = error.splitlines()[-1] if error else ""
             store.publish_execution(ex, inputs, outputs, all_ctx)
@@ -570,7 +591,10 @@ class LocalDagRunner:
             )
         for arts in outputs.values():
             for a in arts:
-                a.fingerprint = fingerprint_dir(a.uri)
+                a.fingerprint = (
+                    external_fps.get(os.path.abspath(a.uri))
+                    or fingerprint_dir(a.uri)
+                )
         ex.state = ExecutionState.COMPLETE
         store.publish_execution(ex, inputs, outputs, all_ctx)
         log.info(
