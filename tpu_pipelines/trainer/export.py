@@ -117,6 +117,27 @@ class LoadedModel:
     device_step: Callable[[Any, Dict[str, Any]], Any] = None
 
 
+def _checkpoint_abstract(uri: str, sharding=None) -> Any:
+    """Shape/dtype(/sharding) tree of an exported payload's checkpoint, read
+    from checkpoint metadata — no arrays materialized.  None when the
+    metadata layout is unreadable (orbax version drift); the ONE place that
+    parsing lives, so restore and warm-start validation cannot diverge."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(os.path.join(uri, CHECKPOINT_DIR))
+    try:
+        with ocp.StandardCheckpointer() as ckptr:
+            meta = ckptr.metadata(path).item_metadata.tree
+        return jax.tree.map(
+            lambda m: jax.ShapeDtypeStruct(
+                tuple(m.shape), m.dtype, sharding=sharding
+            ),
+            meta,
+        )
+    except Exception:
+        return None
+
+
 def restore_exported_params(uri: str) -> Any:
     """Restore the params checkpoint of an exported payload, device-resident.
 
@@ -128,40 +149,20 @@ def restore_exported_params(uri: str) -> Any:
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(os.path.join(uri, CHECKPOINT_DIR))
+    target = _checkpoint_abstract(
+        uri, sharding=jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
+    )
     with ocp.StandardCheckpointer() as ckptr:
-        try:
-            meta = ckptr.metadata(path).item_metadata.tree
-            sharding = jax.sharding.SingleDeviceSharding(
-                jax.local_devices()[0]
-            )
-            target = jax.tree.map(
-                lambda m: jax.ShapeDtypeStruct(
-                    tuple(m.shape), m.dtype, sharding=sharding
-                ),
-                meta,
-            )
-        except Exception:  # metadata layout drift across orbax versions
-            target = None
         if target is not None:
             return ckptr.restore(path, target)
         return jax.device_put(ckptr.restore(path))
 
 
 def exported_params_abstract(uri: str) -> Any:
-    """Shape/dtype tree of an exported payload's checkpoint, read from
-    checkpoint metadata — no arrays are materialized.  None when the
-    metadata layout is unreadable (old orbax)."""
-    import orbax.checkpoint as ocp
-
-    path = os.path.abspath(os.path.join(uri, CHECKPOINT_DIR))
-    try:
-        with ocp.StandardCheckpointer() as ckptr:
-            meta = ckptr.metadata(path).item_metadata.tree
-        return jax.tree.map(
-            lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype), meta
-        )
-    except Exception:
-        return None
+    """Shape/dtype tree of an exported payload's checkpoint — see
+    ``_checkpoint_abstract`` (no arrays materialized; None when the
+    metadata layout is unreadable)."""
+    return _checkpoint_abstract(uri)
 
 
 def warm_start_init(fn_args, init_params_fn):
