@@ -12,7 +12,9 @@ import optax
 from tpu_pipelines.data.input_pipeline import BatchIterator, InputConfig
 from tpu_pipelines.models.mnist import DEFAULT_HPARAMS, build_mnist_model
 from tpu_pipelines.parallel.mesh import MeshConfig
-from tpu_pipelines.trainer import TrainLoopConfig, export_model, train_loop
+from tpu_pipelines.trainer import (
+    TrainLoopConfig, export_model, train_loop, warm_start_init,
+)
 
 
 def build_model(hyperparameters):
@@ -71,7 +73,7 @@ def run_fn(fn_args):
     mesh_cfg = MeshConfig(**fn_args.mesh_config) if fn_args.mesh_config else None
     params, result = train_loop(
         loss_fn=loss_fn,
-        init_params_fn=init_params_fn,
+        init_params_fn=warm_start_init(fn_args, init_params_fn),
         optimizer=optax.adam(hp["learning_rate"]),
         train_iter=train_iter,
         eval_iter_fn=eval_iter_fn,

@@ -18,7 +18,9 @@ import optax
 from tpu_pipelines.data.input_pipeline import BatchIterator, InputConfig
 from tpu_pipelines.models.resnet import DEFAULT_HPARAMS, build_resnet_model
 from tpu_pipelines.parallel.mesh import MeshConfig
-from tpu_pipelines.trainer import TrainLoopConfig, export_model, train_loop
+from tpu_pipelines.trainer import (
+    TrainLoopConfig, export_model, train_loop, warm_start_init,
+)
 
 EXAMPLE_DEFAULTS = {
     **DEFAULT_HPARAMS,
@@ -92,7 +94,7 @@ def run_fn(fn_args):
     mesh_cfg = MeshConfig(**fn_args.mesh_config) if fn_args.mesh_config else None
     (params, model_state), result = train_loop(
         loss_fn=loss_fn,
-        init_params_fn=init_params_fn,
+        init_params_fn=warm_start_init(fn_args, init_params_fn),
         optimizer=optax.sgd(
             hp["learning_rate"], momentum=hp["momentum"], nesterov=True
         ),

@@ -20,7 +20,9 @@ from tpu_pipelines.models.staged import (
 )
 from tpu_pipelines.parallel.mesh import MeshConfig, make_mesh
 from tpu_pipelines.parallel.partition import make_param_partition
-from tpu_pipelines.trainer import TrainLoopConfig, export_model, train_loop
+from tpu_pipelines.trainer import (
+    TrainLoopConfig, export_model, train_loop, warm_start_init,
+)
 
 LABEL = "label"
 
@@ -91,7 +93,7 @@ def run_fn(fn_args):
 
     params, result = train_loop(
         loss_fn=loss_fn,
-        init_params_fn=init_params_fn,
+        init_params_fn=warm_start_init(fn_args, init_params_fn),
         optimizer=optax.adam(hp["learning_rate"]),
         train_iter=train_iter,
         eval_iter_fn=eval_iter_fn,
