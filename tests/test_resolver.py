@@ -401,3 +401,70 @@ def test_warm_start_init_model_state_contract(tmp_path):
     for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(trained)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b))
     np.testing.assert_array_equal(model_state["ema"], np.zeros(3))
+
+
+@pytest.mark.slow
+def test_partial_run_replays_latest_resolver_state(tmp_path):
+    """A partial run that SKIPS the Resolver must replay its newest
+    execution's outputs (run 2's resolution, not run 1's empty one)."""
+    r1 = LocalDagRunner().run(_pipeline(
+        tmp_path, {"accuracy": {"min_improvement": 0.0}}
+    ))
+    assert r1.succeeded
+    model1 = r1.outputs_of("Trainer", "model")[0]
+
+    r2 = LocalDagRunner().run(_pipeline(
+        tmp_path, {"accuracy": {"min_improvement": 0.0}}
+    ))
+    assert [a.id for a in r2.nodes["Resolver"].outputs["model"]] == [model1.id]
+
+    # Partial run of ONLY the Evaluator: the skipped Resolver replays its
+    # newest resolution (run 2's: model1), and the Evaluator diffs on it.
+    r3 = LocalDagRunner().run(
+        _pipeline(tmp_path, {"accuracy": {"min_improvement": 0.0}}),
+        from_nodes=["Evaluator"], to_nodes=["Evaluator"],
+    )
+    assert r3.succeeded
+    assert r3.nodes["Resolver"].status == "SKIPPED"
+    assert [a.id for a in r3.nodes["Resolver"].outputs["model"]] == [model1.id]
+
+
+def test_resolver_replay_never_resurrects_older_resolution():
+    """Unit of the skipped-Resolver replay branch: the NEWEST resolver
+    execution is authoritative — resolved-empty and since-retracted
+    artifacts both replay as empty, never an older non-empty resolution."""
+    from tpu_pipelines.dsl.compiler import NodeIR
+
+    store = MetadataStore(":memory:")
+    node = NodeIR(
+        id="Resolver", component_type="Resolver", inputs={},
+        outputs={"model": "Model"}, exec_properties={},
+        executor_version="no-executor", upstream=[], is_resolver=True,
+    )
+    model = Artifact(type_name="Model", uri="/m1", state=ArtifactState.LIVE)
+    store.put_artifact(model)
+    ex1 = Execution(type_name="Resolver", node_id="Resolver",
+                    state=ExecutionState.COMPLETE)
+    store.publish_execution(ex1, {}, {"model": [model]}, [])
+
+    replay = LocalDagRunner._resolve_prior_outputs(store, node)
+    assert [a.id for a in replay["model"]] == [model.id]
+
+    # Newest execution resolved EMPTY: replay is empty, not ex1's model.
+    ex2 = Execution(type_name="Resolver", node_id="Resolver",
+                    state=ExecutionState.COMPLETE)
+    store.publish_execution(ex2, {}, {"model": []}, [])
+    assert LocalDagRunner._resolve_prior_outputs(store, node) == {"model": []}
+
+    # Newest execution resolved a model that has SINCE been retracted
+    # (non-LIVE): replay is empty — not ex1's still-LIVE model.
+    model2 = Artifact(type_name="Model", uri="/m2",
+                      state=ArtifactState.LIVE)
+    store.put_artifact(model2)
+    ex3 = Execution(type_name="Resolver", node_id="Resolver",
+                    state=ExecutionState.COMPLETE)
+    store.publish_execution(ex3, {}, {"model": [model2]}, [])
+    model2.state = ArtifactState.DELETED
+    store.put_artifact(model2)
+    assert LocalDagRunner._resolve_prior_outputs(store, node) == {"model": []}
+    store.close()
