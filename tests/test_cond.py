@@ -1,0 +1,255 @@
+"""Cond: conditional subgraph execution (TFX dsl.Cond equivalent)."""
+
+import os
+
+import pytest
+
+from tpu_pipelines.dsl import Cond, artifact_property, runtime_parameter
+from tpu_pipelines.dsl.component import Parameter, component
+from tpu_pipelines.dsl.pipeline import Pipeline
+from tpu_pipelines.orchestration import LocalDagRunner
+
+
+@component(
+    outputs={"examples": "Examples"},
+    parameters={"quality": Parameter(type=float, default=0.5)},
+)
+def Producer(ctx):
+    out = ctx.output("examples")
+    with open(os.path.join(out.uri, "data"), "w") as f:
+        f.write("payload")
+    out.properties["quality"] = ctx.exec_properties["quality"]
+    out.properties["stats"] = {"rows": 100}
+    return {}
+
+
+def _consumer(name, record):
+    @component(inputs={"examples": "Examples"}, outputs={"out": "Examples"},
+               name=name)
+    def C(ctx):
+        record.append(name)
+        with open(os.path.join(ctx.output("out").uri, "data"), "w") as f:
+            f.write("x")
+        return {}
+
+    return C
+
+
+def test_runtime_parameter_gate(tmp_path):
+    record = []
+    prod = Producer()
+    with Cond(runtime_parameter("deploy", default=False) == True):  # noqa: E712
+        gated = _consumer("Gated", record)(examples=prod.outputs["examples"])
+
+    def pipe():
+        return Pipeline(
+            "cond-rt", [prod, gated],
+            pipeline_root=str(tmp_path / "root"),
+            metadata_path=str(tmp_path / "md.sqlite"),
+        )
+
+    r1 = LocalDagRunner().run(pipe())
+    assert r1.succeeded
+    assert r1.nodes["Gated"].status == "COND_SKIPPED"
+    assert record == []
+
+    r2 = LocalDagRunner().run(pipe(), runtime_parameters={"deploy": True})
+    assert r2.succeeded
+    assert r2.nodes["Gated"].status == "COMPLETE"
+    assert record == ["Gated"]
+
+
+def test_artifact_property_gate_and_cascade(tmp_path):
+    """A property predicate gates the node, and consumers of a skipped
+    node cascade-skip (not fail)."""
+    record = []
+    prod = Producer(quality=0.3)
+    with Cond(
+        artifact_property(prod.outputs["examples"], "quality") >= 0.9
+    ):
+        gated = _consumer("Gated", record)(examples=prod.outputs["examples"])
+    # OUTSIDE the block, but consumes the gated node's output: cascades.
+    downstream = _consumer("Downstream", record)(examples=gated.outputs["out"])
+
+    r = LocalDagRunner().run(Pipeline(
+        "cond-prop", [prod, downstream],
+        pipeline_root=str(tmp_path / "root"),
+        metadata_path=str(tmp_path / "md.sqlite"),
+    ))
+    assert r.succeeded
+    assert r.nodes["Producer"].status == "COMPLETE"
+    assert r.nodes["Gated"].status == "COND_SKIPPED"
+    assert r.nodes["Downstream"].status == "COND_SKIPPED"
+    assert record == []
+
+    # Quality above the bar: the whole chain runs.  Dotted paths traverse
+    # nested dict properties.
+    record2 = []
+    prod2 = Producer(quality=0.95)
+    with Cond(
+        artifact_property(prod2.outputs["examples"], "quality") >= 0.9
+    ):
+        with Cond(
+            artifact_property(prod2.outputs["examples"], "stats.rows") > 10
+        ):
+            gated2 = _consumer("Gated", record2)(
+                examples=prod2.outputs["examples"]
+            )
+
+    r2 = LocalDagRunner().run(Pipeline(
+        "cond-prop2", [prod2, gated2],
+        pipeline_root=str(tmp_path / "root2"),
+        metadata_path=str(tmp_path / "md2.sqlite"),
+    ))
+    assert r2.succeeded
+    assert r2.nodes["Gated"].status == "COMPLETE"
+    assert record2 == ["Gated"]
+
+
+def test_nested_cond_requires_all(tmp_path):
+    record = []
+    prod = Producer(quality=0.95)
+    with Cond(artifact_property(prod.outputs["examples"], "quality") >= 0.9):
+        with Cond(runtime_parameter("deploy", default=False) == True):  # noqa: E712
+            gated = _consumer("Gated", record)(
+                examples=prod.outputs["examples"]
+            )
+
+    r = LocalDagRunner().run(Pipeline(
+        "cond-nest", [prod, gated],
+        pipeline_root=str(tmp_path / "root"),
+        metadata_path=str(tmp_path / "md.sqlite"),
+    ))
+    # Outer predicate holds, inner (deploy) does not -> skipped.
+    assert r.nodes["Gated"].status == "COND_SKIPPED"
+    assert record == []
+
+
+def test_condition_channel_is_a_dependency(tmp_path):
+    """A node whose ONLY link to a producer is the predicate still orders
+    after it (the property must exist when the condition is evaluated)."""
+    record = []
+    prod = Producer(quality=0.95)
+
+    @component(outputs={"out": "Examples"}, name="NoInputs")
+    def NoInputs(ctx):
+        record.append("NoInputs")
+        with open(os.path.join(ctx.output("out").uri, "d"), "w") as f:
+            f.write("x")
+        return {}
+
+    with Cond(artifact_property(prod.outputs["examples"], "quality") >= 0.9):
+        gated = NoInputs()
+
+    # The pipeline only names the gated node; the producer rides in through
+    # the predicate dependency (transitive closure).
+    p = Pipeline(
+        "cond-dep", [gated],
+        pipeline_root=str(tmp_path / "root"),
+        metadata_path=str(tmp_path / "md.sqlite"),
+    )
+    assert [c.id for c in p.components] == ["Producer", "NoInputs"]
+    r = LocalDagRunner().run(p)
+    assert r.nodes["NoInputs"].status == "COMPLETE"
+    assert record == ["NoInputs"]
+
+
+def test_cond_predicate_type_error():
+    with pytest.raises(TypeError, match="predicate"):
+        Cond(True)
+
+
+def test_cond_compiles_into_ir(tmp_path):
+    from tpu_pipelines.dsl.compiler import Compiler
+
+    prod = Producer()
+    with Cond(runtime_parameter("deploy", default=False) == True):  # noqa: E712
+        gated = _consumer("Gated", [])(examples=prod.outputs["examples"])
+    ir = Compiler().compile(Pipeline(
+        "cond-ir", [prod, gated], pipeline_root=str(tmp_path),
+    ))
+    node = ir.node("Gated")
+    assert node.conditions == [{
+        "kind": "runtime_parameter", "op": "eq", "value": True,
+        "param": "deploy", "default": False,
+    }]
+    # Round-trips through the JSON IR.
+    assert ir.to_json()["nodes"][-1]["conditions"] == node.conditions
+
+
+def test_chained_comparison_raises():
+    prod = Producer()
+    ref = artifact_property(prod.outputs["examples"], "quality")
+    with pytest.raises(TypeError, match="chained comparisons"):
+        bool(0.5 <= ref <= 0.9)  # noqa: B015 — the misuse under test
+
+
+def test_producerless_channel_rejected():
+    from tpu_pipelines.dsl.component import Channel
+
+    with pytest.raises(ValueError, match="producer"):
+        artifact_property(Channel("Examples"), "quality")
+
+
+def test_cond_skip_is_recorded_and_replays_in_partial_runs(tmp_path):
+    """The latest condition verdict persists: a partial run that does not
+    re-evaluate the gated node replays condition-SKIPPED (cascading), not
+    the stale outputs of an older run where the condition held."""
+    from tpu_pipelines.metadata import MetadataStore
+    from tpu_pipelines.metadata.types import ExecutionState
+
+    record = []
+
+    def build():
+        prod = Producer()
+        with Cond(runtime_parameter("deploy", default=False) == True):  # noqa: E712
+            gated = _consumer("Gated", record)(
+                examples=prod.outputs["examples"]
+            )
+        downstream = _consumer("Downstream", record)(
+            examples=gated.outputs["out"]
+        )
+        return Pipeline(
+            "cond-replay", [prod, downstream],
+            pipeline_root=str(tmp_path / "root"),
+            metadata_path=str(tmp_path / "md.sqlite"),
+        )
+
+    # Run 1: deploy=true — the gated chain runs and publishes outputs.
+    r1 = LocalDagRunner().run(build(), runtime_parameters={"deploy": True})
+    assert r1.nodes["Gated"].status == "COMPLETE"
+    assert record == ["Gated", "Downstream"]
+
+    # Run 2: deploy unset — skipped, and the verdict is RECORDED.
+    r2 = LocalDagRunner().run(build())
+    assert r2.nodes["Gated"].status == "COND_SKIPPED"
+    store = MetadataStore(str(tmp_path / "md.sqlite"))
+    canceled = [
+        e for e in store.get_executions(node_id="Gated")
+        if e.state == ExecutionState.CANCELED
+    ]
+    assert len(canceled) == 1
+    assert canceled[0].properties["cond_skipped"] is True
+    store.close()
+
+    # Run 3: partial run of ONLY Downstream — the unselected gated node
+    # replays its NEWEST state (run 2's skip), so Downstream cascades
+    # instead of consuming run 1's condition-rejected outputs.
+    record.clear()
+    r3 = LocalDagRunner().run(
+        build(), from_nodes=["Downstream"], to_nodes=["Downstream"],
+    )
+    assert r3.succeeded
+    assert r3.nodes["Gated"].status == "COND_SKIPPED"
+    assert r3.nodes["Downstream"].status == "COND_SKIPPED"
+    assert record == []
+
+    # Run 4: deploy=true again — the chain executes afresh, and a later
+    # partial run replays THAT state (outputs available again).
+    r4 = LocalDagRunner().run(build(), runtime_parameters={"deploy": True})
+    assert r4.nodes["Gated"].status in ("COMPLETE", "CACHED")
+    r5 = LocalDagRunner().run(
+        build(), from_nodes=["Downstream"], to_nodes=["Downstream"],
+    )
+    assert r5.nodes["Gated"].status == "SKIPPED"
+    assert r5.nodes["Downstream"].status in ("COMPLETE", "CACHED")

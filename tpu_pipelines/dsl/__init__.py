@@ -17,3 +17,8 @@ from tpu_pipelines.dsl.component import (  # noqa: F401
 )
 from tpu_pipelines.dsl.pipeline import Pipeline  # noqa: F401
 from tpu_pipelines.dsl.compiler import Compiler, PipelineIR  # noqa: F401
+from tpu_pipelines.dsl.cond import (  # noqa: F401
+    Cond,
+    artifact_property,
+    runtime_parameter,
+)

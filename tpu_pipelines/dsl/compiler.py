@@ -67,6 +67,9 @@ class NodeIR:
     # outputs from the metadata store instead of launching an executor, and
     # never caches it (its answer changes as runs accumulate).
     is_resolver: bool = False
+    # Serialized Cond predicates (dsl/cond.py); ALL must hold or the runner
+    # marks the node COND_SKIPPED and cascades to its consumers.
+    conditions: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -82,6 +85,7 @@ class NodeIR:
             "external_input_parameters": list(self.external_input_parameters),
             "optional_inputs": list(self.optional_inputs),
             "is_resolver": self.is_resolver,
+            "conditions": list(self.conditions),
         }
 
 
@@ -134,6 +138,14 @@ class Compiler:
                     if producer_id and producer_id not in upstream:
                         upstream.append(producer_id)
                 inputs[key] = refs
+            conditions = []
+            for pred in getattr(comp, "conditions", ()):
+                conditions.append(pred.to_json())
+                ch = getattr(pred, "channel", None)
+                if ch is not None and ch.producer is not None:
+                    pid = ch.producer.id
+                    if pid not in upstream:
+                        upstream.append(pid)
             executor_version = self._executor_version(comp)
             nodes.append(
                 NodeIR(
@@ -152,6 +164,7 @@ class Compiler:
                     ),
                     optional_inputs=sorted(comp.SPEC.optional_inputs),
                     is_resolver=bool(getattr(comp, "IS_RESOLVER", False)),
+                    conditions=conditions,
                 )
             )
         return PipelineIR(

@@ -190,6 +190,12 @@ class Component:
             for key, type_name in self.SPEC.outputs.items()
         }
 
+        # Conditions from enclosing `with Cond(...)` blocks (dsl/cond.py):
+        # the runner only executes this node when every predicate holds.
+        from tpu_pipelines.dsl.cond import active_predicates
+
+        self.conditions = active_predicates()
+
     @property
     def upstream(self) -> List["Component"]:
         deps = []
@@ -197,6 +203,12 @@ class Component:
             for ch in chans:
                 if ch.producer is not None:
                     deps.append(ch.producer)
+        # Predicate channels are dependencies too: the producer must have
+        # run (and published properties) before the condition is evaluated.
+        for pred in self.conditions:
+            ch = getattr(pred, "channel", None)
+            if ch is not None and ch.producer is not None:
+                deps.append(ch.producer)
         return deps
 
     def with_id(self, instance_name: str) -> "Component":

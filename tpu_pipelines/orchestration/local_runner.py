@@ -124,7 +124,7 @@ class PipelineRunError(RuntimeError):
 @dataclasses.dataclass
 class NodeResult:
     node_id: str
-    status: str                      # COMPLETE | CACHED | FAILED | SKIPPED
+    status: str   # COMPLETE | CACHED | FAILED | SKIPPED | COND_SKIPPED
     execution_id: int = 0
     outputs: Dict[str, List[Artifact]] = dataclasses.field(default_factory=dict)
     error: str = ""
@@ -141,7 +141,7 @@ class RunResult:
     @property
     def succeeded(self) -> bool:
         return all(
-            n.status in ("COMPLETE", "CACHED", "SKIPPED")
+            n.status in ("COMPLETE", "CACHED", "SKIPPED", "COND_SKIPPED")
             for n in self.nodes.values()
         )
 
@@ -222,9 +222,27 @@ class LocalDagRunner:
         # node_id -> {output_key: [Artifact]} for this run's input resolution.
         produced: Dict[str, Dict[str, List[Artifact]]] = {}
         failed_upstream: set = set()
+        cond_skipped: set = set()
 
         for node in ir.nodes:
             if node.id not in selected:
+                # A gated node whose NEWEST execution was a condition-skip
+                # replays as condition-skipped (cascading to consumers) —
+                # not as its older, condition-rejected outputs.
+                replay_skip = bool(node.conditions) and (
+                    self._latest_is_cond_skip(store, node)
+                )
+                if self.spmd_sync and node.conditions:
+                    replay_skip = bool(
+                        _spmd_broadcast_int(1 if replay_skip else 0)
+                    )
+                if replay_skip:
+                    cond_skipped.add(node.id)
+                    produced[node.id] = {}
+                    result.nodes[node.id] = NodeResult(
+                        node_id=node.id, status="COND_SKIPPED",
+                    )
+                    continue
                 outputs = self._resolve_prior_outputs(store, node)
                 produced[node.id] = outputs
                 result.nodes[node.id] = NodeResult(
@@ -237,6 +255,58 @@ class LocalDagRunner:
                     node_id=node.id,
                     status="FAILED",
                     error="upstream failure",
+                )
+                continue
+            # Cond semantics (dsl/cond.py): a node whose predicate fails —
+            # or whose upstream was condition-skipped — is COND_SKIPPED,
+            # which is NOT a failure: the run still succeeds without it.
+            # The verdict is recorded as a CANCELED execution so partial
+            # runs and cluster pods replay the latest decision.
+            unmet: List[Any] = []
+            cascade = any(u in cond_skipped for u in node.upstream)
+            if node.conditions and not cascade:
+                from tpu_pipelines.dsl.cond import evaluate_condition
+
+                unmet = [
+                    c for c in node.conditions
+                    if not evaluate_condition(
+                        c, produced, runtime_parameters or {}
+                    )
+                ]
+            skip = cascade or bool(unmet)
+            if self.spmd_sync and (node.conditions or cascade):
+                # Store-derived decision: process 0's verdict is
+                # authoritative, or divergent snapshots would leave some
+                # processes inside the executor's collectives while others
+                # skipped (same hazard as the cache-verdict broadcast).
+                skip = bool(_spmd_broadcast_int(1 if skip else 0))
+            if skip:
+                log.info(
+                    "node %s: condition not met%s; skipping",
+                    node.id,
+                    "" if cascade else f" ({unmet})",
+                )
+                cond_skipped.add(node.id)
+                primary = True
+                if self.spmd_sync:
+                    import jax
+
+                    primary = jax.process_index() == 0
+                if primary:
+                    ex = Execution(
+                        type_name=node.component_type,
+                        node_id=node.id,
+                        state=ExecutionState.CANCELED,
+                        properties={
+                            "cond_skipped": True,
+                            "unmet_conditions": unmet,
+                        },
+                    )
+                    store.publish_execution(ex, {}, {}, [
+                        pipeline_ctx, run_ctx,
+                    ])
+                result.nodes[node.id] = NodeResult(
+                    node_id=node.id, status="COND_SKIPPED",
                 )
                 continue
 
@@ -299,6 +369,20 @@ class LocalDagRunner:
                 stack.extend(by_id[nid].upstream)
             selected &= keep
         return selected
+
+    @staticmethod
+    def _latest_is_cond_skip(store: MetadataStore, node: NodeIR) -> bool:
+        """True when the node's newest decisive execution (COMPLETE, CACHED,
+        or a Cond CANCELED record) was a condition-skip."""
+        for ex in reversed(store.get_executions(node_id=node.id)):
+            if ex.state in (ExecutionState.COMPLETE, ExecutionState.CACHED):
+                return False
+            if (
+                ex.state == ExecutionState.CANCELED
+                and ex.properties.get("cond_skipped")
+            ):
+                return True
+        return False
 
     @staticmethod
     def _resolve_prior_outputs(

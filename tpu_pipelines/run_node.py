@@ -93,6 +93,12 @@ def main(argv=None) -> int:
         multihost_utils.sync_global_devices(f"run_node:{args.node_id}:done")
     if node.status in ("COMPLETE", "CACHED"):
         return 0
+    if node.status == "COND_SKIPPED":
+        # Cond semantics hold in cluster mode too: an unmet predicate is a
+        # successful no-op pod, not an Argo step failure.
+        print(f"node {args.node_id}: condition not met; skipped",
+              file=sys.stderr)
+        return 0
     print(f"node {args.node_id} failed: {node.error}", file=sys.stderr)
     return 1
 
