@@ -253,3 +253,101 @@ def test_cond_skip_is_recorded_and_replays_in_partial_runs(tmp_path):
     )
     assert r5.nodes["Gated"].status == "SKIPPED"
     assert r5.nodes["Downstream"].status in ("COMPLETE", "CACHED")
+
+
+def test_cascade_skip_replays_for_condition_less_nodes(tmp_path):
+    """A condition-LESS node that was cascade-skipped must also replay as
+    condition-skipped in later partial runs (its CANCELED record is
+    decisive), never its stale outputs from a run where the gate held."""
+    record = []
+
+    def build():
+        prod = Producer()
+        with Cond(runtime_parameter("deploy", default=False) == True):  # noqa: E712
+            gated = _consumer("Gated", record)(
+                examples=prod.outputs["examples"]
+            )
+        # NO Cond of its own — skipped only by cascade.
+        mid = _consumer("Mid", record)(examples=gated.outputs["out"])
+        final = _consumer("Final", record)(examples=mid.outputs["out"])
+        return Pipeline(
+            "cond-cascade-replay", [prod, final],
+            pipeline_root=str(tmp_path / "root"),
+            metadata_path=str(tmp_path / "md.sqlite"),
+        )
+
+    r1 = LocalDagRunner().run(build(), runtime_parameters={"deploy": True})
+    assert r1.nodes["Mid"].status == "COMPLETE"
+
+    r2 = LocalDagRunner().run(build())
+    assert r2.nodes["Gated"].status == "COND_SKIPPED"
+    assert r2.nodes["Mid"].status == "COND_SKIPPED"
+
+    # Partial run of ONLY Final: Mid (condition-less, cascade-skipped in
+    # run 2) must replay COND_SKIPPED, so Final cascades instead of
+    # consuming run 1's outputs.
+    record.clear()
+    r3 = LocalDagRunner().run(
+        build(), from_nodes=["Final"], to_nodes=["Final"],
+    )
+    assert r3.succeeded
+    assert r3.nodes["Mid"].status == "COND_SKIPPED"
+    assert r3.nodes["Final"].status == "COND_SKIPPED"
+    assert record == []
+
+
+def test_run_node_passes_runtime_parameters(tmp_path):
+    """Cluster pods evaluate the SAME runtime parameters as a local run:
+    run_node accepts --runtime-parameter / TPP_RUNTIME_PARAMETERS, so a
+    Cond-gated node can be enabled on the cluster."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    mod = tmp_path / "cond_pipeline.py"
+    mod.write_text(
+        "import os\n"
+        "from tpu_pipelines.dsl import Cond, Pipeline, runtime_parameter\n"
+        "from tpu_pipelines.dsl.component import Parameter, component\n"
+        f"BASE = {str(tmp_path)!r}\n"
+        "@component(outputs={'out': 'Examples'})\n"
+        "def Gate(ctx):\n"
+        "    with open(os.path.join(ctx.output('out').uri, 'ok'), 'w') as f:\n"
+        "        f.write('ran')\n"
+        "    return {}\n"
+        "def create_pipeline():\n"
+        "    with Cond(runtime_parameter('deploy', default=False) == True):\n"
+        "        gate = Gate()\n"
+        "    return Pipeline('cond-pod', [gate],\n"
+        "                    pipeline_root=os.path.join(BASE, 'root'),\n"
+        "                    metadata_path=os.path.join(BASE, 'md.sqlite'))\n"
+    )
+    env = {**os.environ, "PYTHONPATH": repo, "JAX_PLATFORMS": "cpu"}
+    base_cmd = [sys.executable, "-m", "tpu_pipelines.run_node",
+                "--pipeline-module", str(mod), "--node-id", "Gate"]
+
+    # Default: condition unmet — pod exits 0 (Argo success), node skipped.
+    p1 = subprocess.run(base_cmd, env=env, capture_output=True, text=True,
+                        timeout=240)
+    assert p1.returncode == 0, p1.stderr[-1500:]
+    assert "condition not met" in p1.stderr
+
+    # Flag form.
+    p2 = subprocess.run(base_cmd + ["--runtime-parameter", "deploy=true"],
+                        env=env, capture_output=True, text=True, timeout=240)
+    assert p2.returncode == 0, p2.stderr[-1500:]
+    found = [d for d, _, fs in os.walk(tmp_path / "root") if "ok" in fs]
+    assert found, "gated node did not run with --runtime-parameter"
+
+    # Env form (fresh base so the run is distinguishable).
+    import shutil
+
+    shutil.rmtree(tmp_path / "root")
+    os.remove(tmp_path / "md.sqlite")
+    p3 = subprocess.run(
+        base_cmd, env={**env, "TPP_RUNTIME_PARAMETERS": '{"deploy": true}'},
+        capture_output=True, text=True, timeout=240,
+    )
+    assert p3.returncode == 0, p3.stderr[-1500:]
+    found = [d for d, _, fs in os.walk(tmp_path / "root") if "ok" in fs]
+    assert found, "gated node did not run with TPP_RUNTIME_PARAMETERS"

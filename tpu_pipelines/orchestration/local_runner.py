@@ -226,13 +226,14 @@ class LocalDagRunner:
 
         for node in ir.nodes:
             if node.id not in selected:
-                # A gated node whose NEWEST execution was a condition-skip
-                # replays as condition-skipped (cascading to consumers) —
-                # not as its older, condition-rejected outputs.
-                replay_skip = bool(node.conditions) and (
-                    self._latest_is_cond_skip(store, node)
-                )
-                if self.spmd_sync and node.conditions:
+                # A node whose NEWEST execution was a condition-skip —
+                # whether directly gated or cascade-skipped (both publish
+                # the CANCELED cond_skipped record) — replays as
+                # condition-skipped, not as its older, condition-rejected
+                # outputs.
+                replay_skip = self._latest_is_cond_skip(store, node)
+                if self.spmd_sync:
+                    # Store-derived; broadcast like every control decision.
                     replay_skip = bool(
                         _spmd_broadcast_int(1 if replay_skip else 0)
                     )

@@ -7,6 +7,14 @@ this entrypoint joins the multi-host coordination service when the TPP_* env
 vars are present (parallel/distributed.py), then executes the single node as
 a partial run — input artifacts resolve from the shared metadata store, so
 the DAG's ordering/caching semantics are identical to a local run.
+
+Runtime parameters (RuntimeParameter exec-properties and Cond
+``runtime_parameter`` predicates) enter cluster pods via repeatable
+``--runtime-parameter NAME=VALUE`` flags (VALUE parsed as JSON, raw string
+fallback) or the ``TPP_RUNTIME_PARAMETERS`` env var (a JSON object — the
+natural place for an Argo submit-time substitution); flags win per key.
+Every pod of a run must receive the SAME values, or per-node decisions
+(conditions, exec properties) would diverge across the DAG.
 """
 
 from __future__ import annotations
@@ -31,8 +39,32 @@ def main(argv=None) -> int:
         help="simulate multi-host on CPU with N local devices (tests)",
     )
     parser.add_argument("--max-retries", type=int, default=0)
+    parser.add_argument(
+        "--runtime-parameter", action="append", default=[],
+        metavar="NAME=VALUE",
+        help="runtime parameter (VALUE parsed as JSON, raw string fallback);"
+             " repeatable; overrides TPP_RUNTIME_PARAMETERS per key",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+
+    import json
+    import os as _os
+
+    runtime_parameters = {}
+    env_params = _os.environ.get("TPP_RUNTIME_PARAMETERS", "")
+    if env_params:
+        runtime_parameters.update(json.loads(env_params))
+    for item in args.runtime_parameter:
+        name, sep, raw = item.partition("=")
+        if not sep:
+            parser.error(
+                f"--runtime-parameter needs NAME=VALUE, got {item!r}"
+            )
+        try:
+            runtime_parameters[name] = json.loads(raw)
+        except json.JSONDecodeError:
+            runtime_parameters[name] = raw
 
     dist = maybe_initialize_from_env(
         cpu_devices_per_process=args.cpu_devices_per_process
@@ -80,6 +112,7 @@ def main(argv=None) -> int:
     )
     result = runner.run(
         pipeline,
+        runtime_parameters=runtime_parameters,
         run_id=args.run_id,
         from_nodes=[args.node_id],
         to_nodes=[args.node_id],
