@@ -372,3 +372,23 @@ def test_adaptive_tuner_with_shards_rejected_at_compile(tmp_path):
             output_dir=str(tmp_path / "manifests"),
             shared_volume_claim="shared-pvc",
         )).run(pipeline)
+
+
+def test_run_node_malformed_env_params_is_clear_cli_error(tmp_path):
+    """Round-4 advisor finding: a malformed TPP_RUNTIME_PARAMETERS must be
+    a pointed CLI error naming the env var, not a JSONDecodeError
+    traceback out of main()."""
+    mod = _pipeline_module(tmp_path)
+    for bad, why in [("{not json", "not valid JSON"),
+                     ('["a", "b"]', "JSON object")]:
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_pipelines.run_node",
+             "--pipeline-module", mod, "--node-id", "CsvExampleGen"],
+            env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+                 "TPP_RUNTIME_PARAMETERS": bad},
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 2, (bad, proc.returncode)
+        assert "TPP_RUNTIME_PARAMETERS" in proc.stderr
+        assert why in proc.stderr, (why, proc.stderr[-500:])
+        assert "Traceback" not in proc.stderr

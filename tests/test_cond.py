@@ -351,3 +351,66 @@ def test_run_node_passes_runtime_parameters(tmp_path):
     assert p3.returncode == 0, p3.stderr[-1500:]
     found = [d for d, _, fs in os.walk(tmp_path / "root") if "ok" in fs]
     assert found, "gated node did not run with TPP_RUNTIME_PARAMETERS"
+
+
+def test_unresolvable_condition_fails_not_skips(tmp_path):
+    """Round-4 advisor finding: a predicate whose producer has NO published
+    outputs at all (partial run excluding the producer, no prior history)
+    is a configuration mistake — the gated node must FAIL with a pointed
+    error, never silently report COND_SKIPPED + overall success."""
+    record = []
+    prod = Producer(quality=0.99)
+    with Cond(
+        artifact_property(prod.outputs["examples"], "quality") >= 0.9
+    ):
+        gated = _consumer("Gated", record)(examples=prod.outputs["examples"])
+
+    pipe = Pipeline(
+        "cond-unresolved", [prod, gated],
+        pipeline_root=str(tmp_path / "root"),
+        metadata_path=str(tmp_path / "md.sqlite"),
+    )
+    # Partial run of ONLY the gated node, on a fresh store: the producer
+    # was never executed, so the predicate cannot be evaluated.
+    r = LocalDagRunner().run(
+        pipe, from_nodes=["Gated"], to_nodes=["Gated"],
+        raise_on_failure=False,
+    )
+    assert not r.succeeded
+    assert r.nodes["Gated"].status == "FAILED"
+    assert "no published outputs" in r.nodes["Gated"].error
+    assert record == []
+
+
+def test_cond_on_empty_resolver_output_skips_not_fails(tmp_path):
+    """Review finding on the unresolved-condition fix: a producer that RAN
+    and published an EMPTY output list (a Resolver with no blessed model
+    yet — the documented bootstrap case) is a legitimately unmet
+    condition: the gated node must COND_SKIP and the run succeed, not
+    FAIL as 'unresolvable'."""
+    from tpu_pipelines.components import Resolver
+
+    record = []
+    resolver = Resolver()
+    with Cond(
+        artifact_property(resolver.outputs["model"], "blessed") == True  # noqa: E712
+    ):
+        @component(inputs={"model": "Model"}, outputs={"out": "Examples"},
+                   optional_inputs=("model",), name="Gated")
+        def Gated(ctx):
+            record.append("Gated")
+            with open(os.path.join(ctx.output("out").uri, "data"), "w") as f:
+                f.write("x")
+            return {}
+
+        gated = Gated(model=resolver.outputs["model"])
+
+    r = LocalDagRunner().run(Pipeline(
+        "cond-empty-resolver", [resolver, gated],
+        pipeline_root=str(tmp_path / "root"),
+        metadata_path=str(tmp_path / "md.sqlite"),
+    ))
+    assert r.succeeded
+    assert r.nodes["Resolver"].status == "COMPLETE"
+    assert r.nodes["Gated"].status == "COND_SKIPPED"
+    assert record == []

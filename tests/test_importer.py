@@ -18,6 +18,13 @@ from tpu_pipelines.orchestration import LocalDagRunner
 HERE = os.path.dirname(__file__)
 TAXI_CSV = os.path.join(HERE, "testdata", "taxi_sample.csv")
 
+# Several tests flow this custom type through bare @component nodes, which
+# (unlike Importer) do not auto-register unknown output types.  Register at
+# module level so every test is order-independent under xdist distribution.
+from tpu_pipelines.dsl.artifact_types import register_artifact_type  # noqa: E402
+
+register_artifact_type("ExternalData", "External payload (importer tests).")
+
 
 def _curated_schema(tmp_path) -> str:
     """A hand-curated schema dir, the canonical Importer payload: inferred
@@ -178,3 +185,30 @@ def test_failed_import_abandons_allocated_uri_not_source(tmp_path):
         assert art.uri.startswith(str(tmp_path / "root4"))
     store.close()
     assert (src / "data.txt").read_text() == "keep"
+
+
+def test_importer_default_id_collision_names_the_fix(tmp_path):
+    """Round-4 advisor finding: two Importers of the same artifact_type
+    default to the same node id; the duplicate-id error must point at
+    instance_name=, not read as an opaque compile failure."""
+    import pytest
+
+    from tpu_pipelines.components.importer import Importer
+    from tpu_pipelines.dsl.pipeline import Pipeline
+
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    imp_a = Importer(source_uri=str(tmp_path / "a"), artifact_type="Schema")
+    imp_b = Importer(source_uri=str(tmp_path / "b"), artifact_type="Schema")
+    with pytest.raises(ValueError, match="instance_name"):
+        Pipeline(
+            "dup-importers", [imp_a, imp_b],
+            pipeline_root=str(tmp_path / "root"),
+        )
+    # Disambiguated, construction succeeds.
+    imp_c = Importer(source_uri=str(tmp_path / "b"), artifact_type="Schema",
+                     instance_name="SchemaB")
+    Pipeline(
+        "ok-importers", [imp_a, imp_c],
+        pipeline_root=str(tmp_path / "root"),
+    )

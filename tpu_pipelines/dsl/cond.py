@@ -185,20 +185,49 @@ def _dotted(d: Any, path: str) -> Any:
     return cur
 
 
+class ConditionUnresolvedError(RuntimeError):
+    """The predicate's producer has no published outputs at all.
+
+    Distinct from an unmet predicate (round-4 advisor finding): when the
+    producer never executed — e.g. a partial run whose ``to_nodes`` range
+    excludes it and no prior-run history exists — silently reporting the
+    gated node as COND_SKIPPED would mask a configuration mistake as a
+    legitimately unmet condition.  The runner surfaces this as a node
+    FAILURE instead."""
+
+
 def evaluate_condition(
     cond: Dict[str, Any],
     produced: Dict[str, Dict[str, List[Any]]],
     runtime_parameters: Dict[str, Any],
 ) -> bool:
-    """Evaluate one serialized predicate against this run's state."""
+    """Evaluate one serialized predicate against this run's state.
+
+    Raises :class:`ConditionUnresolvedError` when the predicate reads an
+    artifact property but the producer has no published outputs for the
+    key — 'never ran' must not be conflated with 'ran and the property
+    does not satisfy the predicate' (which returns False)."""
     op = _OPS[cond["op"]]
     if cond["kind"] == "runtime_parameter":
         actual = runtime_parameters.get(cond["param"], cond.get("default"))
         return bool(op(actual, cond["value"]))
-    arts = (produced.get(cond["producer"]) or {}).get(
-        cond["output_key"]
-    ) or []
+    outputs = produced.get(cond["producer"])
+    if not outputs or cond["output_key"] not in outputs:
+        # The producer never published AT ALL (the output key is absent,
+        # not merely empty): a configuration mistake, not an unmet
+        # condition.
+        raise ConditionUnresolvedError(
+            f"condition on {cond['producer']}.{cond['output_key']}"
+            f".{cond['prop']} cannot be evaluated: the producer has no "
+            "published outputs in this run or any prior run. In a partial "
+            "run, include the producer in the node selection (or run the "
+            "full pipeline once first)."
+        )
+    arts = outputs[cond["output_key"]] or []
     if not arts:
+        # The producer RAN and published an empty output list (a Resolver
+        # that found nothing, e.g. no blessed model yet): a legitimately
+        # unmet condition — skip, don't fail.
         return False
     actual = _dotted(arts[0].properties, cond["prop"])
     return bool(op(actual, cond["value"]))

@@ -31,9 +31,27 @@ class Pipeline:
         ids = [c.id for c in self.components]
         dupes = {i for i in ids if ids.count(i) > 1}
         if dupes:
+            # Importer-specific diagnosis (round-4 advisor finding): two
+            # Importers of the same artifact_type both default to
+            # 'Importer.<type>', and the generic duplicate-id error hides
+            # the actual fix (pass instance_name=).
+            hints = []
+            for d in sorted(dupes):
+                uris = {
+                    c.exec_properties.get("source_uri")
+                    for c in self.components
+                    if c.id == d and "source_uri" in c.exec_properties
+                }
+                if len(uris) > 1:
+                    hints.append(
+                        f"{d!r} is the default id shared by Importer nodes "
+                        f"for different sources {sorted(uris)}; pass "
+                        "instance_name= to each Importer to disambiguate"
+                    )
             raise ValueError(
                 f"Pipeline {name!r}: duplicate component ids {sorted(dupes)}; "
                 "use .with_id() to disambiguate"
+                + ("".join(f". {h}" for h in hints))
             )
 
     @staticmethod

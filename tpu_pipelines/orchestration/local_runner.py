@@ -264,23 +264,49 @@ class LocalDagRunner:
             # The verdict is recorded as a CANCELED execution so partial
             # runs and cluster pods replay the latest decision.
             unmet: List[Any] = []
+            cond_error: Any = None
             cascade = any(u in cond_skipped for u in node.upstream)
             if node.conditions and not cascade:
-                from tpu_pipelines.dsl.cond import evaluate_condition
+                from tpu_pipelines.dsl.cond import (
+                    ConditionUnresolvedError,
+                    evaluate_condition,
+                )
 
-                unmet = [
-                    c for c in node.conditions
-                    if not evaluate_condition(
-                        c, produced, runtime_parameters or {}
-                    )
-                ]
+                try:
+                    unmet = [
+                        c for c in node.conditions
+                        if not evaluate_condition(
+                            c, produced, runtime_parameters or {}
+                        )
+                    ]
+                except ConditionUnresolvedError as e:
+                    # Producer never published anything (e.g. a partial run
+                    # excluding it with no prior history): a configuration
+                    # mistake, surfaced as a node FAILURE — never silently
+                    # COND_SKIPPED (round-4 advisor finding).
+                    cond_error = str(e)
             skip = cascade or bool(unmet)
             if self.spmd_sync and (node.conditions or cascade):
                 # Store-derived decision: process 0's verdict is
                 # authoritative, or divergent snapshots would leave some
                 # processes inside the executor's collectives while others
                 # skipped (same hazard as the cache-verdict broadcast).
-                skip = bool(_spmd_broadcast_int(1 if skip else 0))
+                verdict = 2 if cond_error else (1 if skip else 0)
+                verdict = _spmd_broadcast_int(verdict)
+                skip = verdict == 1
+                if verdict == 2 and cond_error is None:
+                    cond_error = (
+                        "condition unresolved on primary process "
+                        "(producer has no published outputs)"
+                    )
+                elif verdict != 2:
+                    cond_error = None
+            if cond_error:
+                failed_upstream.add(node.id)
+                result.nodes[node.id] = NodeResult(
+                    node_id=node.id, status="FAILED", error=cond_error,
+                )
+                continue
             if skip:
                 log.info(
                     "node %s: condition not met%s; skipping",
@@ -753,12 +779,19 @@ class LocalDagRunner:
             primary = jax.process_index() == 0
         if primary:
             store.publish_execution(ex, {}, outputs, all_ctx)
+        ex_id = ex.id
+        if self.spmd_sync:
+            # Only process 0 publishes; its id is the one that exists in the
+            # shared store, so every process's NodeResult must carry IT —
+            # a non-primary ex.id of 0 would reference a nonexistent
+            # execution (round-4 advisor finding).
+            ex_id = _spmd_broadcast_int(ex_id)
         log.info(
             "node %s: RESOLVED %s (execution %d)",
-            node.id, resolved_ids or "nothing", ex.id,
+            node.id, resolved_ids or "nothing", ex_id,
         )
         return NodeResult(
-            node_id=node.id, status="COMPLETE", execution_id=ex.id,
+            node_id=node.id, status="COMPLETE", execution_id=ex_id,
             outputs=outputs, wall_clock_s=wall,
         )
 

@@ -54,7 +54,19 @@ def main(argv=None) -> int:
     runtime_parameters = {}
     env_params = _os.environ.get("TPP_RUNTIME_PARAMETERS", "")
     if env_params:
-        runtime_parameters.update(json.loads(env_params))
+        try:
+            decoded = json.loads(env_params)
+        except json.JSONDecodeError as e:
+            parser.error(
+                "TPP_RUNTIME_PARAMETERS is not valid JSON "
+                f"({e}); value was {env_params[:200]!r}"
+            )
+        if not isinstance(decoded, dict):
+            parser.error(
+                "TPP_RUNTIME_PARAMETERS must be a JSON object "
+                f"({{name: value}}), got {type(decoded).__name__}"
+            )
+        runtime_parameters.update(decoded)
     for item in args.runtime_parameter:
         name, sep, raw = item.partition("=")
         if not sep:
