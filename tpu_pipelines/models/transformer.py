@@ -16,6 +16,7 @@ these blocks; its only parallelism was data-parallel NCCL allreduce
 
 from __future__ import annotations
 
+import os
 from typing import Any, Optional
 
 import jax
@@ -28,9 +29,59 @@ from tpu_pipelines.parallel.ring_attention import dense_attention, ring_attentio
 
 Dtype = Any
 
-# "auto" attn_impl switchover: below this sequence length plain XLA dense
-# attention outruns the Pallas kernel (measured on v5e, BERT-base).
-FLASH_MIN_SEQ_LEN = 512
+# "auto" attn_impl switchover is MEMORY-feasibility-based, not a sequence
+# threshold.  Measured on v5e (BENCH_R4_LOCAL.json flash_probe, BERT-base
+# geometry b=8 h=12 d=64): dense is faster than the Pallas kernel across
+# the whole band where its O(L^2) score temporaries fit in HBM — ~30%
+# faster at L=128 and still ~25% faster at L=2048 (22.2 ms vs 29.7 ms) —
+# because XLA fuses the fwd score/softmax chain well and the blockwise
+# kernel's extra passes are pure overhead while memory is plentiful.
+# Flash's win is FEASIBILITY: at L=8192 the dense fwd+bwd wants 38.7 GB of
+# temporaries (16x the 2.42 GB measured at 2048 — it scales with L^2) and
+# cannot compile on a 16 GB chip, while flash runs in O(block^2) VMEM
+# scratch.  So "auto" estimates the dense temp footprint and takes dense
+# whenever it fits comfortably:
+#
+#   temp ~= DENSE_ATTN_TEMP_FACTOR * B * H * Lq * Lkv * itemsize
+#
+# FACTOR=3 calibrates the estimate to XLA's measured allocation (805 MB of
+# raw [B,H,L,L] bf16 scores at the probe geometry vs 2.42 GB measured:
+# score + softmax-prob + dscore buffers are live at the backward peak).
+DENSE_ATTN_TEMP_FACTOR = 3.0
+# Dense is chosen while its temp estimate stays under this fraction of
+# device memory — headroom for params, optimizer state and activations.
+# Override per-process with TPP_DENSE_ATTN_HBM_FRACTION.
+DENSE_ATTN_HBM_FRACTION = 0.4
+
+
+def _device_memory_bytes() -> int:
+    """Per-device accelerator memory, for the auto attention choice.
+
+    TPP_HBM_BYTES overrides; otherwise the backend's own bytes_limit;
+    16 GiB (v5e) as the fallback when the backend reports nothing (CPU
+    tests) — the decision only needs the right order of magnitude."""
+    env = os.environ.get("TPP_HBM_BYTES")
+    if env:
+        return int(env)
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    return 16 * 1024**3
+
+
+def dense_attn_fits(
+    batch: int, heads: int, seq_q: int, seq_kv: int, itemsize: int = 2
+) -> bool:
+    """True when dense attention's O(L^2) temporaries fit comfortably —
+    the "auto" attn_impl rule (see module comment for the calibration)."""
+    frac = float(
+        os.environ.get("TPP_DENSE_ATTN_HBM_FRACTION", DENSE_ATTN_HBM_FRACTION)
+    )
+    temp = DENSE_ATTN_TEMP_FACTOR * batch * heads * seq_q * seq_kv * itemsize
+    return temp <= frac * _device_memory_bytes()
 
 
 class MlpBlock(nn.Module):
@@ -193,10 +244,12 @@ class MultiHeadAttention(nn.Module):
         at moderate lengths, needs local heads divisible by the axis).
       - "flash": the Pallas blockwise kernel (ops/flash_attention.py) — no
         O(L²) score tensor in HBM, fwd and bwd.
-      - "auto":  dense below FLASH_MIN_SEQ_LEN, flash at/above it.  Measured
-        on v5e: at L=128 dense is ~30% faster (one KV block makes the
-        blockwise kernel pure overhead), while flash wins once the score
-        tensor stops fitting fused in VMEM.
+      - "auto":  dense while its O(L²) score temporaries fit comfortably
+        in device memory (dense_attn_fits — a feasibility estimate, NOT a
+        sequence threshold), flash beyond that.  Measured on v5e
+        (BENCH_R4_LOCAL flash_probe): dense is faster across the whole
+        fits-in-HBM band (~25-30% at L=128-2048); flash's win is running
+        at L=8192+ where dense's 38.7 GB of temporaries cannot compile.
     Ring/ulysses/flash require self-attention without an additive bias;
     cross attention and biased attention (T5 relative positions) always
     take the dense path.
@@ -292,7 +345,12 @@ class MultiHeadAttention(nn.Module):
         impl = self.attn_impl
         if impl == "auto":
             impl = (
-                "flash" if x_q.shape[1] >= FLASH_MIN_SEQ_LEN else "dense"
+                "dense"
+                if dense_attn_fits(
+                    q.shape[0], self.n_heads, q.shape[1], k.shape[1],
+                    jnp.dtype(self.dtype).itemsize,
+                )
+                else "flash"
             )
         has_seq_axis = (
             self.mesh is not None and self.mesh.shape.get("seq", 1) > 1
