@@ -9,9 +9,12 @@ Survivability contract (this file must never produce nothing):
     ``remote_compile: read body`` INTERNAL errors mid-run);
   - the cheap taxi workload runs FIRST and the flagship BERT measurement
     SECOND, so a later crash can never zero the round's headline evidence;
-  - after EVERY workload the full cumulative report is flushed to stdout
-    (one JSON line — the final line is always the most complete) and to
-    BENCH_PARTIAL.json, so even a SIGKILL leaves the last flush behind;
+  - after EVERY workload a COMPACT headline-only JSON line (<= ~600 bytes)
+    is flushed to stdout and the FULL cumulative report to
+    BENCH_PARTIAL.json, so even a SIGKILL leaves the last flush behind.
+    The split matters: the driver captures only the last 2,000 bytes of
+    stdout and JSON-parses the final line — rounds 1-4 lost their headline
+    because the full report (3.7 KB by round 4) overflowed that tail;
   - a global wall-clock budget (``BENCH_BUDGET_S``, default 900) is checked
     between workloads: legs whose estimated cost exceeds the remaining
     budget are recorded as ``{"skipped_budget": true}`` instead of risking
@@ -218,6 +221,7 @@ def bench_bert(smoke: bool) -> dict:
         config=TrainLoopConfig(
             train_steps=steps, batch_size=batch, log_every=0,
             anchor_every=2 if smoke else 8,
+            collect_cost_analysis=True,
         ),
     )
 
@@ -235,7 +239,19 @@ def bench_bert(smoke: bool) -> dict:
     eps_fetch = _windowed_eps(fetch_t, batch)
     eps = eps_anchored or eps_fetch or eps_avg
     steps_per_sec = eps / batch if batch else 0.0
-    mfu = flops_per_step * steps_per_sec / chip_info()["peak_bf16_flops"]
+    peak = chip_info()["peak_bf16_flops"]
+    mfu = flops_per_step * steps_per_sec / peak
+    # XLA's own FLOP count for the compiled step — the cross-check that
+    # makes the analytic numerator falsifiable (VERDICT r4 weak#3).  The
+    # two counts differ in kind: the analytic one is model FLOPs (the MFU
+    # definition — useful work only), XLA's counts every op in the
+    # executable including dropout masks, layernorm and optimizer update,
+    # so mfu_xla >= mfu is the expected direction; mfu far ABOVE mfu_xla
+    # would mean the analytic numerator over-counts.
+    xla_flops = result.cost_analysis_flops_per_step
+    mfu_xla = (
+        round(xla_flops * steps_per_sec / peak, 4) if xla_flops else None
+    )
     return {
         "examples_per_sec_per_chip": eps,
         "throughput_source": (
@@ -247,6 +263,10 @@ def bench_bert(smoke: bool) -> dict:
         "examples_per_sec_per_chip_hostfetch": eps_fetch,
         "examples_per_sec_per_chip_wholerun": eps_avg,
         "mfu": round(mfu, 4),
+        "mfu_xla": mfu_xla,
+        "flops_per_step_analytic": flops_per_step,
+        "flops_per_step_xla": xla_flops,
+        "cost_analysis_source": result.cost_analysis_source,
         "params_total": counts["total"],
         "params_matmul": counts["matmul"],
         "batch_size": batch,
@@ -261,18 +281,11 @@ def bench_bert(smoke: bool) -> dict:
     }
 
 
-def bench_taxi(smoke: bool) -> dict:
-    import jax.numpy as jnp
-    import optax
-
-    from tpu_pipelines.models.taxi import DEFAULT_HPARAMS, build_taxi_model
-    from tpu_pipelines.trainer import TrainLoopConfig, train_loop
-
-    batch = 256 if smoke else 8192
-    steps = 6 if smoke else 60
-    n = batch * 8
+def _taxi_rows(n: int) -> dict:
+    """Synthetic rows at the taxi transform's output schema (one array per
+    feature, ``n`` rows) — shared by the host-fed and device-resident legs."""
     rng = np.random.default_rng(0)
-    data = {
+    return {
         "miles_z": rng.normal(size=n).astype(np.float32),
         "fare_01": rng.random(size=n).astype(np.float32),
         "log_fare_z": rng.normal(size=n).astype(np.float32),
@@ -283,6 +296,19 @@ def bench_taxi(smoke: bool) -> dict:
         "is_cash": rng.integers(0, 2, size=n).astype(np.float32),
         "label_big_tip": rng.integers(0, 2, size=n).astype(np.float32),
     }
+
+
+def bench_taxi(smoke: bool) -> dict:
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_pipelines.models.taxi import DEFAULT_HPARAMS, build_taxi_model
+    from tpu_pipelines.trainer import TrainLoopConfig, train_loop
+
+    batch = 256 if smoke else 8192
+    steps = 6 if smoke else 60
+    n = batch * 8
+    data = _taxi_rows(n)
 
     fetch_t = []
 
@@ -341,6 +367,208 @@ def bench_taxi(smoke: bool) -> dict:
                 result.examples_per_sec_per_chip / base, 4
             )
     return out
+
+
+def bench_taxi_device(smoke: bool) -> dict:
+    """Chip-bound taxi throughput: device-resident input, loop on device.
+
+    The host-fed taxi figure swings ~2.8x across same-day runs
+    (PERFORMANCE.md r4): a ~35 µs step is tunnel-latency-bound, so it
+    measures the network, not the chip — useless as a regression signal
+    (VERDICT r4 weak#4).  This leg measures the CHIP: the batch is staged
+    on device once, N optimizer steps run inside ONE jitted
+    ``lax.fori_loop`` dispatch, and the per-step time is taken from the
+    DIFFERENCE between an n2-step and an n1-step call — the dispatch +
+    tunnel round-trip constant cancels exactly.  Three repeats; the
+    relative spread is recorded and expected <10%.
+    """
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_pipelines.models.taxi import DEFAULT_HPARAMS, build_taxi_model
+
+    model = build_taxi_model(
+        {**DEFAULT_HPARAMS, "hidden_dims": [256, 128, 64]}
+    )
+
+    def loss(params, b):
+        logits = model.apply({"params": params}, b)
+        labels = jnp.asarray(b["label_big_tip"], jnp.float32)
+        return optax.sigmoid_binary_cross_entropy(logits, labels).mean()
+
+    batch = 256 if smoke else 8192
+    return _device_resident_eps(
+        loss=loss,
+        init_params=lambda rng, b: model.init(rng, b)["params"],
+        batch_data=_taxi_rows(batch),
+        batch=batch,
+        optimizer=optax.adam(1e-3),
+        n1=3 if smoke else 200,
+        n2=9 if smoke else 600,
+        repeats=2 if smoke else 3,
+    )
+
+
+def _device_resident_eps(
+    *, loss, init_params, batch_data, batch, optimizer, n1, n2, repeats
+) -> dict:
+    """Chip-bound examples/sec: device-resident input, loop on device.
+
+    N optimizer steps run inside ONE jitted ``lax.fori_loop`` dispatch and
+    the per-step time comes from the DIFFERENCE between an n2-step and an
+    n1-step call — the dispatch + tunnel round-trip constant cancels
+    exactly, so the number measures the chip, not the network (the
+    host-fed µs-scale legs swing ~2.8x with tunnel latency, VERDICT r4
+    weak#4).  Dynamic ``n`` lowers to one while_loop executable: both loop
+    lengths share a single compile.
+    """
+    import jax
+    import optax
+
+    @jax.jit
+    def run_n(params, opt_state, b, n):
+        def body(_, carry):
+            p, o = carry
+            g = jax.grad(loss)(p, b)
+            up, o = optimizer.update(g, o, p)
+            return (optax.apply_updates(p, up), o)
+
+        return jax.lax.fori_loop(0, n, body, (params, opt_state))
+
+    dbatch = jax.device_put(batch_data)
+    params = init_params(jax.random.key(0), dbatch)
+    opt_state = optimizer.init(params)
+
+    def timed(n):
+        t0 = time.perf_counter()
+        p, _ = run_n(params, opt_state, dbatch, n)
+        # Device-to-host read of the result proves all n steps executed
+        # (block_until_ready can return early on this platform).
+        np.asarray(jax.tree_util.tree_leaves(p)[0]).ravel()[0]
+        return time.perf_counter() - t0
+
+    timed(n1)  # compile + warm
+    eps_runs = []
+    for _ in range(repeats):
+        t1, t2 = timed(n1), timed(n2)
+        if t2 > t1:
+            eps_runs.append(batch * (n2 - n1) / (t2 - t1))
+    eps_runs.sort()
+    k = len(eps_runs)
+    # True median: even-length lists average the middle pair (picking
+    # eps_runs[k//2] would report the optimistic max of a 2-run list
+    # exactly when the t2>t1 guard dropped a noisy repeat).
+    med = (
+        0.0 if not eps_runs
+        else eps_runs[k // 2] if k % 2
+        else 0.5 * (eps_runs[k // 2 - 1] + eps_runs[k // 2])
+    )
+    spread = (
+        round((eps_runs[-1] - eps_runs[0]) / med, 4)
+        if med and len(eps_runs) > 1 else None
+    )
+    return {
+        "examples_per_sec_per_chip": round(med, 2),
+        "repeats": [round(e, 2) for e in eps_runs],
+        "relative_spread": spread,
+        "batch_size": batch,
+        "loop_steps": [n1, n2],
+        "method": "device_resident_fori_loop_difference",
+    }
+
+
+def bench_mnist(smoke: bool) -> dict:
+    """Measured TPU number for BASELINE configs[1] (MNIST CNN via Trainer).
+
+    The config's reference status is functional-green only; this leg adds
+    a throughput datapoint (VERDICT r4 missing#2).  Chip-bound method:
+    the whole MNIST train set fits on device many times over, so
+    host-feeding would only measure the tunnel.
+    """
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_pipelines.models.mnist import build_mnist_model
+
+    batch = 64 if smoke else 1024
+    rng = np.random.default_rng(0)
+    data = {
+        "image": rng.random((batch, 28, 28, 1)).astype(np.float32),
+        "label": rng.integers(0, 10, size=batch).astype(np.int32),
+    }
+    model = build_mnist_model({})
+
+    def loss(params, b):
+        logits = model.apply({"params": params}, b["image"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, jnp.asarray(b["label"], jnp.int32)
+        ).mean()
+
+    return _device_resident_eps(
+        loss=loss,
+        init_params=lambda rng, b: model.init(rng, b["image"])["params"],
+        batch_data=data,
+        batch=batch,
+        optimizer=optax.adam(1e-3),
+        n1=3 if smoke else 100,
+        n2=9 if smoke else 300,
+        repeats=2 if smoke else 3,
+    )
+
+
+def bench_resnet(smoke: bool) -> dict:
+    """Measured TPU number for BASELINE configs[2] (ResNet-50 ImageNet).
+
+    Functional-green in tests since round 2; this leg adds the measured
+    examples/sec/chip (VERDICT r4 missing#2) at ImageNet geometry
+    (224x224x3, ResNet-50).  Batch 256 rather than the config's 1024:
+    single-chip HBM headroom — the per-example rate is what transfers.
+    """
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_pipelines.models.resnet import build_resnet_model
+
+    if smoke:
+        batch, size, depth = 4, 32, 18
+    else:
+        batch, size, depth = 256, 224, 50
+    rng = np.random.default_rng(0)
+    data = {
+        "image": rng.random((batch, size, size, 3)).astype(np.float32),
+        "label": rng.integers(0, 1000, size=batch).astype(np.int32),
+    }
+    model = build_resnet_model({"depth": depth})
+    # BatchNorm in train mode normalizes with THIS batch's statistics (the
+    # real training compute); the running-average update is dropped from
+    # the carry — it feeds nothing downstream here, and its cost is a
+    # per-channel running mean, noise next to the convs.
+    init_vars = {}
+
+    def loss(params, b):
+        logits, _ = model.apply(
+            {"params": params, "batch_stats": init_vars["batch_stats"]},
+            b["image"], train=True, mutable=["batch_stats"],
+        )
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, jnp.asarray(b["label"], jnp.int32)
+        ).mean()
+
+    def init_params(rng, b):
+        variables = model.init(rng, b["image"], train=False)
+        init_vars["batch_stats"] = variables["batch_stats"]
+        return variables["params"]
+
+    return _device_resident_eps(
+        loss=loss,
+        init_params=init_params,
+        batch_data=data,
+        batch=batch,
+        optimizer=optax.sgd(0.1, momentum=0.9),
+        n1=2 if smoke else 5,
+        n2=6 if smoke else 15,
+        repeats=2 if smoke else 3,
+    )
 
 
 def bench_t5_decode(smoke: bool) -> dict:
@@ -519,10 +747,21 @@ def bench_flash_probe(smoke: bool) -> dict:
 
     flash = measure(flash_fn, q, k, v, iters)
     dense = measure(dense_attention, q, k, v, iters)
+    # What attn_impl="auto" decides at this geometry per sequence length —
+    # the r4 verdict's check that auto tracks best-of(dense, flash): dense
+    # is measured faster everywhere it fits (this probe), so auto must say
+    # "dense" through 2048 and only go flash where dense cannot compile.
+    from tpu_pipelines.models.transformer import dense_attn_fits
+
     out = {
         "shape": {"batch": b, "heads": h, "head_dim": d, "seq_len": l},
         "flash": flash,
         "dense": dense,
+        "auto_choice": {
+            str(seq): "dense" if dense_attn_fits(b, h, seq, seq, 2)
+            else "flash"
+            for seq in (128, 512, l, 4 * l)
+        },
     }
     if flash.get("ms_per_step") and dense.get("ms_per_step"):
         out["dense_over_flash_time"] = round(
@@ -657,15 +896,62 @@ def _finalize_headline(report: dict) -> None:
         report["mfu"] = None
 
 
+def _compact(report: dict) -> dict:
+    """Headline-only view of the cumulative report, guaranteed to fit the
+    driver's 2,000-byte stdout tail.
+
+    Rounds 1-4 all ended with ``parsed: null`` in the driver artifact: the
+    full cumulative report grew past 3.7 KB, the tail buffer kept only the
+    last 2,000 bytes, and the captured line started mid-JSON.  The fix is a
+    contract split: stdout carries ONLY this compact line (<= ~600 bytes);
+    the full report lives in BENCH_PARTIAL.json and the committed
+    BENCH_R{N}_LOCAL.json artifact.
+    """
+    e2e = report.get("pipeline_e2e") or {}
+
+    def green(name):
+        w = e2e.get(name)
+        return bool(w and w.get("green"))
+
+    skipped = sorted(
+        {
+            name for name, w in report.items()
+            if isinstance(w, dict) and w.get("skipped_budget")
+        }
+        | {
+            f"e2e_{name}" for name, w in e2e.items()
+            if isinstance(w, dict) and w.get("skipped_budget")
+        }
+    )
+    compact = {
+        "metric": report.get("metric"),
+        "value": report.get("value"),
+        "unit": report.get("unit"),
+        "vs_baseline": report.get("vs_baseline"),
+        "mfu": report.get("mfu"),
+        "mfu_xla": (report.get("bert") or {}).get("mfu_xla"),
+        "bert_e2e_green": green("bert"),
+        "taxi_e2e_green": green("taxi"),
+        "elapsed_s": report.get("elapsed_s"),
+        "skipped": skipped,
+        "error_legs": sorted(report.get("errors", {})),
+        "full_report": "BENCH_PARTIAL.json",
+    }
+    if "terminated" in report:
+        compact["terminated"] = report["terminated"]
+    return compact
+
+
 def _flush(report: dict) -> None:
     _finalize_headline(report)
-    line = json.dumps(report)
-    print(line, flush=True)
+    # stdout: compact headline line only (driver tail keeps 2,000 bytes and
+    # JSON-parses the LAST line — it must never see the multi-KB report).
+    print(json.dumps(_compact(report)), flush=True)
     try:
         # Atomic replace: a kill mid-write must corrupt the temp file, not
         # the last good snapshot the survivability contract promises.
         with open(PARTIAL_FILE + ".tmp", "w") as f:
-            f.write(line + "\n")
+            f.write(json.dumps(report) + "\n")
         os.replace(PARTIAL_FILE + ".tmp", PARTIAL_FILE)
     except OSError:
         pass
@@ -745,6 +1031,7 @@ def main() -> None:
     # Order: cheapest evidence first, flagship second, e2e-BERT (the
     # north-star green target) before e2e-taxi, probes last.
     leg("taxi", bench_taxi, est_cost_s=90, post=taxi_best_of_2)
+    leg("taxi_device", bench_taxi_device, est_cost_s=60, retries=1)
     leg("bert", bench_bert, est_cost_s=120)
     e2e: dict = {}
     report["pipeline_e2e"] = e2e
@@ -767,6 +1054,8 @@ def main() -> None:
 
     e2e_leg("bert", bench_e2e_bert, est_cost_s=200)
     e2e_leg("taxi", bench_e2e_taxi, est_cost_s=120)
+    leg("mnist", bench_mnist, est_cost_s=60, retries=1)
+    leg("resnet", bench_resnet, est_cost_s=150, retries=1)
     leg("flash_probe", bench_flash_probe, est_cost_s=100, retries=1)
     leg("t5_decode", bench_t5_decode, est_cost_s=90, retries=1)
 

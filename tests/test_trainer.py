@@ -422,3 +422,28 @@ def test_export_no_weight_constants(tmp_path):
     np.testing.assert_allclose(
         np.asarray(loaded.device_predict(batch)), np.asarray(want), rtol=1e-5
     )
+
+
+def test_train_loop_cost_analysis():
+    """collect_cost_analysis records XLA's own per-step FLOP count — the
+    falsifiability cross-check for analytic MFU numerators (r4 weak#3).
+    For this 2-param linear regression the naive 6NT estimate (384) is an
+    OVER-count (no dx pass exists, params are scalar-ish), and XLA's
+    optimized-executable figure comes in well below it — demonstrating
+    the check can actually falsify an inflated numerator."""
+    loss_fn, init_fn = _linreg_pieces()
+    _, result = train_loop(
+        loss_fn=loss_fn,
+        init_params_fn=init_fn,
+        optimizer=optax.adam(0.1),
+        train_iter=_synthetic_iter(),
+        config=TrainLoopConfig(
+            train_steps=3, batch_size=32, log_every=0,
+            collect_cost_analysis=True,
+        ),
+    )
+    assert result.cost_analysis_flops_per_step is not None
+    assert result.cost_analysis_source in ("compiled", "lowered")
+    # fwd matmul (32x1 @ 1x1) is 64 FLOPs; with backward + optimizer the
+    # all-ops count must land above the bare fwd and below the 6NT 384.
+    assert 64 <= result.cost_analysis_flops_per_step <= 384

@@ -134,3 +134,11 @@ class TrainResult:
     # {badput_kind: fraction of job wall-clock}, e.g. {"tpu_initialization":
     # 0.02, "training_prep": 0.01, "data_loading_sync": 0.05, "other": ...}.
     badput: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # XLA's own per-step FLOP count for the train step
+    # (TrainLoopConfig.collect_cost_analysis=True) — the auditable
+    # cross-check for analytic MFU numerators.  Source "compiled" = cost
+    # analysis of the optimized executable; "lowered" = HLO cost analysis
+    # of the unoptimized module (fallback when the backend's compiled
+    # analysis is unavailable).  None when collection was off or failed.
+    cost_analysis_flops_per_step: Optional[float] = None
+    cost_analysis_source: str = ""
