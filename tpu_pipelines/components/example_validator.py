@@ -45,14 +45,25 @@ ANOMALIES_FILE = "anomalies.json"
 
 
 def validate_split(
-    split_stats: SplitStatistics, schema: Schema
+    split_stats: SplitStatistics,
+    schema: Schema,
+    environment: Optional[str] = None,
 ) -> List[Anomaly]:
+    """Schema-conformance anomalies for one split.
+
+    ``environment`` scopes presence expectations (TFDV schema
+    environments): a feature not expected in the environment (e.g. the
+    label under ``environment="SERVING"``) may be absent without anomaly —
+    but when present, its type/domain/range constraints still apply."""
     anomalies: List[Anomaly] = []
     split = split_stats.split
     seen = set(split_stats.features)
     for name, feat in schema.features.items():
+        expected = schema.expected_in(name, environment)
         fs = split_stats.features.get(name)
         if fs is None or fs.presence == 0.0:
+            if not expected:
+                continue
             anomalies.append(
                 Anomaly(split, name, "MISSING_FEATURE", "ERROR",
                         f"schema feature {name!r} absent from split")
@@ -64,7 +75,7 @@ def validate_split(
                         f"expected {feat.type.value}, found {fs.type}")
             )
             continue
-        if fs.presence < feat.min_presence:
+        if expected and fs.presence < feat.min_presence:
             anomalies.append(
                 Anomaly(split, name, "PRESENCE", "ERROR",
                         f"present in {fs.presence:.4f} < required "
@@ -215,6 +226,11 @@ def compare_splits(
         "skew_linf_threshold": Parameter(type=float, default=0.0),
         "skew_js_threshold": Parameter(type=float, default=0.0),
         "skew_feature_thresholds": Parameter(type=dict, default=None),
+        # Schema environment to validate under ("" = no environment: every
+        # feature expected).  ExampleValidator(environment="SERVING")
+        # validates label-less serving data against the training schema
+        # without MISSING_FEATURE noise (TFDV schema environments).
+        "environment": Parameter(type=str, default=""),
         # Fail the pipeline on ERROR-severity anomalies.
         "fail_on_anomalies": Parameter(type=bool, default=True),
     },
@@ -222,9 +238,10 @@ def compare_splits(
 def ExampleValidator(ctx):
     stats = load_statistics(ctx.input("statistics").uri)
     schema = Schema.load(ctx.input("schema").uri)
+    environment = ctx.exec_properties.get("environment") or None
     anomalies: List[Anomaly] = []
     for split_stats in stats.values():
-        anomalies.extend(validate_split(split_stats, schema))
+        anomalies.extend(validate_split(split_stats, schema, environment))
 
     baseline_uri = ctx.exec_properties["baseline_statistics_uri"]
     if baseline_uri:

@@ -24,12 +24,29 @@ BLESSING_FILE = "BLESSED"
 NOT_BLESSED_FILE = "NOT_BLESSED"
 
 
+def serving_batch_filter(batch, schema, environment):
+    """Keep only features the schema expects in ``environment`` (labels drop
+    out under "SERVING") — the canary then poses exactly the request
+    production serving will see.  Columns the schema does not know keep
+    flowing (passthrough keys are serving-legal)."""
+    return {
+        k: v for k, v in batch.items()
+        if k not in schema.features or schema.expected_in(k, environment)
+    }
+
+
 @component(
-    inputs={"model": "Model", "examples": "Examples"},
+    inputs={"model": "Model", "examples": "Examples", "schema": "Schema"},
+    optional_inputs=("schema",),
     outputs={"blessing": "InfraBlessing"},
     parameters={
         "split": Parameter(type=str, default="eval"),
         "num_examples": Parameter(type=int, default=8),
+        # With a schema wired, the canary batch keeps ONLY features the
+        # schema expects in this environment (labels drop out under
+        # "SERVING") — the canary then exercises the exact request surface
+        # production serving will see (TFDV schema environments).
+        "environment": Parameter(type=str, default="SERVING"),
         # Raw examples (apply embedded transform) vs pre-transformed.
         "raw_examples": Parameter(type=bool, default=True),
         # "inprocess": load + call predict directly.  "http"/"grpc": boot
@@ -57,6 +74,14 @@ def InfraValidator(ctx):
     try:
         data = examples_io.read_split(ctx.input("examples").uri, split)
         batch = {k: v[:n] for k, v in data.items()}
+        if ctx.inputs.get("schema"):
+            from tpu_pipelines.data.schema import Schema
+
+            batch = serving_batch_filter(
+                batch,
+                Schema.load(ctx.input("schema").uri),
+                ctx.exec_properties.get("environment") or None,
+            )
         binary = ctx.exec_properties.get("serving_binary", "inprocess")
         if binary == "http":
             predict = _http_canary(

@@ -26,6 +26,12 @@ _DOMAIN_MAX_CARDINALITY = 100
         "split": Parameter(type=str, default="train"),
         "infer_domains": Parameter(type=bool, default=True),
         "infer_ranges": Parameter(type=bool, default=False),
+        # Schema environments (TFDV parity): features listed here — labels,
+        # typically — get not_in_environment=["SERVING"], and the schema
+        # declares TRAINING/SERVING default environments, so serving-time
+        # validation (ExampleValidator(environment="SERVING"), the
+        # InfraValidator canary) accepts label-less batches.
+        "exclude_at_serving": Parameter(type=list, default=None),
     },
 )
 def SchemaGen(ctx):
@@ -37,6 +43,16 @@ def SchemaGen(ctx):
         )
     s = stats[split]
     schema = Schema()
+    exclude_at_serving = set(
+        ctx.exec_properties.get("exclude_at_serving") or ()
+    )
+    if exclude_at_serving:
+        schema.default_environments = ["TRAINING", "SERVING"]
+        missing = exclude_at_serving - set(s.features)
+        if missing:
+            raise ValueError(
+                f"exclude_at_serving names unknown features {sorted(missing)}"
+            )
     for name, fs in s.features.items():
         feat = Feature(name=name, type=FeatureType(fs.type))
         # Presence with slack: a feature fully present in train is required;
@@ -55,6 +71,8 @@ def SchemaGen(ctx):
         if ctx.exec_properties["infer_ranges"] and fs.numeric is not None:
             feat.min_value = fs.numeric.min
             feat.max_value = fs.numeric.max
+        if name in exclude_at_serving:
+            feat.not_in_environment = ["SERVING"]
         schema.features[name] = feat
     out = ctx.output("schema")
     schema.save(out.uri)

@@ -33,6 +33,15 @@ class Feature:
     max_value: Optional[float] = None
     # Fraction of out-of-domain values tolerated before flagging an anomaly.
     distribution_constraint: float = 0.0
+    # Schema environments (TFDV parity): a feature's presence requirements
+    # apply only in environments where it is EXPECTED.  ``in_environment``
+    # (exclusive allow-list) wins over ``not_in_environment`` (deny-list);
+    # with neither set the feature follows Schema.default_environments.
+    # Canonical use: the label feature carries
+    # ``not_in_environment=["SERVING"]`` so label-less serving batches
+    # validate cleanly against the training schema.
+    in_environment: List[str] = dataclasses.field(default_factory=list)
+    not_in_environment: List[str] = dataclasses.field(default_factory=list)
 
     def to_json(self) -> Dict:
         d = dataclasses.asdict(self)
@@ -49,23 +58,54 @@ class Feature:
 @dataclasses.dataclass
 class Schema:
     features: Dict[str, Feature] = dataclasses.field(default_factory=dict)
-    # Features a model is allowed to not see at serving time (e.g. label).
-    optional_at_serving: List[str] = dataclasses.field(default_factory=list)
+    # Environments this schema knows about (e.g. ["TRAINING", "SERVING"]).
+    # Empty = environments unused: every feature expected everywhere.
+    default_environments: List[str] = dataclasses.field(default_factory=list)
+
+    def expected_in(self, feature_name: str, environment: Optional[str]) -> bool:
+        """Is ``feature_name`` expected to be present in ``environment``?
+
+        ``environment=None`` (validation without an environment) expects
+        every feature — the pre-environment behavior."""
+        feat = self.features.get(feature_name)
+        if feat is None:
+            return False
+        if environment is None:
+            return True
+        if feat.in_environment:
+            return environment in feat.in_environment
+        if feat.not_in_environment:
+            return environment not in feat.not_in_environment
+        if self.default_environments:
+            return environment in self.default_environments
+        return True
 
     def to_json(self) -> Dict:
         return {
             "features": {n: f.to_json() for n, f in self.features.items()},
-            "optional_at_serving": list(self.optional_at_serving),
+            "default_environments": list(self.default_environments),
         }
 
     @classmethod
     def from_json(cls, d: Dict) -> "Schema":
-        return cls(
+        schema = cls(
             features={
                 n: Feature.from_json(f) for n, f in d.get("features", {}).items()
             },
-            optional_at_serving=list(d.get("optional_at_serving", [])),
+            default_environments=list(d.get("default_environments", [])),
         )
+        # Migrate the pre-environment wire format: ``optional_at_serving``
+        # was a Schema-level list of features a serving batch may omit —
+        # exactly ``not_in_environment=["SERVING"]`` in today's model.
+        legacy = d.get("optional_at_serving") or []
+        if legacy:
+            if not schema.default_environments:
+                schema.default_environments = ["TRAINING", "SERVING"]
+            for name in legacy:
+                feat = schema.features.get(name)
+                if feat is not None and not feat.not_in_environment:
+                    feat.not_in_environment = ["SERVING"]
+        return schema
 
     FILE_NAME = "schema.json"
 
