@@ -1,0 +1,82 @@
+"""Persistent XLA compile cache knob (utils/compile_cache.py)."""
+
+import importlib
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(env_extra, code):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", **env_extra}
+    return subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, env=env, cwd=REPO,
+    )
+
+
+CODE = """
+import jax
+from tpu_pipelines.utils.compile_cache import maybe_enable_compile_cache
+print("enabled:", maybe_enable_compile_cache())
+print("dir:", jax.config.jax_compilation_cache_dir)
+"""
+
+
+def test_cache_enabled_by_default(tmp_path):
+    proc = _run({"TPP_COMPILE_CACHE_DIR": str(tmp_path / "xc")}, CODE)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    assert "enabled: True" in proc.stdout
+    assert str(tmp_path / "xc") in proc.stdout
+    assert (tmp_path / "xc").is_dir()
+
+
+def test_cache_disable_knob(tmp_path):
+    proc = _run(
+        {"TPP_COMPILE_CACHE": "0",
+         "TPP_COMPILE_CACHE_DIR": str(tmp_path / "xc")}, CODE,
+    )
+    assert proc.returncode == 0, proc.stderr[-500:]
+    assert "enabled: False" in proc.stdout
+    assert not (tmp_path / "xc").exists()
+
+
+def test_idempotent_in_process(tmp_path, monkeypatch):
+    import jax
+
+    from tpu_pipelines.utils import compile_cache
+
+    # Sandbox: never point the live test process's jax config at the
+    # developer's real ~/.cache (later slow compiles would persist there).
+    monkeypatch.setenv("TPP_COMPILE_CACHE_DIR", str(tmp_path / "xc"))
+    prev = jax.config.jax_compilation_cache_dir
+    importlib.reload(compile_cache)
+    try:
+        first = compile_cache.maybe_enable_compile_cache()
+        assert compile_cache.maybe_enable_compile_cache() == first
+        assert first is True
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path / "xc")
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+        importlib.reload(compile_cache)
+
+
+def test_user_configured_cache_dir_is_respected(tmp_path, monkeypatch):
+    """A cache dir the user set via jax.config must never be repointed."""
+    import jax
+
+    from tpu_pipelines.utils import compile_cache
+
+    monkeypatch.setenv("TPP_COMPILE_CACHE_DIR", str(tmp_path / "ours"))
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", str(tmp_path / "theirs"))
+    importlib.reload(compile_cache)
+    try:
+        assert compile_cache.maybe_enable_compile_cache() is True
+        assert jax.config.jax_compilation_cache_dir == str(
+            tmp_path / "theirs"
+        )
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+        importlib.reload(compile_cache)

@@ -132,6 +132,11 @@ def _run_shard(args) -> int:
 
 def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO)
+    # Subprocess-isolated trials all compile the same model family —
+    # exactly the repeat-compile case the persistent cache removes.
+    from tpu_pipelines.utils.compile_cache import maybe_enable_compile_cache
+
+    maybe_enable_compile_cache()
     parser = argparse.ArgumentParser(description=__doc__)
     sub = parser.add_subparsers(dest="cmd", required=True)
 

@@ -159,6 +159,14 @@ class LocalDagRunner:
     """
 
     def __init__(self, max_retries: int = 0, spmd_sync: bool = False):
+        # Persistent XLA compile cache: the single biggest repeat-run cost
+        # on TPU is recompiling unchanged programs (~45 s for the BERT
+        # step, ~16 s warm-cached); enable before any executor compiles.
+        from tpu_pipelines.utils.compile_cache import (
+            maybe_enable_compile_cache,
+        )
+
+        maybe_enable_compile_cache()
         self.max_retries = max_retries
         # Multi-host SPMD mode (run_node with a live coordination service):
         # workers execute against a point-in-time snapshot of the shared

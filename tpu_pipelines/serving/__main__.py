@@ -25,6 +25,9 @@ log = logging.getLogger("tpu_pipelines.serving")
 
 
 def main(argv=None) -> int:
+    from tpu_pipelines.utils.compile_cache import maybe_enable_compile_cache
+
+    maybe_enable_compile_cache()
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--model-name", required=True)
     parser.add_argument("--base-dir", required=True,

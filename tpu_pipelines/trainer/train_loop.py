@@ -188,6 +188,10 @@ def train_loop(
 ) -> Tuple[Any, TrainResult]:
     """Run the jitted train loop; returns (final_params, TrainResult).
 
+    Enables the persistent XLA compile cache (utils/compile_cache.py)
+    before compiling, so a re-run of an unchanged program — another
+    trial, a retry, a resumed job — skips the multi-10-second compile.
+
     ``loss_fn(params, batch, rng) -> (loss, metrics)`` must be jax-traceable.
     ``init_params_fn(rng, sample_batch)`` builds the params pytree.
     ``train_iter`` yields host batches (dict of numpy, fixed shapes).
@@ -199,6 +203,9 @@ def train_loop(
            -> (loss, (metrics, new_model_state))``
     and the returned "final params" is ``(params, model_state)``.
     """
+    from tpu_pipelines.utils.compile_cache import maybe_enable_compile_cache
+
+    maybe_enable_compile_cache()
     # Badput accounting (SURVEY.md §5): the real ml_goodput_measurement
     # algebra over a local logger; falls back to the host-input-wait proxy
     # when the library is absent (tracker no-ops, summary() == {}).

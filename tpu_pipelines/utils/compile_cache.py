@@ -1,0 +1,78 @@
+"""Persistent XLA compilation cache — compile once per program, per machine.
+
+Every fresh process re-pays the full XLA compile (measured on the
+tunneled v5e: ~45-55 s for the BERT-base train step).  JAX's persistent
+compilation cache keyed on (HLO, compile options, backend) removes that
+for any repeated program: measured here, a warm-cache fresh process
+compiles + runs the same step in ~16 s vs ~49 s uncached — a ~3x win for
+the repeat-compile cases that are everywhere in a pipeline framework:
+re-running a pipeline after editing one node, subprocess-isolated Tuner
+trials (each trial process compiles the same model), serving restarts,
+and retries.
+
+Two platform caveats, measured on the tunneled test chip: (1) the write
+cost scales with executable size and the tunnel hop — +6 s persisting a
+batch-32 BERT step, +86 s for the batch-256 one — so one-shot runs that
+will never re-read the entry can lose (bench.py pins the cache off for
+exactly that reason); (2) the tunnel's remote_compile service caches
+server-side within a session, so SAME-process recompiles are already
+cheaper (~40 s) than first compiles (~137 s) without this cache — the
+persistent cache's win is across processes and across sessions.
+
+Enabled by default at a per-user cache dir; control with:
+
+  TPP_COMPILE_CACHE=0          disable entirely
+  TPP_COMPILE_CACHE_DIR=<dir>  cache location (default
+                               ~/.cache/tpu_pipelines/xla-cache)
+
+Only compiles slower than 1 s are persisted, so µs-scale CPU test jits
+don't churn the cache.  Callers invoke :func:`maybe_enable_compile_cache`
+at process entry (runner construction, cluster-pod entrypoint, tuner
+trial, serving startup, bench) — idempotent, and a failure to set up the
+cache degrades to uncached compiles, never an error.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+_STATE = {"configured": False, "enabled": False}
+
+
+def maybe_enable_compile_cache() -> bool:
+    """Idempotently point JAX at the persistent compilation cache.
+
+    Returns True when the cache is active.  Must run before the first
+    compile to benefit that compile; safe (and cheap) to call any time.
+    """
+    if _STATE["configured"]:
+        return _STATE["enabled"]
+    _STATE["configured"] = True
+    if os.environ.get("TPP_COMPILE_CACHE", "1") == "0":
+        return False
+    try:
+        import jax
+
+        if jax.config.jax_compilation_cache_dir:
+            # The user configured a cache themselves (e.g. a shared
+            # directory) — respect it, never silently repoint it.
+            _STATE["enabled"] = True
+            return True
+        cache_dir = os.environ.get("TPP_COMPILE_CACHE_DIR") or os.path.join(
+            os.path.expanduser("~"), ".cache", "tpu_pipelines", "xla-cache"
+        )
+        os.makedirs(cache_dir, exist_ok=True)
+        # Filter BEFORE activating the dir: if this knob is missing on a
+        # jax version, we fail closed (no cache) rather than activating an
+        # unfiltered cache that micro-jits would churn.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception as e:  # noqa: BLE001 — cache is an optimization only
+        log.warning("persistent compile cache unavailable: %s", e)
+        return False
+    _STATE["enabled"] = True
+    log.debug("persistent XLA compile cache at %s", cache_dir)
+    return True
