@@ -12,6 +12,13 @@ no devices have been touched yet.
 
 import os
 
+# Hermetic tests: the framework's default-on persistent compile cache
+# (utils/compile_cache.py) must never write into the developer's real
+# ~/.cache from the suite — slow mesh-test compiles would persist there
+# and make later timings non-reproducible.  Cache-specific tests opt back
+# in explicitly (tests/test_compile_cache.py).
+os.environ.setdefault("TPP_COMPILE_CACHE", "0")
+
 if os.environ.get("TPP_TEST_REAL_TPU", "") != "1":
     # Default: CPU mesh.  TPP_TEST_REAL_TPU=1 leaves the real backend in
     # place so the TPU-gated tests (flash memory analysis etc.) can run on

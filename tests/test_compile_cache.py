@@ -9,7 +9,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run(env_extra, code):
-    env = {**os.environ, "JAX_PLATFORMS": "cpu", **env_extra}
+    # Re-enable explicitly: conftest pins TPP_COMPILE_CACHE=0 for the rest
+    # of the suite, and subprocesses inherit that.
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "TPP_COMPILE_CACHE": "1",
+           **env_extra}
     return subprocess.run(
         [sys.executable, "-c", code], capture_output=True, text=True,
         timeout=120, env=env, cwd=REPO,
@@ -49,8 +52,12 @@ def test_idempotent_in_process(tmp_path, monkeypatch):
 
     # Sandbox: never point the live test process's jax config at the
     # developer's real ~/.cache (later slow compiles would persist there).
+    monkeypatch.setenv("TPP_COMPILE_CACHE", "1")
     monkeypatch.setenv("TPP_COMPILE_CACHE_DIR", str(tmp_path / "xc"))
     prev = jax.config.jax_compilation_cache_dir
+    # Another test (or an earlier runner construction) may have set the
+    # config already; clear it so this test exercises the enable path.
+    jax.config.update("jax_compilation_cache_dir", None)
     importlib.reload(compile_cache)
     try:
         first = compile_cache.maybe_enable_compile_cache()
@@ -68,6 +75,7 @@ def test_user_configured_cache_dir_is_respected(tmp_path, monkeypatch):
 
     from tpu_pipelines.utils import compile_cache
 
+    monkeypatch.setenv("TPP_COMPILE_CACHE", "1")
     monkeypatch.setenv("TPP_COMPILE_CACHE_DIR", str(tmp_path / "ours"))
     prev = jax.config.jax_compilation_cache_dir
     jax.config.update("jax_compilation_cache_dir", str(tmp_path / "theirs"))
