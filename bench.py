@@ -160,6 +160,11 @@ def _windowed_eps(fetch_t, batch: int, window: int = 8):
     return round(window * batch / med, 2) if med > 0 else None
 
 
+# Flagship non-smoke batch size; the goodput leg's step-sizing math reads
+# the SAME constant, so the two can't drift.
+BERT_BENCH_BATCH = 256
+
+
 def bench_bert(
     smoke: bool,
     steps_override: int = 0,
@@ -173,7 +178,7 @@ def bench_bert(
     from tpu_pipelines.trainer import TrainLoopConfig, train_loop
 
     seq_len = 128
-    batch = 8 if smoke else 256
+    batch = 8 if smoke else BERT_BENCH_BATCH
     steps = steps_override or (6 if smoke else 64)
     hp = {
         **DEFAULT_HPARAMS,
@@ -302,18 +307,34 @@ def _taxi_rows(n: int) -> dict:
     }
 
 
-def bench_bert_goodput(smoke: bool) -> dict:
-    """Converged strict goodput: a ~1,800-step BERT leg (r4 weak#6).
+def bench_bert_goodput(
+    smoke: bool,
+    budget_s: float = 0.0,
+    eps_hint: float = 0.0,
+) -> dict:
+    """Converged strict goodput: the longest BERT leg the budget allows.
 
     The 64-step flagship leg reads strict goodput ~0.09 because one-time
     compile dominates a 10-second run.  Strict goodput converges as
     steps/(compile + steps): with ~34 s of init+compile, ~600 steps
     (~98 s) read 0.74 (round-5 measurement) and ~1,800 steps (~295 s)
-    cross 0.9 — this leg runs the latter.  goodput_post_compile isolates
-    the steady state (~0.98 at every scale).  Runs only when the budget
-    allows; skipped cleanly otherwise."""
+    cross 0.9.  Tunnel pace varies run to run, so the step count ADAPTS:
+    from the flagship leg's measured examples/sec and the remaining
+    budget (minus a 90 s init/compile/margin reserve), capped at 1,800 —
+    the leg runs whenever its budget floor is met and converges as far as
+    the round's budget actually permits, instead of gambling a fixed size
+    against a moody tunnel.  With no throughput hint (flagship leg failed
+    or skipped) it falls back to the 600-step size measured to fit any
+    budget that admits the leg at all.  goodput_post_compile isolates the
+    steady state (~0.98 at every scale)."""
+    if budget_s and eps_hint:
+        steps = int(
+            max(64, min(1800, (budget_s - 90) * eps_hint / BERT_BENCH_BATCH))
+        )
+    else:
+        steps = 600
     out = bench_bert(
-        smoke, steps_override=4 if smoke else 1800, cost_analysis=False,
+        smoke, steps_override=4 if smoke else steps, cost_analysis=False,
     )
     keep = (
         "goodput", "goodput_post_compile", "steps_timed",
@@ -1107,8 +1128,21 @@ def main() -> None:
     leg("resnet", bench_resnet, est_cost_s=150, retries=1)
     leg("flash_probe", bench_flash_probe, est_cost_s=100, retries=1)
     leg("t5_decode", bench_t5_decode, est_cost_s=90, retries=1)
-    # Least critical, so last: the converged-goodput evidence leg.
-    leg("bert_goodput", bench_bert_goodput, est_cost_s=400, retries=1)
+    # Least critical, so last: the converged-goodput evidence leg — sized
+    # from whatever budget is actually left (~90 s compile/init reserve
+    # plus the computed step time must fit under remaining()).
+    leg(
+        "bert_goodput",
+        lambda s: bench_bert_goodput(
+            s,
+            budget_s=remaining(),
+            eps_hint=(report.get("bert") or {}).get(
+                "examples_per_sec_per_chip"
+            ) or 0.0,
+        ),
+        est_cost_s=160,
+        retries=1,
+    )
 
     report["elapsed_s"] = round(time.monotonic() - t0, 1)
     _flush(report)
