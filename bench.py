@@ -682,11 +682,72 @@ def bench_t5_decode(smoke: bool) -> dict:
     return out
 
 
-def _run_example_pipeline(name: str, env: dict) -> dict:
+def _canonical_lineage(metadata_path: str, pipeline_root: str) -> list:
+    """Id-free canonical form of a run's published lineage: per execution,
+    (node, state, sorted input events, sorted output events) with artifact
+    URIs relativized to the pipeline root — two runs publishing the same
+    artifacts/lineage compare equal regardless of store row ids, publish
+    interleaving, or pipeline home."""
+    from tpu_pipelines.metadata import open_store
+    from tpu_pipelines.metadata.types import EventType
+
+    store = open_store(metadata_path)
+    root = os.path.abspath(pipeline_root)
+
+    def rel(uri: str) -> str:
+        a = os.path.abspath(uri)
+        return os.path.relpath(a, root) if a.startswith(root) else uri
+
+    entries = []
+    for ex in store.get_executions():
+        ins, outs = [], []
+        for ev in store.get_events_by_execution(ex.id):
+            art = store.get_artifact(ev.artifact_id)
+            row = (ev.path, ev.index, rel(art.uri), art.type_name,
+                   art.state.value)
+            (ins if ev.type == EventType.INPUT else outs).append(row)
+        entries.append(
+            (ex.node_id, ex.state.value, tuple(sorted(ins)),
+             tuple(sorted(outs)))
+        )
+    store.close()
+    return sorted(entries)
+
+
+def _critical_path(ir, node_walls: dict) -> tuple:
+    """(path node ids, total seconds): the longest dependency chain through
+    the DAG by measured per-node wall-clock — the lower bound no scheduler
+    can beat, and the denominator of the achievable concurrency win."""
+    best: dict = {}
+    prev: dict = {}
+    for node in ir.nodes:  # ir.nodes is topologically ordered
+        up = [u for u in node.upstream if u in best]
+        base = max((best[u] for u in up), default=0.0)
+        if up:
+            prev[node.id] = max(up, key=lambda u: best[u])
+        best[node.id] = base + node_walls.get(node.id, 0.0)
+    if not best:
+        return [], 0.0
+    end = max(best, key=lambda n: best[n])
+    path = [end]
+    while path[-1] in prev:
+        path.append(prev[path[-1]])
+    return list(reversed(path)), round(best[end], 2)
+
+
+def _run_example_pipeline(
+    name: str,
+    env: dict,
+    max_parallel_nodes=None,
+    capture_lineage: bool = False,
+) -> dict:
     """One example pipeline end-to-end in a fresh home (no cache hits);
-    returns total wall-clock + the per-component breakdown."""
+    returns total wall-clock + the per-component breakdown.  The effective
+    scheduler pool size is always recorded so BENCH_*.json files stay
+    comparable across concurrency configs."""
     import tempfile
 
+    from tpu_pipelines.dsl.compiler import Compiler
     from tpu_pipelines.orchestration import LocalDagRunner
     from tpu_pipelines.utils.module_loader import load_fn
 
@@ -700,23 +761,40 @@ def _run_example_pipeline(name: str, env: dict) -> dict:
         with tempfile.TemporaryDirectory() as td:
             pipeline = load_fn(module, "create_pipeline")(td)
             t0 = time.perf_counter()
-            result = LocalDagRunner().run(pipeline)
+            result = LocalDagRunner(
+                max_parallel_nodes=max_parallel_nodes
+            ).run(pipeline)
             total = time.perf_counter() - t0
+            lineage = (
+                _canonical_lineage(
+                    pipeline.metadata_path, pipeline.pipeline_root
+                )
+                if capture_lineage else None
+            )
+            ir = Compiler().compile(pipeline) if capture_lineage else None
     finally:
         for k, v in saved.items():
             if v is None:
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
-    return {
+    out = {
         "green": result.succeeded,
         "wall_clock_s": round(total, 2),
+        "max_parallel_nodes": result.max_parallel_nodes,
         "env": env,
         "nodes": {
             nid: {"status": nr.status, "wall_s": round(nr.wall_clock_s, 2)}
             for nid, nr in result.nodes.items()
         },
     }
+    if capture_lineage:
+        out["lineage"] = lineage
+        walls = {nid: nr.wall_clock_s for nid, nr in result.nodes.items()}
+        path, path_s = _critical_path(ir, walls)
+        out["critical_path"] = path
+        out["critical_path_s"] = path_s
+    return out
 
 
 def bench_e2e_taxi(smoke: bool) -> dict:
@@ -727,6 +805,67 @@ def bench_e2e_taxi(smoke: bool) -> dict:
         "TAXI_TRAIN_STEPS": "4" if smoke else "200",
         "TPP_DISABLE_MID_CHECKPOINT": "1",
     })
+
+
+# Worker-pool size for the concurrent leg of the scheduler comparison: wide
+# enough for every independent-branch pair in the taxi DAG
+# (ExampleValidator ∥ Transform chain, Evaluator ∥ InfraValidator).
+E2E_SCHED_WORKERS = 4
+
+
+def bench_e2e_taxi_sched(smoke: bool) -> dict:
+    """Sequential vs concurrent wall-clock on the branching taxi DAG — the
+    wall-clock head of the two-headed BASELINE metric.  Runs the identical
+    9-node pipeline twice in fresh homes: max_parallel_nodes=1 (the classic
+    topo loop) and the ready-set scheduler with E2E_SCHED_WORKERS.  Reports
+    both wall-clocks, the per-node critical-path breakdown (the
+    no-scheduler-can-beat lower bound), and whether the two runs published
+    identical artifacts/lineage (id-free canonical comparison)."""
+    env = {
+        "TAXI_TRAIN_STEPS": "4" if smoke else "200",
+        "TPP_DISABLE_MID_CHECKPOINT": "1",
+    }
+    # Discarded warm-up first: one cheap pass (4 steps — jit caches are
+    # shape-keyed, so step count doesn't matter) absorbs the in-process
+    # one-time costs (module loads, XLA compiles).  Without it, whichever
+    # measured leg runs first eats ~seconds of compile and the comparison
+    # measures warm-up order, not the scheduler.
+    _run_example_pipeline(
+        "taxi", {**env, "TAXI_TRAIN_STEPS": "4"}, max_parallel_nodes=1
+    )
+    conc = _run_example_pipeline(
+        "taxi", env, max_parallel_nodes=E2E_SCHED_WORKERS,
+        capture_lineage=True,
+    )
+    seq = _run_example_pipeline(
+        "taxi", env, max_parallel_nodes=1, capture_lineage=True
+    )
+    seq_wall, conc_wall = seq["wall_clock_s"], conc["wall_clock_s"]
+    return {
+        "green": seq["green"] and conc["green"],
+        "sequential_wall_s": seq_wall,
+        "concurrent_wall_s": conc_wall,
+        "speedup": round(seq_wall / conc_wall, 3) if conc_wall else None,
+        "concurrent_strictly_faster": conc_wall < seq_wall,
+        # Branch overlap needs a spare core to land on: a 1-cpu host can
+        # only show parity (the scheduler still must not LOSE there); the
+        # win materializes on multicore/TPU hosts.
+        "host_cpus": os.cpu_count(),
+        "max_parallel_nodes": {
+            "sequential": seq["max_parallel_nodes"],
+            "concurrent": conc["max_parallel_nodes"],
+        },
+        # Same artifacts, same lineage, both modes — the single-writer
+        # discipline evidence (ids/fingerprints excluded: row ids depend on
+        # publish interleaving, checkpoint payloads embed timestamps).
+        "lineage_identical": seq["lineage"] == conc["lineage"],
+        "lineage_executions": len(conc["lineage"]),
+        "critical_path": conc["critical_path"],
+        "critical_path_s": conc["critical_path_s"],
+        "nodes_sequential": seq["nodes"],
+        "nodes_concurrent": conc["nodes"],
+        "env": env,
+    }
 
 
 def bench_e2e_bert(smoke: bool) -> dict:
@@ -1047,6 +1186,17 @@ def main() -> None:
         "budget_s": budget,
         "errors": {},
         "smoke": smoke,
+        # Scheduler concurrency config, recorded so BENCH_*.json files from
+        # different rounds/configs stay comparable (each e2e leg also
+        # records its own effective max_parallel_nodes).
+        "concurrency": {
+            "scheduler": "ready_set",
+            "default_policy": "n_dag_roots",
+            "env_max_parallel_nodes": (
+                os.environ.get("TPP_MAX_PARALLEL_NODES") or None
+            ),
+            "e2e_sched_leg_workers": E2E_SCHED_WORKERS,
+        },
     }
 
     def on_term(signum, frame):  # noqa: ARG001
@@ -1124,6 +1274,9 @@ def main() -> None:
 
     e2e_leg("bert", bench_e2e_bert, est_cost_s=200)
     e2e_leg("taxi", bench_e2e_taxi, est_cost_s=120)
+    # Wall-clock head of the BASELINE metric: the same taxi DAG sequential
+    # vs concurrent, identical-lineage checked (see bench_e2e_taxi_sched).
+    e2e_leg("taxi_sched", bench_e2e_taxi_sched, est_cost_s=240)
     leg("mnist", bench_mnist, est_cost_s=60, retries=1)
     leg("resnet", bench_resnet, est_cost_s=150, retries=1)
     leg("flash_probe", bench_flash_probe, est_cost_s=100, retries=1)

@@ -110,6 +110,42 @@ def test_manifest_emission(tmp_path):
     assert "TPP_COORDINATOR_ADDRESS" in env
 
 
+def test_workflow_stage_groups_tpu_mutex_and_parallelism(tmp_path):
+    """Scheduler parity on the cluster: the workflow carries the compiler's
+    topo stage groups as an annotation, TPU resource-class node templates
+    share one Argo mutex (the chip gate), and max_parallel_nodes maps to
+    spec.parallelism."""
+    from tpu_pipelines.orchestration import TPUJobRunner, TPUJobRunnerConfig
+    from tpu_pipelines.utils.module_loader import load_fn
+
+    mod = _pipeline_module(tmp_path)
+    pipeline = load_fn(mod, "create_pipeline")()
+    out = TPUJobRunner(TPUJobRunnerConfig(
+        image="img", pipeline_module="/app/p.py",
+        output_dir=str(tmp_path / "specs"),
+        max_parallel_nodes=3,
+    )).run(pipeline)
+    with open(out["workflow"]) as f:
+        wf = yaml.safe_load(f)
+    groups = json.loads(
+        wf["metadata"]["annotations"]["tpu-pipelines/stage-groups"]
+    )
+    assert groups == [["CsvExampleGen"], ["StatisticsGen"], ["SchemaGen"],
+                      ["Trainer"]]
+    assert wf["spec"]["parallelism"] == 3
+    templates = {t["name"]: t for t in wf["spec"]["templates"]}
+    # Trainer is resource_class="tpu" in the IR -> mutex; host nodes free.
+    assert templates["trainer"]["synchronization"]["mutex"]["name"].endswith(
+        "-tpu"
+    )
+    assert "synchronization" not in templates["csvexamplegen"]
+    with open(out["pipeline_ir"]) as f:
+        ir = json.load(f)
+    classes = {n["id"]: n["resource_class"] for n in ir["nodes"]}
+    assert classes["Trainer"] == "tpu"
+    assert classes["CsvExampleGen"] == "host"
+
+
 def test_manifests_deterministic(tmp_path):
     from tpu_pipelines.orchestration import TPUJobRunner, TPUJobRunnerConfig
     from tpu_pipelines.utils.module_loader import load_fn

@@ -1,6 +1,7 @@
 """Data layer: ExampleGen splitting, IO roundtrip, input pipeline, mesh."""
 
 import os
+import time
 
 import numpy as np
 import pyarrow as pa
@@ -125,6 +126,50 @@ def test_batch_iterator_host_sharding(tmp_path):
     rows0 = np.concatenate([b["fare"] for b in s0])
     rows1 = np.concatenate([b["fare"] for b in s1])
     assert len(np.intersect1d(rows0, rows1)) <= 1  # disjoint (fp collisions aside)
+
+
+def test_batch_iterator_prefetch_matches_lazy_stream(tmp_path):
+    """prefetch=N (background decode thread + device-put lookahead) yields
+    the byte-identical batch stream as the strictly lazy prefetch=0 path."""
+    art = _run_csv_gen(tmp_path)
+    base = dict(batch_size=16, shuffle=True, seed=7, num_epochs=2)
+    lazy = list(BatchIterator(art.uri, "train",
+                              InputConfig(**base, prefetch=0)))
+    pre = list(BatchIterator(art.uri, "train",
+                             InputConfig(**base, prefetch=2)))
+    assert len(pre) == len(lazy) > 0
+    for a, b in zip(lazy, pre):
+        assert set(a) == set(b)
+        for k in a:
+            assert np.array_equal(a[k], b[k])
+    # Transform exceptions surface at the consumer, not in a dead thread.
+    def boom(batch):
+        raise ValueError("bad transform")
+
+    it = BatchIterator(art.uri, "train", InputConfig(**base, prefetch=2),
+                       transform=boom)
+    with pytest.raises(ValueError, match="bad transform"):
+        next(iter(it))
+
+
+def test_batch_iterator_prefetch_abandoned_consumer_stops_thread(tmp_path):
+    """Breaking out of an infinite (num_epochs=None) prefetched iterator
+    must stop the producer thread — no leaked threads across many loops."""
+    import threading
+
+    art = _run_csv_gen(tmp_path)
+    before = threading.active_count()
+    for _ in range(5):
+        it = iter(BatchIterator(
+            art.uri, "train",
+            InputConfig(batch_size=8, num_epochs=None, prefetch=2),
+        ))
+        next(it)
+        it.close()  # consumer abandons mid-stream
+    deadline = time.time() + 5.0
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before + 1
 
 
 def test_mesh_and_shard_batch():

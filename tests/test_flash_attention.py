@@ -169,3 +169,30 @@ def test_auto_attn_choice_is_memory_feasibility(monkeypatch):
     # The fraction is an env knob; tightening it flips the verdict.
     monkeypatch.setenv("TPP_DENSE_ATTN_HBM_FRACTION", "0.0001")
     assert not tr.dense_attn_fits(8, 12, 2048, 2048, 2)
+
+
+def test_auto_attn_choice_uses_per_shard_shapes(monkeypatch):
+    """r5 advisor finding: the estimate must be PER SHARD — a mesh that
+    splits batch over `data` and heads over `model` divides the per-device
+    score footprint, so geometries that are infeasible globally stay dense
+    when each device's slice fits."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpu_pipelines.models import transformer as tr
+
+    monkeypatch.setenv("TPP_HBM_BYTES", str(16 * 1024**3))
+    # Globally infeasible at seq 8192 (38.7 GB of temporaries)...
+    assert not tr.dense_attn_fits(8, 12, 8192, 8192, 2)
+    # ...but an 8-way data x head mesh holds 1/8th per device (4.8 GB):
+    # still too big at 0.4*16 GB — scale to the geometry where the shard
+    # fits: seq 4096 global = 9.7 GB, per-shard 1.2 GB < 6.4 GB budget.
+    devs = np.asarray(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devs, ("data", "model"))
+    assert not tr.dense_attn_fits(8, 12, 4096, 4096, 2)
+    assert tr.dense_attn_fits(8, 12, 4096, 4096, 2, mesh=mesh)
+    # Per-shard division uses only the data/model axes; a seq axis does
+    # not shrink the dense estimate (dense doesn't shard the L^2 scores).
+    seq_mesh = Mesh(np.asarray(jax.devices()[:2]), ("seq",))
+    assert not tr.dense_attn_fits(8, 12, 4096, 4096, 2, mesh=seq_mesh)

@@ -377,7 +377,8 @@ def test_label_less_serving_batch_validates_only_under_serving(tmp_path):
 def test_schema_gen_exclude_at_serving_and_validator_env(tmp_path):
     """End-to-end environment wiring: SchemaGen(exclude_at_serving=[label])
     marks the label not-in-SERVING; ExampleValidator(environment="SERVING")
-    then accepts splits lacking it."""
+    accepts splits lacking it — and flags splits that still CARRY it
+    (FEATURE_UNEXPECTED_IN_ENVIRONMENT, the label-leakage catch)."""
     gen = CsvExampleGen(input_path=TAXI_CSV)
     stats = StatisticsGen(examples=gen.outputs["examples"])
     schema_node = SchemaGen(
@@ -388,6 +389,7 @@ def test_schema_gen_exclude_at_serving_and_validator_env(tmp_path):
         statistics=stats.outputs["statistics"],
         schema=schema_node.outputs["schema"],
         environment="SERVING",
+        fail_on_anomalies=False,
     )
     result = LocalDagRunner().run(Pipeline(
         "dv-env", [validator], pipeline_root=str(tmp_path / "root"),
@@ -397,10 +399,20 @@ def test_schema_gen_exclude_at_serving_and_validator_env(tmp_path):
     assert schema.features["tips"].not_in_environment == ["SERVING"]
     assert schema.default_environments == ["TRAINING", "SERVING"]
     assert not schema.expected_in("tips", "SERVING")
-    # Validator ran clean under SERVING on data that HAS the label (present
-    # features always keep their non-presence constraints).
+    # The statistics here are over TRAINING data, which still carries the
+    # label: under SERVING that is exactly the leakage the environment
+    # machinery exists to catch — every split reports it.
+    from tpu_pipelines.components.example_validator import load_anomalies
+
     anomalies_art = result.outputs_of("ExampleValidator", "anomalies")[0]
-    assert anomalies_art.properties["error_count"] == 0
+    anomalies = load_anomalies(anomalies_art.uri)
+    leaks = [
+        a for a in anomalies
+        if a.kind == "FEATURE_UNEXPECTED_IN_ENVIRONMENT"
+    ]
+    assert leaks and all(a.feature == "tips" for a in leaks)
+    assert all(a.severity == "ERROR" for a in leaks)
+    assert anomalies_art.properties["error_count"] == len(leaks)
 
 
 def test_infra_validator_serving_batch_filter():

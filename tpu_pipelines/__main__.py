@@ -99,6 +99,10 @@ def main(argv=None) -> int:
     p_run.add_argument("--to-node", action="append", default=[],
                        help="partial run: stop here")
     p_run.add_argument("--max-retries", type=int, default=0)
+    p_run.add_argument("--max-parallel-nodes", type=int, default=None,
+                       help="scheduler worker-pool size (default: DAG root "
+                            "count, or TPP_MAX_PARALLEL_NODES; 1 = strict "
+                            "sequential)")
 
     inspect = sub.add_parser("inspect", help="read the metadata store")
     # On the parent AND each leaf, so both argument orders work:
@@ -158,7 +162,10 @@ def cmd_run(args) -> int:
         except json.JSONDecodeError:
             params[name] = raw  # plain string value
     pipeline = load_fn(args.pipeline_module, "create_pipeline")()
-    result = LocalDagRunner(max_retries=args.max_retries).run(
+    result = LocalDagRunner(
+        max_retries=args.max_retries,
+        max_parallel_nodes=args.max_parallel_nodes,
+    ).run(
         pipeline,
         runtime_parameters=params,
         from_nodes=args.from_node or None,

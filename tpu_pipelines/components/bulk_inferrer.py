@@ -44,6 +44,7 @@ PREDICTIONS_FILE = "predictions"
         # make_beam_generate build the decode fn).
         "predict_method": Parameter(type=str, default="forward"),
     },
+    resource_class="tpu",
 )
 def BulkInferrer(ctx):
     from tpu_pipelines.components.evaluator import is_blessed

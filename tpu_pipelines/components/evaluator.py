@@ -90,6 +90,7 @@ def _evaluate(model_uri: str, examples_uri: str, props: Dict) -> EvalOutcome:
         # the wired-but-empty bootstrap fails.
         "require_baseline": Parameter(type=bool, default=False),
     },
+    resource_class="tpu",
 )
 def Evaluator(ctx):
     props = ctx.exec_properties

@@ -36,7 +36,7 @@ class Anomaly:
     feature: str
     kind: str          # MISSING_FEATURE | NEW_FEATURE | TYPE_MISMATCH |
                        # PRESENCE | OUT_OF_DOMAIN | OUT_OF_RANGE | DRIFT |
-                       # SKEW
+                       # SKEW | FEATURE_UNEXPECTED_IN_ENVIRONMENT
     severity: str      # ERROR | WARNING
     description: str
 
@@ -54,7 +54,10 @@ def validate_split(
     ``environment`` scopes presence expectations (TFDV schema
     environments): a feature not expected in the environment (e.g. the
     label under ``environment="SERVING"``) may be absent without anomaly —
-    but when present, its type/domain/range constraints still apply."""
+    but one actually PRESENT is flagged FEATURE_UNEXPECTED_IN_ENVIRONMENT
+    (TFDV's anomaly of the same name: the classic label-leakage-into-
+    serving-data catch), and its type/domain/range constraints still
+    apply."""
     anomalies: List[Anomaly] = []
     split = split_stats.split
     seen = set(split_stats.features)
@@ -69,6 +72,14 @@ def validate_split(
                         f"schema feature {name!r} absent from split")
             )
             continue
+        if not expected:
+            anomalies.append(
+                Anomaly(split, name, "FEATURE_UNEXPECTED_IN_ENVIRONMENT",
+                        "ERROR",
+                        f"feature {name!r} present in "
+                        f"{fs.presence:.4f} of examples but not expected "
+                        f"in environment {environment!r}")
+            )
         if fs.type != feat.type.value:
             anomalies.append(
                 Anomaly(split, name, "TYPE_MISMATCH", "ERROR",

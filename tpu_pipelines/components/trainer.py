@@ -39,6 +39,7 @@ from tpu_pipelines.utils.module_loader import load_fn
         "custom_config": Parameter(type=dict, default=None),
     },
     external_input_parameters=("module_file",),
+    resource_class="tpu",
 )
 def Trainer(ctx):
     run_fn = load_fn(ctx.exec_properties["module_file"], "run_fn")

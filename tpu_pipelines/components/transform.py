@@ -52,6 +52,7 @@ log = logging.getLogger(__name__)
         "materialize_on_device": Parameter(type=bool, default=None),
     },
     external_input_parameters=("module_file",),
+    resource_class="tpu",
 )
 def Transform(ctx):
     module_file = ctx.exec_properties["module_file"]

@@ -416,6 +416,7 @@ def load_shard_results(
         "trial_shards": Parameter(type=int, default=0),
     },
     external_input_parameters=("module_file",),
+    resource_class="tpu",
 )
 def Tuner(ctx):
     module_file = ctx.exec_properties["module_file"]

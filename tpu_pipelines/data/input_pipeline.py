@@ -17,6 +17,8 @@ shuffling); larger splits stream Parquet row groups through a shuffle buffer
 from __future__ import annotations
 
 import dataclasses
+import queue
+import threading
 from typing import Any, Callable, Dict, Iterator, Optional
 
 import numpy as np
@@ -54,6 +56,13 @@ class InputConfig:
     # rows in a fraction of the resident memory.
     grain_read_threads: Optional[int] = None
     grain_prefetch_rows: Optional[int] = None
+    # Double-buffered prefetch depth: a background thread decodes (and
+    # transforms) up to this many batches ahead of the consumer, and
+    # ``sharded_batches`` keeps the same number of device_put transfers in
+    # flight — host decode and H2D copy overlap device compute (the
+    # tf.data ``prefetch(2)`` equivalent at the infeed boundary).  0
+    # disables both (strictly lazy, pre-prefetch behavior).
+    prefetch: int = 2
 
 
 class BatchIterator:
@@ -114,6 +123,11 @@ class BatchIterator:
         return -(-self._n // self.config.batch_size)
 
     def __iter__(self) -> Iterator[Batch]:
+        if self.config.prefetch > 0:
+            return _prefetched(self._batches(), self.config.prefetch)
+        return self._batches()
+
+    def _batches(self) -> Iterator[Batch]:
         cfg = self.config
         if cfg.use_grain:
             from tpu_pipelines.data.grain_source import grain_batches
@@ -206,9 +220,83 @@ class BatchIterator:
             yield from batches
 
 
+class _PrefetchError:
+    """Carrier for an exception raised in the prefetch thread."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+_PREFETCH_DONE = object()
+
+
+def _prefetched(source: Iterator[Batch], depth: int) -> Iterator[Batch]:
+    """Run ``source`` in a background thread, up to ``depth`` batches ahead.
+
+    Order-preserving single producer; exceptions re-raise at the consumer's
+    matching position.  The consumer abandoning the iterator (break, GC)
+    sets the stop event, which the producer's bounded put observes — no
+    thread leaks on the ``num_epochs=None`` infinite readers."""
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def produce() -> None:
+        try:
+            for item in source:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                else:
+                    return
+            item = _PREFETCH_DONE
+        except BaseException as e:  # noqa: BLE001 — re-raised at consumer
+            item = _PrefetchError(e)
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    thread = threading.Thread(
+        target=produce, name="tpp-prefetch", daemon=True
+    )
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _PREFETCH_DONE:
+                return
+            if isinstance(item, _PrefetchError):
+                raise item.exc
+            yield item
+    finally:
+        stop.set()
+
+
 def sharded_batches(
     iterator: BatchIterator, mesh: Any
 ) -> Iterator[Any]:
-    """Wrap a BatchIterator: device_put each batch, batch dim over 'data'."""
+    """Wrap a BatchIterator: device_put each batch, batch dim over 'data'.
+
+    With ``InputConfig.prefetch`` > 0 the next batches' ``shard_batch``
+    device_puts are issued while the consumer still computes on the current
+    one — device_put is async, so the H2D transfer of batch i+1 overlaps
+    the step running on batch i (double-buffered infeed)."""
+    depth = getattr(getattr(iterator, "config", None), "prefetch", 0) or 0
+    if depth <= 0:
+        for batch in iterator:
+            yield shard_batch(batch, mesh)
+        return
+    from collections import deque
+
+    pending: "deque" = deque()
     for batch in iterator:
-        yield shard_batch(batch, mesh)
+        pending.append(shard_batch(batch, mesh))
+        if len(pending) > depth:
+            yield pending.popleft()
+    while pending:
+        yield pending.popleft()

@@ -70,6 +70,10 @@ class NodeIR:
     # Serialized Cond predicates (dsl/cond.py); ALL must hold or the runner
     # marks the node COND_SKIPPED and cascades to its consumers.
     conditions: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    # Scheduler resource class ("host" | "tpu"): the concurrent local runner
+    # admits at most one "tpu" node at a time; the cluster runner maps the
+    # same class to TPU nodeSelectors and the per-pipeline chip mutex.
+    resource_class: str = "host"
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -86,6 +90,7 @@ class NodeIR:
             "optional_inputs": list(self.optional_inputs),
             "is_resolver": self.is_resolver,
             "conditions": list(self.conditions),
+            "resource_class": self.resource_class,
         }
 
 
@@ -116,6 +121,30 @@ class PipelineIR:
             if n.id == node_id:
                 return n
         raise KeyError(node_id)
+
+    def topo_levels(self) -> List[List[str]]:
+        """Topological stage groups: level 0 holds the DAG roots, level k the
+        nodes whose deepest upstream sits at level k-1.  Nodes within one
+        level share no data dependency, so a scheduler may run a whole level
+        concurrently — the local runner's ready-set scheduling realizes the
+        same parallelism dynamically; the cluster runner records the groups
+        as a workflow annotation."""
+        level: Dict[str, int] = {}
+        for n in self.nodes:  # self.nodes is topologically ordered
+            level[n.id] = 1 + max(
+                (level[u] for u in n.upstream), default=-1
+            )
+        groups: List[List[str]] = []
+        for n in self.nodes:
+            depth = level[n.id]
+            while len(groups) <= depth:
+                groups.append([])
+            groups[depth].append(n.id)
+        return groups
+
+    def n_roots(self) -> int:
+        """Number of DAG roots — the concurrent runner's default pool size."""
+        return sum(1 for n in self.nodes if not n.upstream)
 
 
 class Compiler:
@@ -165,6 +194,7 @@ class Compiler:
                     optional_inputs=sorted(comp.SPEC.optional_inputs),
                     is_resolver=bool(getattr(comp, "IS_RESOLVER", False)),
                     conditions=conditions,
+                    resource_class=getattr(comp, "RESOURCE_CLASS", "host"),
                 )
             )
         return PipelineIR(

@@ -128,6 +128,12 @@ class Component:
     # Bump or override to invalidate cached executions when semantics change
     # in ways source-hashing can't see (e.g. data format revision).
     CACHE_SALT: str = ""
+    # Scheduler resource class: "host" components (data/metadata plane) may
+    # overlap freely under the concurrent runner; "tpu" components run jitted
+    # on-chip work, so at most one executes at a time (no device contention,
+    # no compile-cache thrash).  The cluster runner uses the same class for
+    # TPU node selection and the per-pipeline chip mutex.
+    RESOURCE_CLASS: str = "host"
     # Exec-property keys whose values are *external* filesystem paths (data
     # the pipeline ingests but no upstream node produced).  The driver
     # fingerprints the referenced content into the cache key, so editing the
@@ -226,6 +232,7 @@ def component(
     name: Optional[str] = None,
     external_input_parameters: tuple = (),
     optional_inputs: tuple = (),
+    resource_class: str = "host",
 ) -> Callable[[ExecutorFn], Type[Component]]:
     """Decorator: build a Component subclass from a bare executor function.
 
@@ -239,6 +246,11 @@ def component(
 
     def wrap(fn: ExecutorFn) -> Type[Component]:
         cls_name = name or fn.__name__
+        if resource_class not in ("host", "tpu"):
+            raise ValueError(
+                f"{cls_name}: resource_class must be 'host' or 'tpu', "
+                f"got {resource_class!r}"
+            )
         spec = ComponentSpec(
             inputs=dict(inputs or {}),
             outputs=dict(outputs or {}),
@@ -253,6 +265,7 @@ def component(
                 "EXECUTOR": staticmethod(fn),
                 "__doc__": fn.__doc__,
                 "EXTERNAL_INPUT_PARAMETERS": tuple(external_input_parameters),
+                "RESOURCE_CLASS": resource_class,
             },
         )
 

@@ -27,6 +27,7 @@ completion index.  This replaces TF_CONFIG + TFJob operator (SURVEY.md §2b).
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import re
 from typing import Any, Dict, List, Optional
@@ -51,6 +52,12 @@ from tpu_pipelines.parallel.distributed import (
 # Components that train and therefore get a JobSet when num_hosts > 1.
 DISTRIBUTED_COMPONENT_TYPES = ("Trainer", "Tuner")
 
+# Fallback TPU classification for IR emitted before NodeIR.resource_class
+# existed (SURVEY.md §2a TPU-equiv column); current IR carries the class.
+_LEGACY_TPU_COMPONENT_TYPES = (
+    "Trainer", "Tuner", "Evaluator", "BulkInferrer", "Transform",
+)
+
 
 def k8s_name(s: str) -> str:
     """DNS-1123 subdomain: lowercase alphanumerics and '-', edge-trimmed."""
@@ -73,6 +80,18 @@ class TPUJobRunnerConfig:
     namespace: str = "default"
     service_account: str = ""
     workflow_name: str = ""                 # defaults to pipeline name
+    # Workflow-wide cap on concurrently running DAG tasks (Argo
+    # spec.parallelism) — the cluster mirror of the local runner's
+    # ``max_parallel_nodes`` pool.  0 = unlimited (Argo's default: every
+    # ready branch schedules).  Independent of the TPU mutex below, which
+    # serializes chip-holding nodes regardless of this cap.
+    max_parallel_nodes: int = 0
+    # Serialize TPU resource-class nodes behind one Argo mutex (the cluster
+    # equivalent of the local scheduler's single-chip gate).  Disable on
+    # multi-slice clusters where concurrent training pods land on distinct
+    # slices.  Tuner trial-shard pods are exempt: their fan-out exists to
+    # use many slices at once.
+    tpu_mutex: bool = True
     # Shared storage for pipeline_root + the metadata sqlite.  Cross-pod
     # semantics (artifact URIs, run_node's shared-store precondition, orbax
     # collective saves) require every pod to see one filesystem: set
@@ -240,7 +259,7 @@ class TPUJobRunner:
                         "command": self._tuner_trial_command(
                             ir, node.id, i, shards
                         ),
-                        "resources": self._node_resources(node.component_type),
+                        "resources": self._node_resources(node),
                     },
                     "nodeSelector": self._tpu_node_selector(),
                 }
@@ -268,7 +287,7 @@ class TPUJobRunner:
                 tpl["container"] = {
                     "image": cfg.image,
                     "command": self._node_command(node.id),
-                    "resources": self._node_resources(node.component_type),
+                    "resources": self._node_resources(node),
                 }
                 if shards:
                     # The tuner node merges the shard pods' scores and is the
@@ -279,13 +298,22 @@ class TPUJobRunner:
                     }]
                 if cfg.shared_volume_claim:
                     tpl["container"]["volumeMounts"] = self._volume_mounts()
-                if self._is_tpu_node(node.component_type):
+                if self._is_tpu_node(node):
                     tpl["nodeSelector"] = self._tpu_node_selector()
+            if cfg.tpu_mutex and self._is_tpu_node(node):
+                # One chip-holding node at a time — the Argo equivalent of
+                # the local scheduler's TPU resource-class gate.  Trial-shard
+                # pods stay exempt (their fan-out targets many slices).
+                tpl["synchronization"] = {
+                    "mutex": {"name": f"{name}-tpu"}
+                }
             templates.append(tpl)
         spec: Dict[str, Any] = {
             "entrypoint": "pipeline-dag",
             "templates": templates,
         }
+        if cfg.max_parallel_nodes > 0:
+            spec["parallelism"] = cfg.max_parallel_nodes
         if cfg.shared_volume_claim:
             spec["volumes"] = self._volumes()
         if cfg.service_account:
@@ -297,6 +325,15 @@ class TPUJobRunner:
                 "generateName": f"{name}-",
                 "namespace": cfg.namespace,
                 "labels": {"tpu-pipelines/pipeline": name},
+                # The compiler's topo stage groups: nodes within one group
+                # share no data dependency, so Argo schedules them
+                # concurrently — the same parallelism the local concurrent
+                # scheduler realizes dynamically from its ready set.
+                "annotations": {
+                    "tpu-pipelines/stage-groups": json.dumps(
+                        ir.topo_levels()
+                    ),
+                },
             },
             "spec": spec,
         }
@@ -418,7 +455,7 @@ class TPUJobRunner:
                 "periodSeconds": 10,
             },
             "resources": (
-                self._node_resources("BulkInferrer") if on_tpu
+                self._tpu_resources() if on_tpu
                 else {"requests": {"cpu": "2", "memory": "4Gi"}}
             ),
         }
@@ -486,17 +523,24 @@ class TPUJobRunner:
             "cloud.google.com/gke-tpu-topology": self.config.tpu_topology,
         }
 
-    def _is_tpu_node(self, component_type: str) -> bool:
-        # Components that run jitted on-chip work (SURVEY.md §2a TPU-equiv
-        # column); data/metadata-plane components stay on CPU nodes.
-        return component_type in (
-            "Trainer", "Tuner", "Evaluator", "BulkInferrer", "Transform",
-        )
+    def _is_tpu_node(self, node) -> bool:
+        # Nodes that run jitted on-chip work schedule onto TPU node pools;
+        # data/metadata-plane components stay on CPU nodes.  The IR's
+        # resource_class (compiled from Component.RESOURCE_CLASS — the same
+        # classification the local concurrent scheduler gates the chip on)
+        # is authoritative; the legacy type list covers pre-resource-class IR.
+        rc = getattr(node, "resource_class", "")
+        if rc:
+            return rc == "tpu"
+        return node.component_type in _LEGACY_TPU_COMPONENT_TYPES
 
-    def _node_resources(self, component_type: str) -> Dict[str, Any]:
-        if self._is_tpu_node(component_type):
-            return {
-                "requests": {"google.com/tpu": self.config.chips_per_host},
-                "limits": {"google.com/tpu": self.config.chips_per_host},
-            }
+    def _tpu_resources(self) -> Dict[str, Any]:
+        return {
+            "requests": {"google.com/tpu": self.config.chips_per_host},
+            "limits": {"google.com/tpu": self.config.chips_per_host},
+        }
+
+    def _node_resources(self, node) -> Dict[str, Any]:
+        if self._is_tpu_node(node):
+            return self._tpu_resources()
         return {"requests": {"cpu": "2", "memory": "4Gi"}}

@@ -1,20 +1,39 @@
-"""Local DAG runner: topological execution with caching, retry, partial runs.
+"""Local DAG runner: concurrent ready-set scheduling with caching, retry,
+partial runs.
 
-Equivalent of TFX's ``LocalDagRunner`` + launcher stack (SURVEY.md §3.1):
+Equivalent of TFX's ``LocalDagRunner`` + launcher stack (SURVEY.md §3.1),
+with Kubeflow/Argo's DAG-level parallelism (SURVEY.md §3.1): independent
+branches run concurrently instead of serializing in topo order.
 
     run(pipeline)
     └─ compile DSL → IR
-    └─ for node in topo order:
+    └─ ready-set scheduler (worker pool of ``max_parallel_nodes``):
+       a node is dispatched once every upstream has PUBLISHED; at most one
+       "tpu" resource-class node (Trainer/Tuner/Transform/Evaluator/
+       BulkInferrer) holds the chip at a time while "host" nodes overlap
+       freely.  Per dispatched node:
        ├─ DRIVER: resolve input artifacts; compute content cache key;
-       │          cache hit ⇒ publish CACHED execution reusing outputs
-       ├─ LAUNCHER: allocate output artifact dirs; invoke executor
-       │            (with per-node retry — the Argo retryStrategy equivalent)
+       │          cache hit ⇒ publish CACHED execution reusing outputs.
+       │          Runs in the scheduler thread, so execution ids (and the
+       │          output URIs embedding them) are assigned in deterministic
+       │          dispatch order.
+       ├─ LAUNCHER: allocate output artifact dirs; invoke executor in a
+       │            worker thread (with per-node retry — the Argo
+       │            retryStrategy equivalent)
        └─ PUBLISHER: fingerprint outputs, mark LIVE, record execution +
-                     lineage events + contexts in the metadata store
+                     lineage events + contexts — every store write funnels
+                     through one run-level publish lock, preserving the
+                     store's single-writer discipline and lineage ordering.
+
+``max_parallel_nodes`` defaults to the DAG's root count (env-overridable via
+``TPP_MAX_PARALLEL_NODES``); at 1 — and always under ``spmd_sync``, whose
+collectives require every process to take the same branches in the same
+order — the runner takes the classic sequential topo loop, whose metadata
+trace the 1-worker scheduler reproduces exactly (tests/test_concurrent_runner).
 
 The orchestrator is cold control plane; all hot work happens inside executors
 (jitted train/transform steps).  Single-writer metadata discipline: only this
-loop writes to the store during a run.
+runner writes to the store during a run.
 """
 
 from __future__ import annotations
@@ -24,6 +43,7 @@ import logging
 import os
 import shutil
 import tempfile
+import threading
 import time
 import traceback
 from typing import Any, Dict, List, Optional, Sequence
@@ -48,6 +68,14 @@ from tpu_pipelines.utils.fingerprint import execution_cache_key, fingerprint_dir
 from tpu_pipelines.utils.span import has_span_pattern, resolve_span_pattern
 
 log = logging.getLogger("tpu_pipelines.runner")
+
+
+def _maybe_locked(lock: Optional[threading.Lock]):
+    """The run-level publish lock when scheduling concurrently, a no-op
+    context in the sequential path (where this thread is the only writer)."""
+    import contextlib
+
+    return lock if lock is not None else contextlib.nullcontext()
 
 
 def _spmd_broadcast_int(value: int) -> int:
@@ -137,6 +165,8 @@ class RunResult:
     pipeline_name: str
     run_id: str
     nodes: Dict[str, NodeResult] = dataclasses.field(default_factory=dict)
+    # Effective scheduler pool size this run executed with (1 = sequential).
+    max_parallel_nodes: int = 1
 
     @property
     def succeeded(self) -> bool:
@@ -149,6 +179,24 @@ class RunResult:
         return self.nodes[node_id].outputs.get(key, [])
 
 
+@dataclasses.dataclass
+class _LaunchPlan:
+    """Driver-phase output for a node that must execute: everything the
+    worker-thread launcher/publisher phase needs.  The RUNNING execution is
+    already registered (ids — and output URIs embedding them — are assigned
+    in the scheduler thread, in deterministic dispatch order)."""
+
+    node: NodeIR
+    component: Any
+    inputs: Dict[str, List[Artifact]]
+    props: Dict[str, Any]
+    external_fps: Dict[str, str]
+    execution: Execution
+    outputs: Dict[str, List[Artifact]]
+    all_ctx: List[Context]
+    t0: float
+
+
 class LocalDagRunner:
     """In-process topological pipeline runner.
 
@@ -156,9 +204,19 @@ class LocalDagRunner:
     substrate-level retry the reference delegates to Argo/TFJob, SURVEY.md §5
     failure detection).  Idempotence contract: executors write only under
     their output artifact uris and tmp dir, so a retry starts clean.
+
+    ``max_parallel_nodes`` bounds the concurrent scheduler's worker pool:
+    None = env ``TPP_MAX_PARALLEL_NODES`` if set, else the DAG's root count.
+    1 means the classic sequential topo loop; "tpu" resource-class nodes are
+    additionally serialized against each other regardless of pool size.
     """
 
-    def __init__(self, max_retries: int = 0, spmd_sync: bool = False):
+    def __init__(
+        self,
+        max_retries: int = 0,
+        spmd_sync: bool = False,
+        max_parallel_nodes: Optional[int] = None,
+    ):
         # Persistent XLA compile cache: the single biggest repeat-run cost
         # on TPU is recompiling unchanged programs (~45 s for the BERT
         # step, ~16 s warm-cached); enable before any executor compiles.
@@ -168,6 +226,7 @@ class LocalDagRunner:
 
         maybe_enable_compile_cache()
         self.max_retries = max_retries
+        self.max_parallel_nodes = max_parallel_nodes
         # Multi-host SPMD mode (run_node with a live coordination service):
         # workers execute against a point-in-time snapshot of the shared
         # metadata sqlite, so two store-derived decisions could diverge from
@@ -232,130 +291,28 @@ class LocalDagRunner:
         failed_upstream: set = set()
         cond_skipped: set = set()
 
-        for node in ir.nodes:
-            if node.id not in selected:
-                # A node whose NEWEST execution was a condition-skip —
-                # whether directly gated or cascade-skipped (both publish
-                # the CANCELED cond_skipped record) — replays as
-                # condition-skipped, not as its older, condition-rejected
-                # outputs.
-                replay_skip = self._latest_is_cond_skip(store, node)
-                if self.spmd_sync:
-                    # Store-derived; broadcast like every control decision.
-                    replay_skip = bool(
-                        _spmd_broadcast_int(1 if replay_skip else 0)
-                    )
-                if replay_skip:
-                    cond_skipped.add(node.id)
-                    produced[node.id] = {}
-                    result.nodes[node.id] = NodeResult(
-                        node_id=node.id, status="COND_SKIPPED",
-                    )
-                    continue
-                outputs = self._resolve_prior_outputs(store, node)
-                produced[node.id] = outputs
-                result.nodes[node.id] = NodeResult(
-                    node_id=node.id, status="SKIPPED", outputs=outputs
-                )
-                continue
-            if any(u in failed_upstream for u in node.upstream):
-                failed_upstream.add(node.id)
-                result.nodes[node.id] = NodeResult(
-                    node_id=node.id,
-                    status="FAILED",
-                    error="upstream failure",
-                )
-                continue
-            # Cond semantics (dsl/cond.py): a node whose predicate fails —
-            # or whose upstream was condition-skipped — is COND_SKIPPED,
-            # which is NOT a failure: the run still succeeds without it.
-            # The verdict is recorded as a CANCELED execution so partial
-            # runs and cluster pods replay the latest decision.
-            unmet: List[Any] = []
-            cond_error: Any = None
-            cascade = any(u in cond_skipped for u in node.upstream)
-            if node.conditions and not cascade:
-                from tpu_pipelines.dsl.cond import (
-                    ConditionUnresolvedError,
-                    evaluate_condition,
-                )
-
-                try:
-                    unmet = [
-                        c for c in node.conditions
-                        if not evaluate_condition(
-                            c, produced, runtime_parameters or {}
-                        )
-                    ]
-                except ConditionUnresolvedError as e:
-                    # Producer never published anything (e.g. a partial run
-                    # excluding it with no prior history): a configuration
-                    # mistake, surfaced as a node FAILURE — never silently
-                    # COND_SKIPPED (round-4 advisor finding).
-                    cond_error = str(e)
-            skip = cascade or bool(unmet)
-            if self.spmd_sync and (node.conditions or cascade):
-                # Store-derived decision: process 0's verdict is
-                # authoritative, or divergent snapshots would leave some
-                # processes inside the executor's collectives while others
-                # skipped (same hazard as the cache-verdict broadcast).
-                verdict = 2 if cond_error else (1 if skip else 0)
-                verdict = _spmd_broadcast_int(verdict)
-                skip = verdict == 1
-                if verdict == 2 and cond_error is None:
-                    cond_error = (
-                        "condition unresolved on primary process "
-                        "(producer has no published outputs)"
-                    )
-                elif verdict != 2:
-                    cond_error = None
-            if cond_error:
-                failed_upstream.add(node.id)
-                result.nodes[node.id] = NodeResult(
-                    node_id=node.id, status="FAILED", error=cond_error,
-                )
-                continue
-            if skip:
-                log.info(
-                    "node %s: condition not met%s; skipping",
-                    node.id,
-                    "" if cascade else f" ({unmet})",
-                )
-                cond_skipped.add(node.id)
-                primary = True
-                if self.spmd_sync:
-                    import jax
-
-                    primary = jax.process_index() == 0
-                if primary:
-                    ex = Execution(
-                        type_name=node.component_type,
-                        node_id=node.id,
-                        state=ExecutionState.CANCELED,
-                        properties={
-                            "cond_skipped": True,
-                            "unmet_conditions": unmet,
-                        },
-                    )
-                    store.publish_execution(ex, {}, {}, [
-                        pipeline_ctx, run_ctx,
-                    ])
-                result.nodes[node.id] = NodeResult(
-                    node_id=node.id, status="COND_SKIPPED",
-                )
-                continue
-
-            node_result = self._run_node(
-                store, ir, node, executors[node.id], produced,
-                runtime_parameters, [pipeline_ctx, run_ctx],
-                extras=dict(extras or {}),
-                enable_cache=pipeline.enable_cache,
-            )
-            result.nodes[node.id] = node_result
-            if node_result.status in ("COMPLETE", "CACHED"):
-                produced[node.id] = node_result.outputs
-            else:
-                failed_upstream.add(node.id)
+        max_parallel = self._effective_parallelism(ir)
+        result.max_parallel_nodes = max_parallel
+        shared = dict(
+            store=store, ir=ir, executors=executors, selected=selected,
+            produced=produced, failed_upstream=failed_upstream,
+            cond_skipped=cond_skipped, result=result,
+            runtime_parameters=runtime_parameters,
+            pipeline_ctx=pipeline_ctx, run_ctx=run_ctx,
+            extras=extras, enable_cache=pipeline.enable_cache,
+        )
+        # TPP_FORCE_SCHEDULER=1 routes even a 1-worker run through the
+        # concurrent scheduler — the test hook proving its trace matches the
+        # sequential loop byte for byte (tests/test_concurrent_runner.py).
+        # spmd_sync always stays sequential: its collectives require every
+        # process to take identical branches in identical order.
+        if not self.spmd_sync and (
+            max_parallel > 1
+            or os.environ.get("TPP_FORCE_SCHEDULER") == "1"
+        ):
+            self._run_nodes_concurrent(max_workers=max_parallel, **shared)
+        else:
+            self._run_nodes_sequential(**shared)
 
         store.close()
         if raise_on_failure and not result.succeeded:
@@ -368,6 +325,288 @@ class LocalDagRunner:
         return result
 
     # ------------------------------------------------------------ internals
+
+    def _effective_parallelism(self, ir: PipelineIR) -> int:
+        """Resolve the scheduler pool size: explicit arg > env > DAG roots.
+
+        spmd_sync always forces 1: the per-node collective counts must be
+        identical on every process, so the schedule (one node, sequential)
+        must never depend on local timing."""
+        if self.spmd_sync:
+            return 1
+        if self.max_parallel_nodes is not None:
+            return max(1, int(self.max_parallel_nodes))
+        env = os.environ.get("TPP_MAX_PARALLEL_NODES", "")
+        if env:
+            return max(1, int(env))
+        return max(1, ir.n_roots())
+
+    def _control_outcome(
+        self,
+        store: MetadataStore,
+        node: NodeIR,
+        selected: set,
+        produced: Dict[str, Dict[str, List[Artifact]]],
+        failed_upstream: set,
+        cond_skipped: set,
+        runtime_parameters: Dict[str, Any],
+        pipeline_ctx: Context,
+        run_ctx: Context,
+    ) -> Optional[NodeResult]:
+        """Control-plane verdict for a node whose upstreams are all settled:
+        a NodeResult for nodes that must NOT execute (partial-run skip,
+        upstream failure, condition skip/error), or None when the node should
+        be dispatched.  Store writes here (the CANCELED cond-skip record)
+        happen in the calling scheduler thread, never in workers."""
+        if node.id not in selected:
+            # A node whose NEWEST execution was a condition-skip — whether
+            # directly gated or cascade-skipped (both publish the CANCELED
+            # cond_skipped record) — replays as condition-skipped, not as
+            # its older, condition-rejected outputs.
+            replay_skip = self._latest_is_cond_skip(store, node)
+            if self.spmd_sync:
+                # Store-derived; broadcast like every control decision.
+                replay_skip = bool(
+                    _spmd_broadcast_int(1 if replay_skip else 0)
+                )
+            if replay_skip:
+                return NodeResult(node_id=node.id, status="COND_SKIPPED")
+            outputs = self._resolve_prior_outputs(store, node)
+            return NodeResult(
+                node_id=node.id, status="SKIPPED", outputs=outputs
+            )
+        if any(u in failed_upstream for u in node.upstream):
+            return NodeResult(
+                node_id=node.id, status="FAILED", error="upstream failure",
+            )
+        # Cond semantics (dsl/cond.py): a node whose predicate fails — or
+        # whose upstream was condition-skipped — is COND_SKIPPED, which is
+        # NOT a failure: the run still succeeds without it.  The verdict is
+        # recorded as a CANCELED execution so partial runs and cluster pods
+        # replay the latest decision.
+        unmet: List[Any] = []
+        cond_error: Any = None
+        cascade = any(u in cond_skipped for u in node.upstream)
+        if node.conditions and not cascade:
+            from tpu_pipelines.dsl.cond import (
+                ConditionUnresolvedError,
+                evaluate_condition,
+            )
+
+            try:
+                unmet = [
+                    c for c in node.conditions
+                    if not evaluate_condition(
+                        c, produced, runtime_parameters or {}
+                    )
+                ]
+            except ConditionUnresolvedError as e:
+                # Producer never published anything (e.g. a partial run
+                # excluding it with no prior history): a configuration
+                # mistake, surfaced as a node FAILURE — never silently
+                # COND_SKIPPED (round-4 advisor finding).
+                cond_error = str(e)
+        skip = cascade or bool(unmet)
+        if self.spmd_sync and (node.conditions or cascade):
+            # Store-derived decision: process 0's verdict is authoritative,
+            # or divergent snapshots would leave some processes inside the
+            # executor's collectives while others skipped (same hazard as
+            # the cache-verdict broadcast).
+            verdict = 2 if cond_error else (1 if skip else 0)
+            verdict = _spmd_broadcast_int(verdict)
+            skip = verdict == 1
+            if verdict == 2 and cond_error is None:
+                cond_error = (
+                    "condition unresolved on primary process "
+                    "(producer has no published outputs)"
+                )
+            elif verdict != 2:
+                cond_error = None
+        if cond_error:
+            return NodeResult(
+                node_id=node.id, status="FAILED", error=cond_error,
+            )
+        if skip:
+            log.info(
+                "node %s: condition not met%s; skipping",
+                node.id,
+                "" if cascade else f" ({unmet})",
+            )
+            primary = True
+            if self.spmd_sync:
+                import jax
+
+                primary = jax.process_index() == 0
+            if primary:
+                ex = Execution(
+                    type_name=node.component_type,
+                    node_id=node.id,
+                    state=ExecutionState.CANCELED,
+                    properties={
+                        "cond_skipped": True,
+                        "unmet_conditions": unmet,
+                    },
+                )
+                store.publish_execution(ex, {}, {}, [pipeline_ctx, run_ctx])
+            return NodeResult(node_id=node.id, status="COND_SKIPPED")
+        return None
+
+    @staticmethod
+    def _settle(
+        node_result: NodeResult,
+        produced: Dict[str, Dict[str, List[Artifact]]],
+        failed_upstream: set,
+        cond_skipped: set,
+        result: RunResult,
+    ) -> None:
+        """Record a node's final verdict and update the downstream-visible
+        state (scheduler thread only — ``produced`` feeds input resolution)."""
+        nid = node_result.node_id
+        result.nodes[nid] = node_result
+        if node_result.status in ("COMPLETE", "CACHED", "SKIPPED"):
+            produced[nid] = node_result.outputs
+        elif node_result.status == "COND_SKIPPED":
+            cond_skipped.add(nid)
+            produced[nid] = {}
+        else:  # FAILED
+            failed_upstream.add(nid)
+
+    def _run_nodes_sequential(
+        self, *, store, ir, executors, selected, produced, failed_upstream,
+        cond_skipped, result, runtime_parameters, pipeline_ctx, run_ctx,
+        extras, enable_cache,
+    ) -> None:
+        """The classic strict-topo-order loop (spmd_sync and pool size 1)."""
+        for node in ir.nodes:
+            node_result = self._control_outcome(
+                store, node, selected, produced, failed_upstream,
+                cond_skipped, runtime_parameters, pipeline_ctx, run_ctx,
+            )
+            if node_result is None:
+                node_result = self._run_node(
+                    store, ir, node, executors[node.id], produced,
+                    runtime_parameters, [pipeline_ctx, run_ctx],
+                    extras=dict(extras or {}),
+                    enable_cache=enable_cache,
+                )
+            self._settle(
+                node_result, produced, failed_upstream, cond_skipped, result
+            )
+
+    def _run_nodes_concurrent(
+        self, *, store, ir, executors, selected, produced, failed_upstream,
+        cond_skipped, result, runtime_parameters, pipeline_ctx, run_ctx,
+        extras, enable_cache, max_workers: int,
+    ) -> None:
+        """Ready-set scheduler: dispatch any node whose upstreams have all
+        published, lowest topo index first; executors run in a worker pool
+        while driver/launch (and so execution-id/URI assignment) stays in
+        this thread.  At most one "tpu" resource-class node is in flight at
+        a time; "host" nodes overlap freely.  A failing node marks its
+        descendants FAILED without cancelling in-flight or independent work
+        (same fail-fast semantics as the sequential loop — downstream nodes
+        of a failure are never started, in-flight branches drain and
+        publish)."""
+        import queue as queue_mod
+        from concurrent.futures import ThreadPoolExecutor
+
+        publish_lock = threading.Lock()
+        unprocessed = [n.id for n in ir.nodes]  # stays in topo order
+        by_id = {n.id: n for n in ir.nodes}
+        settled: set = set()
+        in_flight: set = set()
+        tpu_in_flight: Optional[str] = None
+        done_q: "queue_mod.Queue" = queue_mod.Queue()
+
+        def worker(plan: _LaunchPlan, node_extras: Dict[str, Any]) -> None:
+            try:
+                nr = self._execute_and_publish(
+                    store, plan, node_extras, publish_lock
+                )
+            except Exception:
+                # Runner-internal failure: settle the node as FAILED instead
+                # of deadlocking the scheduler on a completion that never
+                # arrives.
+                nr = NodeResult(
+                    node_id=plan.node.id, status="FAILED",
+                    error=traceback.format_exc(),
+                )
+            done_q.put(nr)
+
+        pool = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="tpp-node"
+        )
+        try:
+            while unprocessed or in_flight:
+                progressed = False
+                # With a single worker, hold back later nodes until the
+                # in-flight one settles: control-plane publishes (cond-skip
+                # CANCELED records) must interleave exactly as the
+                # sequential loop's would.
+                scan = (
+                    [] if (max_workers == 1 and in_flight)
+                    else list(unprocessed)
+                )
+                for nid in scan:
+                    node = by_id[nid]
+                    if any(u not in settled for u in node.upstream):
+                        continue
+                    nr = self._control_outcome(
+                        store, node, selected, produced, failed_upstream,
+                        cond_skipped, runtime_parameters, pipeline_ctx,
+                        run_ctx,
+                    )
+                    if nr is not None:
+                        self._settle(
+                            nr, produced, failed_upstream, cond_skipped,
+                            result,
+                        )
+                        unprocessed.remove(nid)
+                        settled.add(nid)
+                        progressed = True
+                        continue
+                    if len(in_flight) >= max_workers:
+                        continue  # no slot; later control-only nodes may settle
+                    if node.resource_class == "tpu" and tpu_in_flight:
+                        continue  # chip busy; host nodes may still dispatch
+                    prepared = self._prepare_node(
+                        store, ir, node, executors[nid], produced,
+                        runtime_parameters, [pipeline_ctx, run_ctx],
+                        enable_cache, publish_lock,
+                    )
+                    unprocessed.remove(nid)
+                    progressed = True
+                    if isinstance(prepared, NodeResult):
+                        # Resolver, cache hit, or driver failure: finished
+                        # without an executor.
+                        self._settle(
+                            prepared, produced, failed_upstream,
+                            cond_skipped, result,
+                        )
+                        settled.add(nid)
+                        continue
+                    in_flight.add(nid)
+                    if node.resource_class == "tpu":
+                        tpu_in_flight = nid
+                    pool.submit(worker, prepared, dict(extras or {}))
+                if progressed:
+                    continue
+                if not in_flight:
+                    # Nothing runnable, nothing running: an IR bug (cycle),
+                    # not a state this acyclic-compiled DAG can reach.
+                    raise RuntimeError(
+                        f"scheduler stalled with pending nodes {unprocessed}"
+                    )
+                nr = done_q.get()
+                in_flight.discard(nr.node_id)
+                if tpu_in_flight == nr.node_id:
+                    tpu_in_flight = None
+                self._settle(
+                    nr, produced, failed_upstream, cond_skipped, result
+                )
+                settled.add(nr.node_id)
+        finally:
+            pool.shutdown(wait=True)
 
     @staticmethod
     def _select_nodes(
@@ -477,15 +716,48 @@ class LocalDagRunner:
         extras: Dict[str, Any],
         enable_cache: bool,
     ) -> NodeResult:
+        """Sequential-path node execution: driver + launcher + publisher
+        inline, in this thread (the concurrent scheduler calls the two
+        phases separately — driver here, launcher/publisher in a worker)."""
+        prepared = self._prepare_node(
+            store, ir, node, component, produced, runtime_parameters,
+            contexts, enable_cache, publish_lock=None,
+        )
+        if isinstance(prepared, NodeResult):
+            return prepared
+        return self._execute_and_publish(
+            store, prepared, extras, publish_lock=None
+        )
+
+    def _prepare_node(
+        self,
+        store: MetadataStore,
+        ir: PipelineIR,
+        node: NodeIR,
+        component,
+        produced: Dict[str, Dict[str, List[Artifact]]],
+        runtime_parameters: Dict[str, Any],
+        contexts: List[Context],
+        enable_cache: bool,
+        publish_lock: Optional[threading.Lock],
+    ):
+        """DRIVER phase: input resolution, cache check, and — on a cache
+        miss — RUNNING-execution registration + output allocation.  Returns
+        a NodeResult for nodes finished without an executor (resolver, cache
+        hit, driver failure), else a _LaunchPlan for _execute_and_publish.
+        Always runs in the scheduling thread, so execution ids (and the
+        output URIs embedding them) are assigned in dispatch order."""
         t0 = time.time()
         node_ctx = Context("node", f"{ir.name}.{node.id}")
-        store.put_context(node_ctx)
+        with _maybe_locked(publish_lock):
+            store.put_context(node_ctx)
         all_ctx = contexts + [node_ctx]
 
         if node.is_resolver:
-            return self._run_resolver_node(
-                store, ir, node, all_ctx, t0, runtime_parameters
-            )
+            with _maybe_locked(publish_lock):
+                return self._run_resolver_node(
+                    store, ir, node, all_ctx, t0, runtime_parameters
+                )
 
         # ---- DRIVER: resolve inputs + cache check
         resolve_error = ""
@@ -570,7 +842,8 @@ class LocalDagRunner:
                 properties={"cache_hit": True},
                 cache_key=cache_key,
             )
-            store.publish_execution(ex, inputs, cached, all_ctx)
+            with _maybe_locked(publish_lock):
+                store.publish_execution(ex, inputs, cached, all_ctx)
             log.info("node %s: cache hit (execution %d)", node.id, ex.id)
             return NodeResult(
                 node_id=node.id,
@@ -588,7 +861,8 @@ class LocalDagRunner:
             properties={},
             cache_key=cache_key,
         )
-        store.put_execution(ex)
+        with _maybe_locked(publish_lock):
+            store.put_execution(ex)
 
         # Output URIs embed the execution id; under spmd_sync process 0's id
         # is authoritative so all processes write one shared directory tree.
@@ -611,11 +885,31 @@ class LocalDagRunner:
         for key, type_name in node.outputs.items():
             uri = os.path.join(ir.pipeline_root, node.id, key, str(uri_ex_id))
             outputs[key] = [Artifact(type_name=type_name, uri=uri)]
+        return _LaunchPlan(
+            node=node, component=component, inputs=inputs, props=props,
+            external_fps=external_fps, execution=ex, outputs=outputs,
+            all_ctx=all_ctx, t0=t0,
+        )
+
+    def _execute_and_publish(
+        self,
+        store: MetadataStore,
+        plan: _LaunchPlan,
+        extras: Dict[str, Any],
+        publish_lock: Optional[threading.Lock],
+    ) -> NodeResult:
+        """LAUNCHER + PUBLISHER phases: run the executor (with per-node
+        retries), then fingerprint and publish.  Under the concurrent
+        scheduler this runs in a worker thread; every store write goes
+        through the run-level publish lock."""
+        node, ex = plan.node, plan.execution
+        inputs, props, outputs = plan.inputs, plan.props, plan.outputs
+        external_fps, all_ctx, t0 = plan.external_fps, plan.all_ctx, plan.t0
 
         error = ""
         extra_props: Dict[str, Any] = {}
         attempts = 1
-        executor = component.EXECUTOR
+        executor = plan.component.EXECUTOR
         # The runner-allocated output locations.  Executors may REASSIGN an
         # artifact's uri (Importer points it at external source data); every
         # retry must reset to — and clean — the ALLOCATED path, never the
@@ -703,7 +997,8 @@ class LocalDagRunner:
                     a.uri = allocated_uris[id(a)]
             ex.state = ExecutionState.FAILED
             ex.properties["error"] = error.splitlines()[-1] if error else ""
-            store.publish_execution(ex, inputs, outputs, all_ctx)
+            with _maybe_locked(publish_lock):
+                store.publish_execution(ex, inputs, outputs, all_ctx)
             return NodeResult(
                 node_id=node.id, status="FAILED", execution_id=ex.id,
                 error=error, wall_clock_s=wall, retries=attempts - 1,
@@ -715,7 +1010,8 @@ class LocalDagRunner:
                     or fingerprint_dir(a.uri)
                 )
         ex.state = ExecutionState.COMPLETE
-        store.publish_execution(ex, inputs, outputs, all_ctx)
+        with _maybe_locked(publish_lock):
+            store.publish_execution(ex, inputs, outputs, all_ctx)
         log.info(
             "node %s: COMPLETE in %.2fs (execution %d)", node.id, wall, ex.id
         )
