@@ -682,12 +682,25 @@ def bench_t5_decode(smoke: bool) -> dict:
     return out
 
 
-def _canonical_lineage(metadata_path: str, pipeline_root: str) -> list:
+def _canonical_lineage(
+    metadata_path: str,
+    pipeline_root: str,
+    states: tuple = (),
+    strip_exec_ids: bool = False,
+) -> list:
     """Id-free canonical form of a run's published lineage: per execution,
     (node, state, sorted input events, sorted output events) with artifact
     URIs relativized to the pipeline root — two runs publishing the same
     artifacts/lineage compare equal regardless of store row ids, publish
-    interleaving, or pipeline home."""
+    interleaving, or pipeline home.
+
+    ``states`` filters to those execution states (e.g. COMPLETE/CACHED only,
+    so a stitched resume — which legitimately carries extra ABANDONED
+    fencing records — compares against a cold run's decisive set).
+    ``strip_exec_ids`` drops the trailing execution-id path component from
+    artifact URIs (``Trainer/model/7`` -> ``Trainer/model``): a resumed
+    run's re-dispatched nodes get later execution ids than a cold run's, so
+    the embedded id is the one legitimate difference."""
     from tpu_pipelines.metadata import open_store
     from tpu_pipelines.metadata.types import EventType
 
@@ -696,10 +709,15 @@ def _canonical_lineage(metadata_path: str, pipeline_root: str) -> list:
 
     def rel(uri: str) -> str:
         a = os.path.abspath(uri)
-        return os.path.relpath(a, root) if a.startswith(root) else uri
+        out = os.path.relpath(a, root) if a.startswith(root) else uri
+        if strip_exec_ids and os.path.basename(out).isdigit():
+            out = os.path.dirname(out)
+        return out
 
     entries = []
     for ex in store.get_executions():
+        if states and ex.state.value not in states:
+            continue
         ins, outs = [], []
         for ev in store.get_events_by_execution(ex.id):
             art = store.get_artifact(ev.artifact_id)
@@ -879,6 +897,117 @@ def bench_e2e_bert(smoke: bool) -> dict:
     if smoke:
         env["BERT_TINY"] = "1"
     return _run_example_pipeline("bert", env)
+
+
+def bench_robustness(smoke: bool) -> dict:
+    """Crash-safe resume on the taxi DAG: work saved vs a cold re-run.
+
+    The ``taxi_faults`` leg is the on-hardware evidence for the resume
+    layer's contract (docs/RECOVERY.md): kill the orchestrator at the
+    Trainer dispatch (the most expensive node), then ``resume_from=
+    "latest"`` — the five upstream data-plane nodes must be ADOPTED (same
+    execution ids/URIs, zero recompute) and only Trainer + its three
+    descendants re-run.  Reported:
+
+      - ``resume_wall_s`` vs ``cold_wall_s`` (an identical full run in a
+        fresh home) and the ``work_saved_ratio`` = 1 - resume/cold;
+      - ``lineage_identical``: the stitched run's decisive
+        (COMPLETE/CACHED) lineage equals the cold run's, id-free and with
+        embedded execution ids normalized out — adoption preserved the
+        original artifacts and the re-runs published the same graph shape.
+
+    A throwaway warm-up run absorbs in-process one-time costs (module
+    loads, XLA compiles) first, so neither measured leg pays them — the
+    same discipline as the scheduler-comparison leg.
+    """
+    import shutil
+    import tempfile
+
+    from tpu_pipelines.orchestration import LocalDagRunner
+    from tpu_pipelines.testing.faults import (
+        KILL_ORCHESTRATOR,
+        FaultPlan,
+        NodeFault,
+        SimulatedCrash,
+    )
+    from tpu_pipelines.utils.module_loader import load_fn
+
+    module = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "examples", "taxi", "pipeline.py",
+    )
+    env = {
+        "TAXI_TRAIN_STEPS": "4" if smoke else "200",
+        "TPP_DISABLE_MID_CHECKPOINT": "1",
+    }
+    kill_node = "Trainer"
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    homes = [tempfile.mkdtemp(prefix=f"tpp-robust-{tag}-")
+             for tag in ("warm", "stitched", "cold")]
+    try:
+        # Warm-up (throwaway home, 4 steps): jit caches are shape-keyed, so
+        # the step count doesn't matter for cache warmth.
+        os.environ["TAXI_TRAIN_STEPS"] = "4"
+        LocalDagRunner().run(load_fn(module, "create_pipeline")(homes[0]))
+        os.environ["TAXI_TRAIN_STEPS"] = env["TAXI_TRAIN_STEPS"]
+
+        plan = FaultPlan({kill_node: NodeFault(KILL_ORCHESTRATOR)})
+        crashed = False
+        t0 = time.perf_counter()
+        try:
+            with plan.activate():
+                LocalDagRunner().run(
+                    load_fn(module, "create_pipeline")(homes[1])
+                )
+        except SimulatedCrash:
+            crashed = True
+        partial_wall = time.perf_counter() - t0
+
+        stitched = load_fn(module, "create_pipeline")(homes[1])
+        t0 = time.perf_counter()
+        resumed = LocalDagRunner().run(stitched, resume_from="latest")
+        resume_wall = time.perf_counter() - t0
+
+        cold_pipeline = load_fn(module, "create_pipeline")(homes[2])
+        t0 = time.perf_counter()
+        cold = LocalDagRunner().run(cold_pipeline)
+        cold_wall = time.perf_counter() - t0
+
+        decisive = ("COMPLETE", "CACHED")
+        lineage_identical = _canonical_lineage(
+            stitched.metadata_path, stitched.pipeline_root,
+            states=decisive, strip_exec_ids=True,
+        ) == _canonical_lineage(
+            cold_pipeline.metadata_path, cold_pipeline.pipeline_root,
+            states=decisive, strip_exec_ids=True,
+        )
+        return {"taxi_faults": {
+            "green": crashed and resumed.succeeded and cold.succeeded,
+            "killed_at": kill_node,
+            "partial_wall_s": round(partial_wall, 2),
+            "resume_wall_s": round(resume_wall, 2),
+            "cold_wall_s": round(cold_wall, 2),
+            "work_saved_ratio": (
+                round(1.0 - resume_wall / cold_wall, 3) if cold_wall else None
+            ),
+            "adopted": sorted(
+                n for n, r in resumed.nodes.items() if r.adopted
+            ),
+            "rerun": sorted(
+                n for n, r in resumed.nodes.items() if not r.adopted
+            ),
+            "lineage_identical": lineage_identical,
+            "env": env,
+        }}
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        for home in homes:
+            shutil.rmtree(home, ignore_errors=True)
 
 
 def bench_flash_probe(smoke: bool) -> dict:
@@ -1131,6 +1260,10 @@ def _compact(report: dict) -> dict:
         "error_legs": sorted(report.get("errors", {})),
         "full_report": "BENCH_PARTIAL.json",
     }
+    robust = (report.get("robustness") or {}).get("taxi_faults")
+    if isinstance(robust, dict) and "green" in robust:
+        compact["robust_green"] = bool(robust.get("green"))
+        compact["work_saved"] = robust.get("work_saved_ratio")
     if "terminated" in report:
         compact["terminated"] = report["terminated"]
     return compact
@@ -1277,6 +1410,9 @@ def main() -> None:
     # Wall-clock head of the BASELINE metric: the same taxi DAG sequential
     # vs concurrent, identical-lineage checked (see bench_e2e_taxi_sched).
     e2e_leg("taxi_sched", bench_e2e_taxi_sched, est_cost_s=240)
+    # Crash-safety evidence: kill-at-Trainer + resume vs cold re-run
+    # (work-saved ratio + stitched-lineage identity, see bench_robustness).
+    leg("robustness", bench_robustness, est_cost_s=300, retries=1)
     leg("mnist", bench_mnist, est_cost_s=60, retries=1)
     leg("resnet", bench_resnet, est_cost_s=150, retries=1)
     leg("flash_probe", bench_flash_probe, est_cost_s=100, retries=1)

@@ -330,6 +330,123 @@ def test_one_worker_scheduler_reproduces_sequential_trace(tmp_path):
     assert dumps[0] == dumps[1]
 
 
+# ------------------------------------------- fault plans + recovery
+# (Concurrent-scheduler versions of the crash-safety contracts; the
+# sequential-path coverage lives in tests/test_recovery.py.)
+
+
+@pytest.mark.robustness
+def test_crash_after_publish_then_resume_adopts_under_concurrency(tmp_path):
+    """Orchestrator death right after a node's COMPLETE publish: the resume
+    adopts that execution as-is (same id) and re-runs only its consumers."""
+    from tpu_pipelines.metadata import MetadataStore
+    from tpu_pipelines.metadata.types import ExecutionState
+    from tpu_pipelines.testing.faults import (
+        CRASH_AFTER_PUBLISH,
+        FaultPlan,
+        NodeFault,
+        SimulatedCrash,
+    )
+
+    p = _diamond(tmp_path, sleep_s=0.02)
+    plan = FaultPlan({"Left": NodeFault(CRASH_AFTER_PUBLISH)})
+    with plan.activate():
+        with pytest.raises(SimulatedCrash):
+            LocalDagRunner(max_parallel_nodes=3).run(p)
+    store = MetadataStore(p.metadata_path)
+    (left_id,) = [e.id for e in store.get_executions(node_id="Left")
+                  if e.state == ExecutionState.COMPLETE]
+    store.close()
+
+    CALLS.clear()
+    result = LocalDagRunner(max_parallel_nodes=3).run(
+        _diamond(tmp_path, sleep_s=0.02), resume_from="latest"
+    )
+    assert result.succeeded
+    assert result.nodes["Left"].adopted
+    assert result.nodes["Left"].execution_id == left_id
+    assert "Left" not in CALLS and "Gen" not in CALLS
+    assert "Join" in CALLS  # downstream of the crash point re-runs
+
+
+@pytest.mark.robustness
+def test_crash_before_publish_then_resume_reruns_with_clean_uri(tmp_path):
+    """Orchestrator death between executor success and publish: the resume
+    fences the RUNNING orphan (ABANDONED + dir reclaimed) and the re-run
+    gets a fresh execution id/URI, never the half-trusted old one."""
+    from tpu_pipelines.metadata import MetadataStore
+    from tpu_pipelines.metadata.types import ExecutionState
+    from tpu_pipelines.testing.faults import (
+        CRASH_BEFORE_PUBLISH,
+        FaultPlan,
+        NodeFault,
+        SimulatedCrash,
+    )
+
+    p = _diamond(tmp_path, sleep_s=0.02)
+    plan = FaultPlan({"Right": NodeFault(CRASH_BEFORE_PUBLISH)})
+    with plan.activate():
+        with pytest.raises(SimulatedCrash):
+            LocalDagRunner(max_parallel_nodes=3).run(p)
+    store = MetadataStore(p.metadata_path)
+    (orphan_id,) = [e.id for e in store.get_executions(node_id="Right")
+                    if e.state == ExecutionState.RUNNING]
+    store.close()
+    orphan_dir = os.path.join(
+        p.pipeline_root, "Right", "schema", str(orphan_id)
+    )
+    assert os.path.isdir(orphan_dir)
+
+    result = LocalDagRunner(max_parallel_nodes=3).run(
+        _diamond(tmp_path, sleep_s=0.02), resume_from="latest"
+    )
+    assert result.succeeded
+    assert not os.path.isdir(orphan_dir)  # fenced + reclaimed
+    right = result.nodes["Right"]
+    assert not right.adopted and right.execution_id != orphan_id
+    assert right.outputs["schema"][0].uri.endswith(str(right.execution_id))
+    store = MetadataStore(p.metadata_path)
+    states = {e.state for e in store.get_executions(node_id="Right")}
+    store.close()
+    assert ExecutionState.ABANDONED in states
+
+
+@pytest.mark.robustness
+def test_tpu_timeout_releases_chip_mutex_for_drain(tmp_path):
+    """A hung tpu-class node hits its deadline: the watchdog releases the
+    chip gate, so the QUEUED tpu sibling still runs during the drain."""
+    from tpu_pipelines.testing.faults import FaultPlan, HANG, NodeFault
+
+    Gen = _stub("Gen", {"examples": "Examples"})
+    THang = _stub("THang", {"model": "Model"}, {"examples": "Examples"},
+                  resource_class="tpu")
+    TNext = _stub("TNext", {"transform_graph": "TransformGraph"},
+                  {"examples": "Examples"}, resource_class="tpu")
+    gen = Gen()
+    thang = THang(examples=gen.outputs["examples"]).with_execution_timeout(
+        0.5
+    )
+    tnext = TNext(examples=gen.outputs["examples"])
+    p = Pipeline(
+        "tpu-timeout", [gen, thang, tnext],
+        pipeline_root=str(tmp_path / "root"),
+        metadata_path=str(tmp_path / "md.sqlite"),
+    )
+    plan = FaultPlan({"THang": NodeFault(HANG, max_hang_s=10)})
+    with plan.activate():
+        result = LocalDagRunner(max_parallel_nodes=3).run(
+            p, raise_on_failure=False
+        )
+    assert result.nodes["THang"].status == "FAILED"
+    assert "timeout" in result.nodes["THang"].error
+    # The chip was released: the other tpu node ran to completion.  (The
+    # hang fires inside THang's attempt, so the chip gate had admitted
+    # THang first — TNext could only run because the watchdog freed it.)
+    assert result.nodes["TNext"].status == "COMPLETE"
+    # The watchdog's cancel event (not the safety ceiling) freed the hang.
+    assert ("THang", "hang_released") in plan.log
+
+
 # ----------------------------------------------------- IR / compiler
 
 

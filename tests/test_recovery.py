@@ -1,0 +1,400 @@
+"""Crash-safe runs: resume-from-metadata, fencing, deadlines, fault plans.
+
+The fault-tolerance tentpole's contracts (docs/RECOVERY.md):
+  - resume_from adopts published executions as-is (same ids, same URIs,
+    lineage preserved) and re-runs only the unfinished frontier;
+  - orphaned RUNNING executions are fenced: ABANDONED in the store, their
+    allocated-but-unpublished output dirs removed, the node re-dispatched
+    on a clean slate;
+  - resume refuses a run whose compiled DAG fingerprint changed;
+  - a hung executor is failed by the deadline watchdog within its
+    execution_timeout_s (+scheduler slack), the run drains, and the
+    cooperative cancel event leaves no orphan thread;
+  - injected faults fire exactly once, so the very next attempt is clean.
+
+Everything here is CPU-only stub components, tier-1-fast (<30 s total).
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from tpu_pipelines.dsl.compiler import Compiler
+from tpu_pipelines.dsl.component import Parameter, component
+from tpu_pipelines.dsl.pipeline import Pipeline
+from tpu_pipelines.metadata import MetadataStore
+from tpu_pipelines.metadata.types import ExecutionState
+from tpu_pipelines.orchestration import LocalDagRunner, PipelineRunError
+from tpu_pipelines.orchestration.local_runner import LocalDagRunner as _LDR
+from tpu_pipelines.testing.faults import (
+    CRASH_AFTER_PUBLISH,
+    CRASH_BEFORE_PUBLISH,
+    HANG,
+    KILL_ORCHESTRATOR,
+    RAISE,
+    FaultPlan,
+    NodeFault,
+    SimulatedCrash,
+)
+
+pytestmark = pytest.mark.robustness
+
+CALLS = []
+
+
+@pytest.fixture(autouse=True)
+def _clear():
+    CALLS.clear()
+
+
+def _stub(name, outs, ins=None, payload="v1"):
+    """Deterministic component: records invocation, writes fixed payloads."""
+
+    @component(inputs=ins or {}, outputs=outs, name=name,
+               parameters={"payload": Parameter(type=str, default=payload)})
+    def C(ctx):
+        CALLS.append(ctx.node_id)
+        for key in ctx.outputs:
+            with open(os.path.join(ctx.output(key).uri, "data.txt"),
+                      "w") as f:
+                f.write(f"{ctx.node_id}:{key}:{ctx.exec_properties['payload']}")
+
+    return C
+
+
+def _chain(tmp_path, subdir="h", payload="v1"):
+    """A -> B -> C -> D linear chain in a persistent home (resumable)."""
+    A = _stub("A", {"examples": "Examples"}, payload=payload)
+    B = _stub("B", {"statistics": "ExampleStatistics"},
+              {"examples": "Examples"}, payload=payload)
+    C = _stub("C", {"schema": "Schema"},
+              {"statistics": "ExampleStatistics"}, payload=payload)
+    D = _stub("D", {"model": "Model"}, {"schema": "Schema"}, payload=payload)
+    a = A()
+    b = B(examples=a.outputs["examples"])
+    c = C(statistics=b.outputs["statistics"])
+    d = D(schema=c.outputs["schema"])
+    home = tmp_path / subdir
+    return Pipeline(
+        "chain", [a, b, c, d],
+        pipeline_root=str(home / "root"),
+        metadata_path=str(home / "md.sqlite"),
+    )
+
+
+def _executions(metadata_path):
+    store = MetadataStore(metadata_path)
+    out = [(e.id, e.node_id, e.state, dict(e.properties))
+           for e in store.get_executions()]
+    store.close()
+    return out
+
+
+# ------------------------------------------------------------------ resume
+
+
+def test_kill_orchestrator_then_resume_reruns_only_descendants(tmp_path):
+    """The acceptance contract: kill at node N, resume, only N and its
+    descendants re-run; adopted nodes keep their original execution ids and
+    artifact URIs."""
+    plan = FaultPlan({"C": NodeFault(KILL_ORCHESTRATOR)})
+    with plan.activate():
+        with pytest.raises(SimulatedCrash):
+            LocalDagRunner().run(_chain(tmp_path))
+    assert CALLS == ["A", "B"]
+    pre = {nid: (ex_id, st) for ex_id, nid, st, _ in
+           _executions(str(tmp_path / "h" / "md.sqlite"))}
+
+    CALLS.clear()
+    p = _chain(tmp_path)
+    result = LocalDagRunner().run(p, resume_from="latest")
+    assert CALLS == ["C", "D"]
+    assert result.succeeded
+    for nid in ("A", "B"):
+        nr = result.nodes[nid]
+        assert nr.adopted and nr.status == "COMPLETE"
+        assert nr.execution_id == pre[nid][0]  # original id kept
+    for nid in ("C", "D"):
+        assert not result.nodes[nid].adopted
+    # Adopted outputs point at the ORIGINAL artifact dirs (lineage intact):
+    b_uri = result.nodes["B"].outputs["statistics"][0].uri
+    assert b_uri.endswith(os.path.join("B", "statistics", str(pre["B"][0])))
+    assert open(os.path.join(b_uri, "data.txt")).read() == "B:statistics:v1"
+    # And the run id was continued, not forked.
+    store = MetadataStore(p.metadata_path)
+    assert len(store.get_contexts("pipeline_run")) == 1
+    store.close()
+
+
+def test_resume_by_run_id_and_unknown_run_id(tmp_path):
+    plan = FaultPlan({"B": NodeFault(KILL_ORCHESTRATOR)})
+    with plan.activate():
+        with pytest.raises(SimulatedCrash):
+            LocalDagRunner().run(_chain(tmp_path), run_id="r-one")
+    result = LocalDagRunner().run(_chain(tmp_path), resume_from="r-one")
+    assert result.succeeded and result.run_id == "r-one"
+    with pytest.raises(ValueError, match="no prior run"):
+        LocalDagRunner().run(_chain(tmp_path), resume_from="r-nope")
+
+
+def test_resume_refuses_changed_dag_fingerprint(tmp_path):
+    plan = FaultPlan({"C": NodeFault(KILL_ORCHESTRATOR)})
+    with plan.activate():
+        with pytest.raises(SimulatedCrash):
+            LocalDagRunner().run(_chain(tmp_path))
+    # Same topology, different exec-property: a different compiled DAG.
+    changed = _chain(tmp_path, payload="v2")
+    with pytest.raises(ValueError, match="resume refused"):
+        LocalDagRunner().run(changed, resume_from="latest")
+    # The unchanged DAG still resumes fine afterwards.
+    assert LocalDagRunner().run(
+        _chain(tmp_path), resume_from="latest"
+    ).succeeded
+
+
+def test_resume_argument_validation(tmp_path):
+    p = _chain(tmp_path)
+    with pytest.raises(ValueError, match="run_id"):
+        LocalDagRunner().run(p, resume_from="latest", run_id="x")
+    with pytest.raises(ValueError, match="from_nodes"):
+        LocalDagRunner().run(p, resume_from="latest", from_nodes=["B"])
+
+
+def test_crash_before_publish_fences_and_reruns_clean(tmp_path):
+    """RUNNING-at-crash execution: marked ABANDONED, its orphan output dir
+    rmtree'd, and the node re-dispatched with a fresh execution id/URI."""
+    plan = FaultPlan({"B": NodeFault(CRASH_BEFORE_PUBLISH)})
+    with plan.activate():
+        with pytest.raises(SimulatedCrash):
+            LocalDagRunner().run(_chain(tmp_path))
+    md = str(tmp_path / "h" / "md.sqlite")
+    (orphan_id,) = [ex_id for ex_id, nid, st, _ in _executions(md)
+                    if nid == "B" and st == ExecutionState.RUNNING]
+    orphan_dir = str(
+        tmp_path / "h" / "root" / "B" / "statistics" / str(orphan_id)
+    )
+    assert os.path.isdir(orphan_dir)  # executor wrote before the crash
+
+    CALLS.clear()
+    result = LocalDagRunner().run(_chain(tmp_path), resume_from="latest")
+    assert result.succeeded
+    assert CALLS == ["B", "C", "D"]  # A adopted, B fenced + re-run
+    assert not os.path.isdir(orphan_dir)  # fencing reclaimed the orphan
+    b = result.nodes["B"]
+    assert not b.adopted and b.execution_id != orphan_id
+    by_node = {}
+    for ex_id, nid, st, props in _executions(md):
+        by_node.setdefault(nid, []).append((st, props))
+    states = [st for st, _ in by_node["B"]]
+    assert ExecutionState.ABANDONED in states  # audit trail kept
+    assert ExecutionState.COMPLETE in states
+    (_, abandoned_props), = [
+        (st, p) for st, p in by_node["B"] if st == ExecutionState.ABANDONED
+    ]
+    assert "crash" in abandoned_props["abandoned_reason"]
+
+
+def test_crash_after_publish_adopts_published_execution(tmp_path):
+    plan = FaultPlan({"B": NodeFault(CRASH_AFTER_PUBLISH)})
+    with plan.activate():
+        with pytest.raises(SimulatedCrash):
+            LocalDagRunner().run(_chain(tmp_path))
+    md = str(tmp_path / "h" / "md.sqlite")
+    (b_id,) = [ex_id for ex_id, nid, st, _ in _executions(md)
+               if nid == "B" and st == ExecutionState.COMPLETE]
+
+    CALLS.clear()
+    result = LocalDagRunner().run(_chain(tmp_path), resume_from="latest")
+    assert result.succeeded
+    assert CALLS == ["C", "D"]  # the published B is adopted, not re-run
+    assert result.nodes["B"].adopted
+    assert result.nodes["B"].execution_id == b_id
+
+
+def test_resume_of_completed_run_reruns_nothing(tmp_path):
+    LocalDagRunner().run(_chain(tmp_path))
+    CALLS.clear()
+    result = LocalDagRunner().run(_chain(tmp_path), resume_from="latest")
+    assert CALLS == []
+    assert result.succeeded
+    assert all(nr.adopted for nr in result.nodes.values())
+
+
+# ----------------------------------------------------------------- faults
+
+
+def test_raise_fault_fires_once_so_retry_succeeds(tmp_path):
+    """A fault plan injects exactly one failure: with a retry budget the
+    second (clean) attempt completes — the retry slate really is clean."""
+    plan = FaultPlan({"B": NodeFault(RAISE, message="transient blip")})
+    with plan.activate():
+        result = LocalDagRunner(max_retries=1).run(_chain(tmp_path))
+    assert result.succeeded
+    assert result.nodes["B"].retries == 1
+    assert CALLS == ["A", "B", "C", "D"]  # the faulted attempt never ran
+
+
+def test_raise_fault_without_retry_fails_and_cascades(tmp_path):
+    plan = FaultPlan({"B": NodeFault(RAISE, message="hard fault")})
+    with plan.activate():
+        with pytest.raises(PipelineRunError):
+            LocalDagRunner().run(_chain(tmp_path))
+
+
+def test_store_unavailable_during_publish_records_node_failure(
+    tmp_path, monkeypatch
+):
+    """Satellite contract: a StoreUnavailableError surfacing through publish
+    becomes a recorded node failure (downstream fails fast, independent
+    work keeps its results) — never a crash of the whole run."""
+    from tpu_pipelines.metadata import StoreUnavailableError
+    from tpu_pipelines.metadata.store import MetadataStore as MS
+
+    real = MS.publish_execution
+
+    def flaky(self, execution, inputs, outputs, contexts=()):
+        if execution.node_id == "B":
+            raise StoreUnavailableError("engine handle died")
+        return real(self, execution, inputs, outputs, contexts)
+
+    monkeypatch.setattr(MS, "publish_execution", flaky)
+    result = LocalDagRunner().run(_chain(tmp_path), raise_on_failure=False)
+    assert result.nodes["A"].status == "COMPLETE"
+    assert result.nodes["B"].status == "FAILED"
+    assert "store unavailable" in result.nodes["B"].error
+    assert result.nodes["C"].status == "FAILED"
+    assert result.nodes["C"].error == "upstream failure"
+
+
+# -------------------------------------------------------------- deadlines
+
+
+def _hang_pipeline(tmp_path, timeout_s, **pipeline_kw):
+    """Hang (deadline) -> Down, plus an independent Side branch that must
+    drain normally while the watchdog fires."""
+
+    @component(inputs={}, outputs={"examples": "Examples"}, name="Hang")
+    def Hang(ctx):
+        CALLS.append(ctx.node_id)
+        released = ctx.extras["cancel_event"].wait(30)
+        raise RuntimeError("released" if released else "ceiling")
+
+    @component(inputs={"examples": "Examples"}, outputs={"model": "Model"},
+               name="Down")
+    def Down(ctx):
+        CALLS.append(ctx.node_id)
+
+    @component(inputs={}, outputs={"schema": "Schema"}, name="Side")
+    def Side(ctx):
+        CALLS.append(ctx.node_id)
+        time.sleep(0.1)
+        with open(os.path.join(ctx.output("schema").uri, "s.txt"), "w") as f:
+            f.write("side")
+
+    h = Hang().with_execution_timeout(timeout_s)
+    d = Down(examples=h.outputs["examples"])
+    s = Side()
+    home = tmp_path / "t"
+    return Pipeline(
+        "deadline", [h, d, s],
+        pipeline_root=str(home / "root"),
+        metadata_path=str(home / "md.sqlite"),
+        **pipeline_kw,
+    )
+
+
+def test_hung_node_fails_within_deadline_and_run_drains(tmp_path):
+    """Acceptance: a hung executor is failed within execution_timeout_s
+    + 2 s, the run drains, and no orphan thread survives (the watchdog's
+    cancel event released the hang)."""
+    p = _hang_pipeline(tmp_path, timeout_s=0.5)
+    before = threading.active_count()
+    t0 = time.monotonic()
+    with pytest.raises(PipelineRunError):
+        LocalDagRunner(max_parallel_nodes=3).run(p)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 0.5 + 2.0
+    # Allow the released worker a beat to unwind, then: no orphans.
+    deadline = time.monotonic() + 2.0
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before
+
+    store = MetadataStore(p.metadata_path)
+    (hang_ex,) = [e for e in store.get_executions() if e.node_id == "Hang"]
+    store.close()
+    assert hang_ex.state == ExecutionState.FAILED
+    assert hang_ex.properties["timeout"] is True
+    assert "deadline" in hang_ex.properties["error"]
+
+
+def test_deadline_drains_run_and_sibling_branch_completes(tmp_path):
+    p = _hang_pipeline(tmp_path, timeout_s=0.4)
+    result = LocalDagRunner(max_parallel_nodes=3).run(
+        p, raise_on_failure=False
+    )
+    assert result.nodes["Hang"].status == "FAILED"
+    assert "timeout" in result.nodes["Hang"].error
+    assert result.nodes["Down"].status == "FAILED"
+    assert result.nodes["Down"].error == "upstream failure"
+    assert "Down" not in CALLS  # never started
+    assert result.nodes["Side"].status == "COMPLETE"  # drained, published
+
+
+def test_timeout_precedence_component_over_pipeline_over_env(monkeypatch):
+    from tpu_pipelines.dsl.compiler import NodeIR, PipelineIR
+
+    def node(t):
+        return NodeIR(
+            id="n", component_type="X", inputs={}, outputs={},
+            exec_properties={}, executor_version="v", upstream=[],
+            execution_timeout_s=t,
+        )
+
+    def ir(default):
+        return PipelineIR(
+            name="p", pipeline_root="/r", metadata_path=":memory:",
+            enable_cache=True, nodes=[], default_node_timeout_s=default,
+        )
+
+    monkeypatch.delenv("TPP_NODE_TIMEOUT_S", raising=False)
+    assert _LDR._node_timeout_s(node(0), ir(0)) == 0.0
+    assert _LDR._node_timeout_s(node(7), ir(30)) == 7.0   # component wins
+    assert _LDR._node_timeout_s(node(0), ir(30)) == 30.0  # pipeline default
+    monkeypatch.setenv("TPP_NODE_TIMEOUT_S", "90")
+    assert _LDR._node_timeout_s(node(0), ir(0)) == 90.0   # env fallback
+    assert _LDR._node_timeout_s(node(0), ir(30)) == 30.0  # default beats env
+    monkeypatch.setenv("TPP_NODE_TIMEOUT_S", "bogus")
+    assert _LDR._node_timeout_s(node(0), ir(0)) == 0.0    # ignored, logged
+
+
+def test_pipeline_default_deadline_applies_via_ir(tmp_path):
+    p = _hang_pipeline(tmp_path, timeout_s=0)  # no component override
+    p.node_timeout_s = 0.4
+    ir = Compiler().compile(p)
+    assert ir.default_node_timeout_s == 0.4
+    result = LocalDagRunner(max_parallel_nodes=3).run(
+        p, raise_on_failure=False
+    )
+    assert result.nodes["Hang"].status == "FAILED"
+    assert "timeout" in result.nodes["Hang"].error
+
+
+# ------------------------------------------------------------ fingerprint
+
+
+def test_dag_fingerprint_stable_and_structural(tmp_path):
+    p1 = _chain(tmp_path, subdir="f1")
+    p2 = _chain(tmp_path, subdir="f2")  # different home, same structure
+    fp1 = Compiler().compile(p1).fingerprint()
+    fp2 = Compiler().compile(p2).fingerprint()
+    assert fp1 == fp2  # relocatable: home paths excluded
+    p3 = _chain(tmp_path, subdir="f3", payload="v2")
+    assert Compiler().compile(p3).fingerprint() != fp1  # properties counted
+    # Deadlines are operational, not structural: retuning one must not
+    # invalidate resume.
+    p4 = _chain(tmp_path, subdir="f4")
+    p4.components[0].with_execution_timeout(123)
+    assert Compiler().compile(p4).fingerprint() == fp1

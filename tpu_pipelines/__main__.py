@@ -98,6 +98,10 @@ def main(argv=None) -> int:
                        help="partial run: start here, upstreams from store")
     p_run.add_argument("--to-node", action="append", default=[],
                        help="partial run: stop here")
+    p_run.add_argument("--resume-from", default=None, metavar="RUN_ID",
+                       help="continue a crashed run: 'latest' or a prior "
+                            "run id; adopts published executions, fences "
+                            "and re-runs the rest (docs/RECOVERY.md)")
     p_run.add_argument("--max-retries", type=int, default=0)
     p_run.add_argument("--max-parallel-nodes", type=int, default=None,
                        help="scheduler worker-pool size (default: DAG root "
@@ -171,6 +175,7 @@ def cmd_run(args) -> int:
         from_nodes=args.from_node or None,
         to_nodes=args.to_node or None,
         raise_on_failure=False,
+        resume_from=args.resume_from,
     )
     print(f"run {result.run_id}: "
           f"{'OK' if result.succeeded else 'FAILED'}")
@@ -178,6 +183,8 @@ def cmd_run(args) -> int:
         mark = {"COMPLETE": "done", "CACHED": "cached"}.get(
             nr.status, nr.status
         )
+        if nr.adopted:
+            mark = f"adopted ({mark})"
         wall = f" ({nr.wall_clock_s:.1f}s)" if nr.wall_clock_s else ""
         err = f"  !! {nr.error}" if nr.error else ""
         print(f"  {node_id}: {mark}{wall}{err}")

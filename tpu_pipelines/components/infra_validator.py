@@ -147,6 +147,31 @@ def InfraValidator(ctx):
     return props
 
 
+def _urlopen_backoff(req, timeout: float = 60, attempts: int = 3,
+                     base_delay_s: float = 0.5):
+    """``urlopen`` with bounded exponential backoff on connection-level
+    errors (URLError wrapping ECONNREFUSED/reset, raw ConnectionError).
+
+    A model server that is still warming up refuses connections for a
+    moment; without the retry the canary would declare the model
+    NOT_BLESSED over a transient, gating a perfectly good push.  HTTP-level
+    errors (4xx/5xx responses) are NOT retried — the server answered, so
+    its verdict stands.
+    """
+    import urllib.error
+    import urllib.request
+
+    for attempt in range(attempts):
+        try:
+            return urllib.request.urlopen(req, timeout=timeout)
+        except urllib.error.HTTPError:
+            raise  # the server spoke; its answer is the answer
+        except (urllib.error.URLError, ConnectionError, TimeoutError):
+            if attempt == attempts - 1:
+                raise
+            time.sleep(base_delay_s * (2 ** attempt))
+
+
 def _http_canary(model_uri: str, raw: bool = True):
     """A reusable predict(batch) callable through the REST surface on a
     loopback port; ``.close()`` stops the server.  Keeping one server alive
@@ -169,7 +194,7 @@ def _http_canary(model_uri: str, raw: bool = True):
             data=json.dumps({"instances": instances}).encode(),
             headers={"Content-Type": "application/json"},
         )
-        with urllib.request.urlopen(req, timeout=60) as r:
+        with _urlopen_backoff(req, timeout=60) as r:
             return np.asarray(json.load(r)["predictions"])
 
     predict.close = server.stop

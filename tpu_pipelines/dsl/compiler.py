@@ -9,6 +9,7 @@ format (SURVEY.md §4 "Compiler/IR tests").
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 from typing import Any, Dict, List
 
@@ -74,6 +75,10 @@ class NodeIR:
     # admits at most one "tpu" node at a time; the cluster runner maps the
     # same class to TPU nodeSelectors and the per-pipeline chip mutex.
     resource_class: str = "host"
+    # Per-node execution deadline in seconds (0 = fall back to the pipeline
+    # default, then env TPP_NODE_TIMEOUT_S).  Local runner: scheduler
+    # watchdog; cluster runner: activeDeadlineSeconds.
+    execution_timeout_s: float = 0.0
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -91,6 +96,7 @@ class NodeIR:
             "is_resolver": self.is_resolver,
             "conditions": list(self.conditions),
             "resource_class": self.resource_class,
+            "execution_timeout_s": self.execution_timeout_s,
         }
 
 
@@ -102,6 +108,9 @@ class PipelineIR:
     enable_cache: bool
     nodes: List[NodeIR]
     schema_version: str = IR_SCHEMA_VERSION
+    # Pipeline-wide default node deadline (0 = none); a node's own
+    # execution_timeout_s takes precedence.
+    default_node_timeout_s: float = 0.0
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -110,8 +119,46 @@ class PipelineIR:
             "pipeline_root": self.pipeline_root,
             "metadata_path": self.metadata_path,
             "enable_cache": self.enable_cache,
+            "default_node_timeout_s": self.default_node_timeout_s,
             "nodes": [n.to_json() for n in self.nodes],
         }
+
+    def fingerprint(self) -> str:
+        """Structural DAG fingerprint, recorded per run and checked by
+        ``resume_from``: a resume against a run whose compiled graph differs
+        (nodes, wiring, exec-properties, executor code) must be refused —
+        adopted outputs would no longer be what the current DAG produces.
+        Deliberately EXCLUDES relocatable/operational fields (pipeline_root,
+        metadata_path, enable_cache, resource_class, timeouts): moving the
+        home or retuning deadlines does not change what a node computes.
+        """
+        structural = [
+            {
+                "id": n.id,
+                "component_type": n.component_type,
+                "inputs": {
+                    k: [r.to_json() for r in refs]
+                    for k, refs in n.inputs.items()
+                },
+                "outputs": dict(n.outputs),
+                "exec_properties": n.exec_properties,
+                "executor_version": n.executor_version,
+                "upstream": list(n.upstream),
+                "external_input_parameters": list(
+                    n.external_input_parameters
+                ),
+                "optional_inputs": list(n.optional_inputs),
+                "is_resolver": n.is_resolver,
+                "conditions": list(n.conditions),
+            }
+            for n in self.nodes
+        ]
+        payload = json.dumps(
+            {"schema": self.schema_version, "name": self.name,
+             "nodes": structural},
+            sort_keys=True, default=str,
+        )
+        return hashlib.sha256(payload.encode()).hexdigest()
 
     def to_json_str(self, indent: int = 2) -> str:
         return json.dumps(self.to_json(), indent=indent, sort_keys=True, default=str)
@@ -195,6 +242,9 @@ class Compiler:
                     is_resolver=bool(getattr(comp, "IS_RESOLVER", False)),
                     conditions=conditions,
                     resource_class=getattr(comp, "RESOURCE_CLASS", "host"),
+                    execution_timeout_s=float(
+                        getattr(comp, "execution_timeout_s", 0.0) or 0.0
+                    ),
                 )
             )
         return PipelineIR(
@@ -203,6 +253,9 @@ class Compiler:
             metadata_path=pipeline.metadata_path,
             enable_cache=pipeline.enable_cache,
             nodes=nodes,
+            default_node_timeout_s=float(
+                getattr(pipeline, "node_timeout_s", 0.0) or 0.0
+            ),
         )
 
     @staticmethod

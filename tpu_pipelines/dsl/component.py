@@ -140,12 +140,20 @@ class Component:
     # file invalidates the cache even though the path string is unchanged —
     # the equivalent of TFX ExampleGen's input-fingerprint/span mechanism.
     EXTERNAL_INPUT_PARAMETERS: tuple = ()
+    # Execution deadline in seconds (0 = none).  The deadline covers the
+    # node's whole launcher phase — all retry attempts included — so a hung
+    # executor cannot stall the run forever.  Precedence: this component
+    # override > Pipeline(node_timeout_s=...) > env TPP_NODE_TIMEOUT_S.
+    # Locally a scheduler watchdog enforces it; on the cluster it maps to
+    # activeDeadlineSeconds (Argo template / JobSet job).
+    EXECUTION_TIMEOUT_S: float = 0.0
 
     def __init__(self, instance_name: str = "", **kwargs: Any):
         cls = type(self)
         self.id = instance_name or cls.__name__
         self.input_channels: Dict[str, List[Channel]] = {}
         self.exec_properties: Dict[str, Any] = {}
+        self.execution_timeout_s = float(cls.EXECUTION_TIMEOUT_S or 0.0)
 
         for key, value in kwargs.items():
             # A key may name both an input and a parameter (e.g. Trainer's
@@ -221,6 +229,15 @@ class Component:
         self.id = instance_name
         return self
 
+    def with_execution_timeout(self, seconds: float) -> "Component":
+        """Per-instance deadline override (chainable, like ``with_id``)."""
+        if seconds < 0:
+            raise ValueError(
+                f"{self.id}: execution timeout must be >= 0, got {seconds}"
+            )
+        self.execution_timeout_s = float(seconds)
+        return self
+
     def __repr__(self) -> str:
         return f"{type(self).__name__}(id={self.id!r})"
 
@@ -233,6 +250,7 @@ def component(
     external_input_parameters: tuple = (),
     optional_inputs: tuple = (),
     resource_class: str = "host",
+    execution_timeout_s: float = 0.0,
 ) -> Callable[[ExecutorFn], Type[Component]]:
     """Decorator: build a Component subclass from a bare executor function.
 
@@ -266,6 +284,7 @@ def component(
                 "__doc__": fn.__doc__,
                 "EXTERNAL_INPUT_PARAMETERS": tuple(external_input_parameters),
                 "RESOURCE_CLASS": resource_class,
+                "EXECUTION_TIMEOUT_S": float(execution_timeout_s),
             },
         )
 

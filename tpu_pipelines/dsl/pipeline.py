@@ -22,11 +22,20 @@ class Pipeline:
         pipeline_root: str,
         metadata_path: str = ":memory:",
         enable_cache: bool = True,
+        node_timeout_s: float = 0.0,
     ):
         self.name = name
         self.pipeline_root = pipeline_root
         self.metadata_path = metadata_path
         self.enable_cache = enable_cache
+        # Default per-node execution deadline (seconds; 0 = none).  A
+        # component's own EXECUTION_TIMEOUT_S / with_execution_timeout()
+        # overrides it; env TPP_NODE_TIMEOUT_S is the outermost fallback.
+        if node_timeout_s < 0:
+            raise ValueError(
+                f"Pipeline {name!r}: node_timeout_s must be >= 0"
+            )
+        self.node_timeout_s = float(node_timeout_s)
         self.components = self._closure_in_topo_order(components)
         ids = [c.id for c in self.components]
         dupes = {i for i in ids if ids.count(i) > 1}

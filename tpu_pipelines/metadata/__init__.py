@@ -14,7 +14,10 @@ from tpu_pipelines.metadata.types import (  # noqa: F401
     ExecutionState,
     Context,
 )
-from tpu_pipelines.metadata.store import MetadataStore  # noqa: F401
+from tpu_pipelines.metadata.store import (  # noqa: F401
+    MetadataStore,
+    StoreUnavailableError,
+)
 
 
 def open_store(db_path: str = ":memory:", backend: str = "") -> MetadataStore:

@@ -23,7 +23,7 @@ import subprocess
 import threading
 from typing import Dict, Iterable, List, Optional
 
-from tpu_pipelines.metadata.store import MetadataStore
+from tpu_pipelines.metadata.store import MetadataStore, StoreUnavailableError
 from tpu_pipelines.metadata.types import (
     Artifact,
     ArtifactState,
@@ -62,6 +62,18 @@ def _load_library():
                 ["make", "-C", NATIVE_DIR], check=True,
                 capture_output=True, text=True, timeout=120,
             )
+        except subprocess.TimeoutExpired as e:
+            # A hung make is not a missing toolchain: an existing .so may be
+            # stale relative to the sources, so using it as-is could run old
+            # engine code against new client expectations.  Surface a
+            # structured store-level error; open_store() falls back to the
+            # python backend (same on-disk schema) and the run proceeds —
+            # a scheduler-level publish sees a recorded failure, never a
+            # bare TimeoutExpired crashing the run.
+            raise StoreUnavailableError(
+                f"native metadata backend build timed out after "
+                f"{e.timeout:.0f}s (make -C {NATIVE_DIR})"
+            ) from e
         except (subprocess.SubprocessError, OSError) as e:
             detail = getattr(e, "stderr", "") or str(e)
             if not os.path.exists(path):
@@ -152,7 +164,10 @@ class NativeMetadataStore(MetadataStore):
 
     def _err(self, what: str):
         msg = self._lib.tpp_meta_errmsg(self._handle).decode("utf-8", "replace")
-        raise RuntimeError(f"native metadata store: {what}: {msg}")
+        # Structured (StoreUnavailableError is a RuntimeError subclass, so
+        # existing expectations hold): the runner catches it around publishes
+        # and records a node failure instead of crashing the run.
+        raise StoreUnavailableError(f"native metadata store: {what}: {msg}")
 
     def _take_json(self, ptr) -> list:
         if not ptr:

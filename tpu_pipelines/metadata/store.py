@@ -31,6 +31,13 @@ from tpu_pipelines.metadata.types import (
     LineageNode,
 )
 
+class StoreUnavailableError(RuntimeError):
+    """The metadata backend cannot serve a request (build timeout, dead
+    native handle, engine-level failure).  Subclasses RuntimeError so
+    existing callers keep working; the runner catches it around publishes
+    and records a node failure instead of crashing the whole run."""
+
+
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS artifacts (
     id INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -418,6 +425,32 @@ class MetadataStore:
                     for art in arts:
                         self.attribute(ctx.id, art.id)
             return execution
+
+    # ------------------------------------------------------- crash fencing
+
+    def sweep_stale_executions(
+        self, run_context_id: int, reason: str = "orchestrator crash"
+    ) -> List[Execution]:
+        """Fence a crashed run's orphaned executions.
+
+        Every execution associated with the run context that is still
+        RUNNING was registered by an orchestrator that died before
+        publishing: its outputs may be half-written and must never be
+        adopted.  Marks each one ABANDONED (recording ``reason``) and
+        returns the fenced executions so the caller can reclaim their
+        allocated-but-unpublished output URIs.  Built on the primitive
+        accessors, so the native backend inherits it unchanged.
+        """
+        fenced: List[Execution] = []
+        with self._lock:
+            for ex in self.get_executions_by_context(run_context_id):
+                if ex.state != ExecutionState.RUNNING:
+                    continue
+                ex.state = ExecutionState.ABANDONED
+                ex.properties["abandoned_reason"] = reason
+                self.put_execution(ex)
+                fenced.append(ex)
+        return fenced
 
     # -------------------------------------------------------- cache queries
 

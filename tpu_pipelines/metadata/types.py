@@ -27,6 +27,10 @@ class ExecutionState(str, enum.Enum):
     FAILED = "FAILED"
     CACHED = "CACHED"        # outputs reused from a prior COMPLETE execution
     CANCELED = "CANCELED"
+    # Orphaned RUNNING execution fenced by a resume's stale-execution sweep:
+    # its orchestrator died before publishing, so the record can never be
+    # trusted (the executor may have half-written its outputs).
+    ABANDONED = "ABANDONED"
 
 
 class EventType(str, enum.Enum):

@@ -152,6 +152,19 @@ class TPUJobRunner:
             and self.config.num_hosts > 1
         )
 
+    @staticmethod
+    def _node_deadline_s(ir: PipelineIR, node) -> int:
+        """Effective execution deadline (whole seconds; 0 = none) — the
+        cluster mirror of the local watchdog's precedence: component
+        override > pipeline default.  The env fallback (TPP_NODE_TIMEOUT_S)
+        is deliberately NOT read at compile time: the operator laptop's
+        environment is not the cluster's; set the pipeline default instead.
+        """
+        t = float(getattr(node, "execution_timeout_s", 0.0) or 0.0)
+        if t <= 0:
+            t = float(getattr(ir, "default_node_timeout_s", 0.0) or 0.0)
+        return int(-(-t // 1)) if t > 0 else 0
+
     # ------------------------------------------------- tuner trial fan-out
 
     @staticmethod
@@ -250,6 +263,12 @@ class TPUJobRunner:
         ]
         for node in ir.nodes:
             shards = self._tuner_shards(node)
+            # The local watchdog's deadline, as Argo's template-level
+            # activeDeadlineSeconds: a hung pod is killed by the substrate
+            # and the failure counts against retryStrategy — the same
+            # "timeouts consume the retry budget" semantics as the local
+            # runner (docs/RECOVERY.md precedence table).
+            deadline_s = self._node_deadline_s(ir, node)
             for i in range(shards):
                 trial_tpl: Dict[str, Any] = {
                     "name": k8s_name(f"{node.id}-trial-{i}"),
@@ -263,6 +282,8 @@ class TPUJobRunner:
                     },
                     "nodeSelector": self._tpu_node_selector(),
                 }
+                if deadline_s:
+                    trial_tpl["activeDeadlineSeconds"] = deadline_s
                 if cfg.shared_volume_claim:
                     trial_tpl["container"]["volumeMounts"] = (
                         self._volume_mounts()
@@ -272,6 +293,8 @@ class TPUJobRunner:
                 "name": k8s_name(node.id),
                 "retryStrategy": {"limit": 2},
             }
+            if deadline_s:
+                tpl["activeDeadlineSeconds"] = deadline_s
             if self._is_distributed(node):
                 # Create the node's JobSet and await it: multi-host training
                 # runs inside the DAG, dependencies intact.
@@ -377,6 +400,19 @@ class TPUJobRunner:
         }
         if cfg.shared_volume_claim:
             pod_spec["volumes"] = self._volumes()
+        job_spec: Dict[str, Any] = {
+            "parallelism": cfg.num_hosts,
+            "completions": cfg.num_hosts,
+            "completionMode": "Indexed",
+            "backoffLimit": 0,
+            "template": {"spec": pod_spec},
+        }
+        deadline_s = self._node_deadline_s(ir, ir.node(node_id))
+        if deadline_s:
+            # Enforced by the Job controller itself, so a hung multi-host
+            # step dies even when submitted standalone (outside the Argo
+            # template whose activeDeadlineSeconds mirrors it).
+            job_spec["activeDeadlineSeconds"] = deadline_s
         return {
             "apiVersion": "jobset.x-k8s.io/v1alpha2",
             "kind": "JobSet",
@@ -392,15 +428,7 @@ class TPUJobRunner:
                 "replicatedJobs": [{
                     "name": "workers",
                     "replicas": 1,
-                    "template": {
-                        "spec": {
-                            "parallelism": cfg.num_hosts,
-                            "completions": cfg.num_hosts,
-                            "completionMode": "Indexed",
-                            "backoffLimit": 0,
-                            "template": {"spec": pod_spec},
-                        },
-                    },
+                    "template": {"spec": job_spec},
                 }],
             },
         }

@@ -31,6 +31,24 @@ collectives require every process to take the same branches in the same
 order — the runner takes the classic sequential topo loop, whose metadata
 trace the 1-worker scheduler reproduces exactly (tests/test_concurrent_runner).
 
+Crash safety (docs/RECOVERY.md):
+  - ``run(..., resume_from="latest"|run_id)`` reconstructs a prior run from
+    the metadata store: COMPLETE/CACHED executions are ADOPTED as-is (same
+    execution ids, same artifact URIs, lineage preserved); executions still
+    RUNNING at the crash are fenced (marked ABANDONED, their
+    allocated-but-unpublished output dirs removed) and re-dispatched along
+    with everything downstream.  A per-run DAG fingerprint recorded on the
+    run context refuses resumption of a run whose compiled IR changed.
+  - per-node ``execution_timeout_s`` (component override > pipeline default
+    > env ``TPP_NODE_TIMEOUT_S``) is enforced by a watchdog in the
+    scheduler thread: on expiry the node is published FAILED(timeout), its
+    chip gate released, and the run drains — the worker's eventual result
+    is fenced out, so a hung executor can never stall the pool or
+    double-publish.
+  - fault hooks (tpu_pipelines/testing/faults.py) thread through dispatch,
+    the executor attempt, and both sides of the publisher — no-ops unless a
+    test installs a plan.
+
 The orchestrator is cold control plane; all hot work happens inside executors
 (jitted train/transform steps).  Single-writer metadata discipline: only this
 runner writes to the store during a run.
@@ -56,7 +74,7 @@ from tpu_pipelines.dsl.compiler import (
 )
 from tpu_pipelines.dsl.component import ExecutorContext
 from tpu_pipelines.dsl.pipeline import Pipeline
-from tpu_pipelines.metadata.store import MetadataStore
+from tpu_pipelines.metadata.store import MetadataStore, StoreUnavailableError
 from tpu_pipelines.metadata.types import (
     Artifact,
     ArtifactState,
@@ -64,6 +82,7 @@ from tpu_pipelines.metadata.types import (
     Execution,
     ExecutionState,
 )
+from tpu_pipelines.testing import faults as _faults
 from tpu_pipelines.utils.fingerprint import execution_cache_key, fingerprint_dir
 from tpu_pipelines.utils.span import has_span_pattern, resolve_span_pattern
 
@@ -158,6 +177,9 @@ class NodeResult:
     error: str = ""
     wall_clock_s: float = 0.0
     retries: int = 0
+    # True when resume_from stitched this node in from a prior run's
+    # published execution instead of executing it again.
+    adopted: bool = False
 
 
 @dataclasses.dataclass
@@ -195,6 +217,22 @@ class _LaunchPlan:
     outputs: Dict[str, List[Artifact]]
     all_ctx: List[Context]
     t0: float
+    # Deadline watchdog state (0 = no deadline).  ``cancel`` is handed to
+    # the executor (extras["cancel_event"]) so cooperative long-runners can
+    # abort; ``fenced`` is set by the scheduler when the deadline expires
+    # (the worker must not publish afterwards); ``published`` is set by the
+    # worker under the publish lock (the scheduler must not fence
+    # afterwards) — together they make exactly one publish win.
+    deadline_s: float = 0.0
+    cancel: threading.Event = dataclasses.field(
+        default_factory=threading.Event
+    )
+    fenced: threading.Event = dataclasses.field(
+        default_factory=threading.Event
+    )
+    published: threading.Event = dataclasses.field(
+        default_factory=threading.Event
+    )
 
 
 class LocalDagRunner:
@@ -252,69 +290,130 @@ class LocalDagRunner:
         to_nodes: Optional[Sequence[str]] = None,
         raise_on_failure: bool = True,
         extras: Optional[Dict[str, Any]] = None,
+        resume_from: Optional[str] = None,
     ) -> RunResult:
         """Execute the pipeline.
 
         ``from_nodes``/``to_nodes`` bound a partial run (TFX partial-run
         semantics): nodes outside the range are not executed; their outputs are
         resolved from the latest LIVE artifacts already in the metadata store.
+
+        ``resume_from`` ("latest" or a prior run id) continues a run whose
+        orchestrator died: published COMPLETE/CACHED executions are adopted
+        as-is, RUNNING-at-crash executions are fenced (ABANDONED + orphan
+        output dirs removed), and only unfinished nodes plus their
+        descendants execute.  Refused when the compiled DAG's fingerprint no
+        longer matches the one recorded for that run.
         """
         ir = Compiler().compile(pipeline)
         executors = {c.id: c for c in pipeline.components}
         from tpu_pipelines.metadata import open_store
 
         store = open_store(pipeline.metadata_path)
-        run_id = run_id or f"{pipeline.name}-{int(time.time() * 1000)}"
-        runtime_parameters = dict(runtime_parameters or {})
+        dag_fp = ir.fingerprint()
+        adopted: Dict[str, NodeResult] = {}
+        try:
+            if resume_from:
+                if self.spmd_sync:
+                    raise ValueError(
+                        "resume_from is incompatible with spmd_sync: resume "
+                        "decisions are store-derived and per-process; use "
+                        "substrate-level restart (Argo retry) for multi-host "
+                        "nodes"
+                    )
+                if from_nodes or to_nodes:
+                    raise ValueError(
+                        "resume_from is incompatible with from_nodes/"
+                        "to_nodes: a resume re-runs exactly the unfinished "
+                        "frontier of the prior run"
+                    )
+                if run_id:
+                    raise ValueError(
+                        "pass either resume_from (continues the prior run's "
+                        "id) or run_id, not both"
+                    )
+                run_id, adopted = self._prepare_resume(
+                    store, ir, pipeline.name, resume_from, dag_fp
+                )
+            run_id = run_id or f"{pipeline.name}-{int(time.time() * 1000)}"
+            runtime_parameters = dict(runtime_parameters or {})
 
-        pipeline_ctx = Context("pipeline", pipeline.name)
-        run_ctx = Context(
-            "pipeline_run", f"{pipeline.name}.{run_id}",
-            properties={"run_id": run_id},
-        )
-        store.put_context(pipeline_ctx)
-        store.put_context(run_ctx)
-
-        selected = self._select_nodes(ir, from_nodes, to_nodes)
-        if self.spmd_sync and len(selected) != 1:
-            # Per-node collective counts must be identical on every process;
-            # the failed-upstream skip path performs none, so a multi-node
-            # run with divergent node outcomes would deadlock peers at the
-            # next node's broadcast.  Cluster mode runs one node per pod.
-            raise ValueError(
-                "spmd_sync requires a single-node partial run "
-                f"(from_nodes=to_nodes=[node]); selected {sorted(selected)}"
+            pipeline_ctx = Context("pipeline", pipeline.name)
+            run_ctx = Context(
+                "pipeline_run", f"{pipeline.name}.{run_id}",
+                # The DAG fingerprint recorded here is what a future
+                # resume_from checks; put_context is insert-or-fetch, so a
+                # resumed run keeps the original record.
+                properties={"run_id": run_id, "dag_fingerprint": dag_fp},
             )
-        result = RunResult(pipeline_name=pipeline.name, run_id=run_id)
-        # node_id -> {output_key: [Artifact]} for this run's input resolution.
-        produced: Dict[str, Dict[str, List[Artifact]]] = {}
-        failed_upstream: set = set()
-        cond_skipped: set = set()
+            store.put_context(pipeline_ctx)
+            store.put_context(run_ctx)
 
-        max_parallel = self._effective_parallelism(ir)
-        result.max_parallel_nodes = max_parallel
-        shared = dict(
-            store=store, ir=ir, executors=executors, selected=selected,
-            produced=produced, failed_upstream=failed_upstream,
-            cond_skipped=cond_skipped, result=result,
-            runtime_parameters=runtime_parameters,
-            pipeline_ctx=pipeline_ctx, run_ctx=run_ctx,
-            extras=extras, enable_cache=pipeline.enable_cache,
-        )
-        # TPP_FORCE_SCHEDULER=1 routes even a 1-worker run through the
-        # concurrent scheduler — the test hook proving its trace matches the
-        # sequential loop byte for byte (tests/test_concurrent_runner.py).
-        # spmd_sync always stays sequential: its collectives require every
-        # process to take identical branches in identical order.
-        if not self.spmd_sync and (
-            max_parallel > 1
-            or os.environ.get("TPP_FORCE_SCHEDULER") == "1"
-        ):
-            self._run_nodes_concurrent(max_workers=max_parallel, **shared)
-        else:
-            self._run_nodes_sequential(**shared)
+            selected = self._select_nodes(ir, from_nodes, to_nodes)
+            if self.spmd_sync and len(selected) != 1:
+                # Per-node collective counts must be identical on every
+                # process; the failed-upstream skip path performs none, so a
+                # multi-node run with divergent node outcomes would deadlock
+                # peers at the next node's broadcast.  Cluster mode runs one
+                # node per pod.
+                raise ValueError(
+                    "spmd_sync requires a single-node partial run "
+                    f"(from_nodes=to_nodes=[node]); selected {sorted(selected)}"
+                )
+            result = RunResult(pipeline_name=pipeline.name, run_id=run_id)
+            # node_id -> {output_key: [Artifact]} for input resolution.
+            produced: Dict[str, Dict[str, List[Artifact]]] = {}
+            failed_upstream: set = set()
+            cond_skipped: set = set()
+            # Adopted nodes settle before scheduling starts: downstream
+            # input resolution sees their original artifacts, and both
+            # loops skip anything already in result.nodes.
+            for node in ir.nodes:
+                if node.id in adopted:
+                    self._settle(
+                        adopted[node.id], produced, failed_upstream,
+                        cond_skipped, result,
+                    )
 
-        store.close()
+            max_parallel = self._effective_parallelism(ir)
+            result.max_parallel_nodes = max_parallel
+            shared = dict(
+                store=store, ir=ir, executors=executors, selected=selected,
+                produced=produced, failed_upstream=failed_upstream,
+                cond_skipped=cond_skipped, result=result,
+                runtime_parameters=runtime_parameters,
+                pipeline_ctx=pipeline_ctx, run_ctx=run_ctx,
+                extras=extras, enable_cache=pipeline.enable_cache,
+            )
+            # Deadline enforcement needs the executor in a worker thread the
+            # watchdog can outlive, so any configured deadline routes the run
+            # through the concurrent scheduler even at pool size 1.
+            has_deadlines = any(
+                self._node_timeout_s(n, ir) > 0 for n in ir.nodes
+            )
+            # TPP_FORCE_SCHEDULER=1 routes even a 1-worker run through the
+            # concurrent scheduler — the test hook proving its trace matches
+            # the sequential loop byte for byte (tests/test_concurrent_runner
+            # .py).  spmd_sync always stays sequential: its collectives
+            # require every process to take identical branches in identical
+            # order.
+            if not self.spmd_sync and (
+                max_parallel > 1
+                or has_deadlines
+                or os.environ.get("TPP_FORCE_SCHEDULER") == "1"
+            ):
+                self._run_nodes_concurrent(max_workers=max_parallel, **shared)
+            else:
+                if has_deadlines and self.spmd_sync:
+                    log.warning(
+                        "execution_timeout_s is not enforced under spmd_sync"
+                        " (the schedule must stay collective-deterministic);"
+                        " rely on the substrate deadline"
+                        " (activeDeadlineSeconds)"
+                    )
+                self._run_nodes_sequential(**shared)
+        finally:
+            store.close()
         if raise_on_failure and not result.succeeded:
             bad = [n for n in result.nodes.values() if n.status == "FAILED"]
             raise PipelineRunError(
@@ -340,6 +439,175 @@ class LocalDagRunner:
         if env:
             return max(1, int(env))
         return max(1, ir.n_roots())
+
+    @staticmethod
+    def _node_timeout_s(node: NodeIR, ir: PipelineIR) -> float:
+        """Effective execution deadline for a node (0 = none).
+
+        Precedence: component-level override (NodeIR.execution_timeout_s) >
+        pipeline default (Pipeline(node_timeout_s=...)) > env
+        ``TPP_NODE_TIMEOUT_S`` as the fleet-wide outermost fallback.
+        """
+        if node.execution_timeout_s and node.execution_timeout_s > 0:
+            return float(node.execution_timeout_s)
+        if ir.default_node_timeout_s and ir.default_node_timeout_s > 0:
+            return float(ir.default_node_timeout_s)
+        env = os.environ.get("TPP_NODE_TIMEOUT_S", "")
+        if env:
+            try:
+                return max(0.0, float(env))
+            except ValueError:
+                log.warning("ignoring non-numeric TPP_NODE_TIMEOUT_S=%r", env)
+        return 0.0
+
+    # -------------------------------------------------------------- resume
+
+    def _prepare_resume(
+        self,
+        store: MetadataStore,
+        ir: PipelineIR,
+        pipeline_name: str,
+        resume_from: str,
+        dag_fp: str,
+    ):
+        """Reconstruct a crashed run's state from the metadata store.
+
+        Returns ``(run_id, adopted)`` where ``adopted`` maps node ids to
+        ready-made NodeResults for every node whose prior execution can be
+        trusted: COMPLETE/CACHED with all output artifacts still LIVE (and
+        every upstream itself adopted), or a Cond CANCELED skip record.
+        Before adoption, the stale-execution sweep fences everything still
+        RUNNING at the crash: marks it ABANDONED in the store and removes
+        its allocated-but-unpublished output dirs, so the re-dispatch starts
+        from a clean slate and a half-written payload can never be read.
+        """
+        prefix = f"{pipeline_name}."
+        candidates = [
+            c for c in store.get_contexts("pipeline_run")
+            if c.name.startswith(prefix)
+        ]
+        if resume_from != "latest":
+            candidates = [
+                c for c in candidates
+                if c.properties.get("run_id") == resume_from
+                or c.name == prefix + resume_from
+            ]
+        if not candidates:
+            raise ValueError(
+                f"resume_from={resume_from!r}: no prior run of pipeline "
+                f"{pipeline_name!r} in {store.db_path!r}"
+            )
+        run_ctx = max(candidates, key=lambda c: c.id)
+        prior_fp = run_ctx.properties.get("dag_fingerprint", "")
+        if prior_fp != dag_fp:
+            detail = (
+                "was recorded before DAG fingerprinting existed"
+                if not prior_fp
+                else "was compiled from a different DAG (nodes, wiring, "
+                     "exec-properties, or executor code changed)"
+            )
+            raise ValueError(
+                f"resume refused: run {run_ctx.name!r} {detail}; start a "
+                "fresh run instead (the execution cache still reuses "
+                "any node whose inputs and code are unchanged)"
+            )
+        run_id = run_ctx.properties.get("run_id") or run_ctx.name[len(prefix):]
+
+        by_id = {n.id: n for n in ir.nodes}
+        fenced = store.sweep_stale_executions(run_ctx.id)
+        for ex in fenced:
+            node = by_id.get(ex.node_id)
+            if node is None:
+                continue
+            for key in node.outputs:
+                stale = os.path.join(
+                    ir.pipeline_root, node.id, key, str(ex.id)
+                )
+                if os.path.isdir(stale):
+                    shutil.rmtree(stale)
+
+        # Newest decisive execution per node within the crashed run.
+        decisive: Dict[str, Execution] = {}
+        for ex in store.get_executions_by_context(run_ctx.id):  # id order
+            if ex.state in (
+                ExecutionState.COMPLETE,
+                ExecutionState.CACHED,
+                ExecutionState.FAILED,
+                ExecutionState.ABANDONED,
+            ):
+                decisive[ex.node_id] = ex
+            elif (
+                ex.state == ExecutionState.CANCELED
+                and ex.properties.get("cond_skipped")
+            ):
+                decisive[ex.node_id] = ex
+
+        adopted: Dict[str, NodeResult] = {}
+        for node in ir.nodes:  # topo order: upstream adoption settles first
+            ex = decisive.get(node.id)
+            if ex is None:
+                continue
+            if any(u not in adopted for u in node.upstream):
+                # An upstream re-runs, so this node's recorded outputs may
+                # not match what the re-run produces — re-run it too (the
+                # execution cache still short-circuits identical work).
+                continue
+            if ex.state in (ExecutionState.COMPLETE, ExecutionState.CACHED):
+                outputs = self._outputs_of_execution(store, node, ex)
+                if outputs is None:
+                    continue  # an output artifact went non-LIVE: re-run
+                adopted[node.id] = NodeResult(
+                    node_id=node.id,
+                    status=(
+                        "COMPLETE"
+                        if ex.state == ExecutionState.COMPLETE else "CACHED"
+                    ),
+                    execution_id=ex.id,
+                    outputs=outputs,
+                    adopted=True,
+                )
+            elif ex.state == ExecutionState.CANCELED:
+                adopted[node.id] = NodeResult(
+                    node_id=node.id, status="COND_SKIPPED", adopted=True
+                )
+            # FAILED / ABANDONED: fall through to re-dispatch.
+        rerun = sorted(n.id for n in ir.nodes if n.id not in adopted)
+        log.info(
+            "resume %s: adopting %d node(s), fenced %d stale execution(s), "
+            "re-running %s",
+            run_id, len(adopted), len(fenced), rerun or "nothing",
+        )
+        return run_id, adopted
+
+    @staticmethod
+    def _outputs_of_execution(
+        store: MetadataStore, node: NodeIR, ex: Execution
+    ) -> Optional[Dict[str, List[Artifact]]]:
+        """A specific execution's outputs in event-index order, or None when
+        any output artifact is no longer LIVE (adoption must be refused)."""
+        from tpu_pipelines.metadata.types import EventType
+
+        candidate: Dict[str, List[tuple]] = {}
+        for ev in store.get_events_by_execution(ex.id):
+            if ev.type != EventType.OUTPUT:
+                continue
+            art = store.get_artifact(ev.artifact_id)
+            if art is None or art.state != ArtifactState.LIVE:
+                return None
+            candidate.setdefault(ev.path, []).append((ev.index, art))
+        if not candidate and node.outputs and not node.is_resolver:
+            # A COMPLETE execution with declared outputs but no OUTPUT
+            # events is corrupt state (interrupted legacy publish) — same
+            # rule as the cache lookup.
+            return None
+        outputs: Dict[str, List[Artifact]] = (
+            {key: [] for key in node.outputs} if node.is_resolver else {}
+        )
+        outputs.update({
+            path: [a for _, a in sorted(pairs, key=lambda p: p[0])]
+            for path, pairs in candidate.items()
+        })
+        return outputs
 
     def _control_outcome(
         self,
@@ -478,16 +746,27 @@ class LocalDagRunner:
     ) -> None:
         """The classic strict-topo-order loop (spmd_sync and pool size 1)."""
         for node in ir.nodes:
-            node_result = self._control_outcome(
-                store, node, selected, produced, failed_upstream,
-                cond_skipped, runtime_parameters, pipeline_ctx, run_ctx,
-            )
-            if node_result is None:
-                node_result = self._run_node(
-                    store, ir, node, executors[node.id], produced,
-                    runtime_parameters, [pipeline_ctx, run_ctx],
-                    extras=dict(extras or {}),
-                    enable_cache=enable_cache,
+            if node.id in result.nodes:
+                continue  # adopted by resume_from before scheduling began
+            try:
+                node_result = self._control_outcome(
+                    store, node, selected, produced, failed_upstream,
+                    cond_skipped, runtime_parameters, pipeline_ctx, run_ctx,
+                )
+                if node_result is None:
+                    node_result = self._run_node(
+                        store, ir, node, executors[node.id], produced,
+                        runtime_parameters, [pipeline_ctx, run_ctx],
+                        extras=dict(extras or {}),
+                        enable_cache=enable_cache,
+                    )
+            except StoreUnavailableError as e:
+                # Store backend died under a driver-phase write: record a
+                # node failure (descendants fail fast) instead of crashing
+                # the run.
+                node_result = NodeResult(
+                    node_id=node.id, status="FAILED",
+                    error=f"metadata store unavailable: {e}",
                 )
             self._settle(
                 node_result, produced, failed_upstream, cond_skipped, result
@@ -511,10 +790,19 @@ class LocalDagRunner:
         from concurrent.futures import ThreadPoolExecutor
 
         publish_lock = threading.Lock()
-        unprocessed = [n.id for n in ir.nodes]  # stays in topo order
+        # Adopted (resume_from) nodes are already settled in result.nodes.
+        unprocessed = [
+            n.id for n in ir.nodes if n.id not in result.nodes
+        ]  # stays in topo order
         by_id = {n.id: n for n in ir.nodes}
-        settled: set = set()
+        settled: set = set(result.nodes)
         in_flight: set = set()
+        in_flight_plans: Dict[str, _LaunchPlan] = {}
+        # node_id -> absolute monotonic deadline for in-flight timed nodes.
+        deadlines: Dict[str, float] = {}
+        # Nodes settled FAILED(timeout) by the watchdog whose worker thread
+        # has not returned yet: their eventual done_q result is discarded.
+        zombies: set = set()
         tpu_in_flight: Optional[str] = None
         done_q: "queue_mod.Queue" = queue_mod.Queue()
 
@@ -523,6 +811,11 @@ class LocalDagRunner:
                 nr = self._execute_and_publish(
                     store, plan, node_extras, publish_lock
                 )
+            except _faults.SimulatedCrash as crash:
+                # Forward the injected orchestrator death to the scheduler
+                # thread, which re-raises it (the whole process "dies").
+                done_q.put(crash)
+                return
             except Exception:
                 # Runner-internal failure: settle the node as FAILED instead
                 # of deadlocking the scheduler on a completion that never
@@ -551,11 +844,17 @@ class LocalDagRunner:
                     node = by_id[nid]
                     if any(u not in settled for u in node.upstream):
                         continue
-                    nr = self._control_outcome(
-                        store, node, selected, produced, failed_upstream,
-                        cond_skipped, runtime_parameters, pipeline_ctx,
-                        run_ctx,
-                    )
+                    try:
+                        nr = self._control_outcome(
+                            store, node, selected, produced, failed_upstream,
+                            cond_skipped, runtime_parameters, pipeline_ctx,
+                            run_ctx,
+                        )
+                    except StoreUnavailableError as e:
+                        nr = NodeResult(
+                            node_id=nid, status="FAILED",
+                            error=f"metadata store unavailable: {e}",
+                        )
                     if nr is not None:
                         self._settle(
                             nr, produced, failed_upstream, cond_skipped,
@@ -569,11 +868,20 @@ class LocalDagRunner:
                         continue  # no slot; later control-only nodes may settle
                     if node.resource_class == "tpu" and tpu_in_flight:
                         continue  # chip busy; host nodes may still dispatch
-                    prepared = self._prepare_node(
-                        store, ir, node, executors[nid], produced,
-                        runtime_parameters, [pipeline_ctx, run_ctx],
-                        enable_cache, publish_lock,
-                    )
+                    try:
+                        prepared = self._prepare_node(
+                            store, ir, node, executors[nid], produced,
+                            runtime_parameters, [pipeline_ctx, run_ctx],
+                            enable_cache, publish_lock,
+                        )
+                    except StoreUnavailableError as e:
+                        # Driver-phase store write failed (cache publish,
+                        # RUNNING registration): a node failure, not a
+                        # run crash.
+                        prepared = NodeResult(
+                            node_id=nid, status="FAILED",
+                            error=f"metadata store unavailable: {e}",
+                        )
                     unprocessed.remove(nid)
                     progressed = True
                     if isinstance(prepared, NodeResult):
@@ -586,9 +894,19 @@ class LocalDagRunner:
                         settled.add(nid)
                         continue
                     in_flight.add(nid)
+                    in_flight_plans[nid] = prepared
+                    if prepared.deadline_s > 0:
+                        deadlines[nid] = (
+                            time.monotonic() + prepared.deadline_s
+                        )
                     if node.resource_class == "tpu":
                         tpu_in_flight = nid
-                    pool.submit(worker, prepared, dict(extras or {}))
+                    node_extras = dict(extras or {})
+                    # Cooperative cancellation handle: set on deadline
+                    # expiry and at drain, so well-behaved long-runners
+                    # (and the fault harness's injected hangs) can abort.
+                    node_extras["cancel_event"] = prepared.cancel
+                    pool.submit(worker, prepared, node_extras)
                 if progressed:
                     continue
                 if not in_flight:
@@ -597,8 +915,48 @@ class LocalDagRunner:
                     raise RuntimeError(
                         f"scheduler stalled with pending nodes {unprocessed}"
                     )
-                nr = done_q.get()
+                # Watchdog wait: block until a completion arrives or the
+                # nearest in-flight deadline expires.
+                wait_s = None
+                if deadlines:
+                    wait_s = max(
+                        0.0, min(deadlines.values()) - time.monotonic()
+                    )
+                try:
+                    item = done_q.get(timeout=wait_s)
+                except queue_mod.Empty:
+                    now = time.monotonic()
+                    for nid in [
+                        n for n, d in deadlines.items() if d <= now
+                    ]:
+                        expired = self._expire_deadline(
+                            store, in_flight_plans[nid], publish_lock
+                        )
+                        deadlines.pop(nid)
+                        if expired is None:
+                            continue  # published concurrently: result coming
+                        in_flight.discard(nid)
+                        in_flight_plans.pop(nid)
+                        zombies.add(nid)
+                        if tpu_in_flight == nid:
+                            tpu_in_flight = None  # release the chip gate
+                        self._settle(
+                            expired, produced, failed_upstream,
+                            cond_skipped, result,
+                        )
+                        settled.add(nid)
+                    continue
+                if isinstance(item, BaseException):
+                    raise item  # forwarded SimulatedCrash
+                nr = item
+                if nr.node_id in zombies:
+                    # The timed-out worker finally returned (its publish was
+                    # fenced); the node is already settled FAILED(timeout).
+                    zombies.discard(nr.node_id)
+                    continue
                 in_flight.discard(nr.node_id)
+                in_flight_plans.pop(nr.node_id, None)
+                deadlines.pop(nr.node_id, None)
                 if tpu_in_flight == nr.node_id:
                     tpu_in_flight = None
                 self._settle(
@@ -606,7 +964,72 @@ class LocalDagRunner:
                 )
                 settled.add(nr.node_id)
         finally:
-            pool.shutdown(wait=True)
+            # Release every cooperative hang, give timed-out workers a short
+            # grace to drain, then shut down — without blocking forever on a
+            # genuinely wedged thread (it holds no locks and its publish is
+            # fenced, so abandoning it is safe).
+            for plan in in_flight_plans.values():
+                plan.cancel.set()
+            deadline = time.monotonic() + 2.0
+            while zombies and time.monotonic() < deadline:
+                try:
+                    item = done_q.get(timeout=0.1)
+                except queue_mod.Empty:
+                    continue
+                if isinstance(item, NodeResult):
+                    zombies.discard(item.node_id)
+            pool.shutdown(wait=not zombies)
+
+    def _expire_deadline(
+        self,
+        store: MetadataStore,
+        plan: _LaunchPlan,
+        publish_lock: threading.Lock,
+    ) -> Optional[NodeResult]:
+        """Watchdog expiry for one in-flight node: fence the worker's future
+        publish, record the FAILED(timeout) execution, and release the
+        (cooperative) executor via the cancel event.  Returns None when the
+        worker's publish already won the race (its completion is in flight
+        on the done queue), else the timeout NodeResult to settle.
+
+        A deadline expiry is terminal: the hung attempt cannot be reaped, so
+        a clean-slate retry would race its writes — the timeout consumes
+        whatever retry budget the node had left.
+        """
+        node, ex = plan.node, plan.execution
+        with publish_lock:
+            if plan.published.is_set():
+                return None
+            plan.fenced.set()
+            plan.cancel.set()
+            wall = time.time() - plan.t0
+            error = (
+                f"execution timeout: node {node.id!r} exceeded its "
+                f"{plan.deadline_s:g}s deadline"
+            )
+            ex.state = ExecutionState.FAILED
+            ex.properties.update({
+                "wall_clock_s": round(wall, 4),
+                "timeout": True,
+                "error": error,
+            })
+            try:
+                # Outputs publish as ABANDONED at their allocated URIs; the
+                # wedged executor may still be writing under them, which is
+                # why they are never adopted or cached.
+                store.publish_execution(
+                    ex, plan.inputs, plan.outputs, plan.all_ctx
+                )
+            except StoreUnavailableError as e:
+                log.error(
+                    "node %s: metadata store unavailable while recording "
+                    "timeout: %s", node.id, e,
+                )
+        log.warning("node %s: %s", node.id, error)
+        return NodeResult(
+            node_id=node.id, status="FAILED", execution_id=ex.id,
+            error=error, wall_clock_s=wall,
+        )
 
     @staticmethod
     def _select_nodes(
@@ -748,6 +1171,9 @@ class LocalDagRunner:
         Always runs in the scheduling thread, so execution ids (and the
         output URIs embedding them) are assigned in dispatch order."""
         t0 = time.time()
+        # Fault hook: kill-orchestrator-at-node-N fires here, in the
+        # scheduler thread, before any state for this node is registered.
+        _faults.at_dispatch(node.id)
         node_ctx = Context("node", f"{ir.name}.{node.id}")
         with _maybe_locked(publish_lock):
             store.put_context(node_ctx)
@@ -863,6 +1289,13 @@ class LocalDagRunner:
         )
         with _maybe_locked(publish_lock):
             store.put_execution(ex)
+            # Associate the RUNNING record with its contexts NOW, not only
+            # at publish: if the orchestrator dies mid-execution, the
+            # resume's stale-execution sweep finds the orphan by run
+            # context.  publish_execution re-associates (INSERT OR IGNORE),
+            # so the final row set is unchanged.
+            for ctx in all_ctx:
+                store.associate(ctx.id, ex.id)
 
         # Output URIs embed the execution id; under spmd_sync process 0's id
         # is authoritative so all processes write one shared directory tree.
@@ -889,6 +1322,7 @@ class LocalDagRunner:
             node=node, component=component, inputs=inputs, props=props,
             external_fps=external_fps, execution=ex, outputs=outputs,
             all_ctx=all_ctx, t0=t0,
+            deadline_s=self._node_timeout_s(node, ir),
         )
 
     def _execute_and_publish(
@@ -905,6 +1339,10 @@ class LocalDagRunner:
         node, ex = plan.node, plan.execution
         inputs, props, outputs = plan.inputs, plan.props, plan.outputs
         external_fps, all_ctx, t0 = plan.external_fps, plan.all_ctx, plan.t0
+        extras = dict(extras)
+        # Cooperative cancellation: the watchdog (and drain) set this event;
+        # long-running executors may poll it to abort early.
+        extras.setdefault("cancel_event", plan.cancel)
 
         error = ""
         extra_props: Dict[str, Any] = {}
@@ -941,6 +1379,8 @@ class LocalDagRunner:
                         tmp_dir=tmp,
                         extras=extras,
                     )
+                    # Fault hook: raise-in-executor / cooperative hang.
+                    _faults.in_executor(node.id, plan.cancel)
                     ret = executor(ctx)
                     extra_props = dict(ret or {})
                     error = ""
@@ -997,12 +1437,16 @@ class LocalDagRunner:
                     a.uri = allocated_uris[id(a)]
             ex.state = ExecutionState.FAILED
             ex.properties["error"] = error.splitlines()[-1] if error else ""
-            with _maybe_locked(publish_lock):
-                store.publish_execution(ex, inputs, outputs, all_ctx)
+            publish_err = self._publish_fenced(store, plan, publish_lock)
+            if publish_err:
+                error = f"{error}\n{publish_err}"
             return NodeResult(
                 node_id=node.id, status="FAILED", execution_id=ex.id,
                 error=error, wall_clock_s=wall, retries=attempts - 1,
             )
+        # Fault hook: crash-after-success-before-publish (the state a resume
+        # must fence: RUNNING execution + written payload dirs, no events).
+        _faults.before_publish(node.id)
         for arts in outputs.values():
             for a in arts:
                 a.fingerprint = (
@@ -1010,8 +1454,25 @@ class LocalDagRunner:
                     or fingerprint_dir(a.uri)
                 )
         ex.state = ExecutionState.COMPLETE
-        with _maybe_locked(publish_lock):
-            store.publish_execution(ex, inputs, outputs, all_ctx)
+        publish_err = self._publish_fenced(store, plan, publish_lock)
+        if publish_err is not None:
+            # Store backend died under the publish: the run must record a
+            # node failure, not crash (the payload is on disk but without a
+            # COMPLETE record it is invisible — a resume re-runs the node).
+            return NodeResult(
+                node_id=node.id, status="FAILED", execution_id=ex.id,
+                error=publish_err, wall_clock_s=wall, retries=attempts - 1,
+            )
+        if plan.fenced.is_set():
+            # The watchdog expired this node while the executor was
+            # finishing: the scheduler already settled FAILED(timeout) and
+            # published; this result is discarded as a zombie.
+            return NodeResult(
+                node_id=node.id, status="FAILED", execution_id=ex.id,
+                error="fenced by deadline watchdog", wall_clock_s=wall,
+            )
+        # Fault hook: crash-right-after-publish (the state a resume adopts).
+        _faults.after_publish(node.id)
         log.info(
             "node %s: COMPLETE in %.2fs (execution %d)", node.id, wall, ex.id
         )
@@ -1019,6 +1480,34 @@ class LocalDagRunner:
             node_id=node.id, status="COMPLETE", execution_id=ex.id,
             outputs=outputs, wall_clock_s=wall, retries=attempts - 1,
         )
+
+    @staticmethod
+    def _publish_fenced(
+        store: MetadataStore,
+        plan: _LaunchPlan,
+        publish_lock: Optional[threading.Lock],
+    ) -> Optional[str]:
+        """Publish the plan's execution unless the deadline watchdog fenced
+        it first.  The fenced/published handshake runs under the publish
+        lock, so exactly one of {worker publish, watchdog FAILED(timeout)
+        publish} reaches the store.  Returns an error string when the store
+        backend is unavailable (the caller records a node failure), else
+        None."""
+        try:
+            with _maybe_locked(publish_lock):
+                if plan.fenced.is_set():
+                    return None  # watchdog already published FAILED(timeout)
+                plan.published.set()
+                store.publish_execution(
+                    plan.execution, plan.inputs, plan.outputs, plan.all_ctx
+                )
+        except StoreUnavailableError as e:
+            log.error(
+                "node %s: metadata store unavailable during publish: %s",
+                plan.node.id, e,
+            )
+            return f"metadata store unavailable during publish: {e}"
+        return None
 
     def _run_resolver_node(
         self,

@@ -1,0 +1,11 @@
+"""Test-only harnesses: fault injection for crash-safety verification.
+
+Nothing here runs in a production pipeline unless explicitly activated;
+the runner's hook calls are no-ops while no plan is installed.
+"""
+
+from tpu_pipelines.testing.faults import (  # noqa: F401
+    FaultPlan,
+    NodeFault,
+    SimulatedCrash,
+)

@@ -1,0 +1,200 @@
+"""Fault-injection harness: prove the runner's failure semantics.
+
+PR 1 *claims* fail-fast drain, no orphans, clean retry slates; the resume
+layer claims crash-safe adoption and fencing.  This module makes those
+claims testable by injecting the exact failure modes a preemptible TPU
+fleet produces, at the exact runner phase where they occur:
+
+  ==================== =====================================================
+  kind                 fires at
+  ==================== =====================================================
+  RAISE                inside the executor attempt (transient executor bug)
+  HANG                 inside the executor attempt; blocks on the runner's
+                       cancel event (stuck ``urlopen``, deadlocked
+                       collective) — released by the deadline watchdog, so
+                       a hang test leaves no orphan thread behind
+  CRASH_BEFORE_PUBLISH after the executor succeeded, before the publisher's
+                       store write (RUNNING execution + written payload
+                       dirs left behind — the state a resume must fence)
+  CRASH_AFTER_PUBLISH  right after the COMPLETE publish landed (the state a
+                       resume must adopt as-is)
+  KILL_ORCHESTRATOR    at node dispatch, in the scheduler thread (pod
+                       eviction / OOM / Ctrl-C mid-run)
+  ==================== =====================================================
+
+The crash kinds raise :class:`SimulatedCrash` — a ``BaseException`` so no
+``except Exception`` along the way can swallow it, mimicking a process
+death: the metadata store is left exactly as a SIGKILL would leave it
+(committed rows only, nothing finalized).  Each fault fires ONCE per plan,
+so the node runs clean on resume.
+
+Usage::
+
+    plan = FaultPlan({"Trainer": NodeFault(CRASH_BEFORE_PUBLISH)})
+    with plan.activate():
+        with pytest.raises(SimulatedCrash):
+            LocalDagRunner().run(pipeline)
+    LocalDagRunner().run(pipeline, resume_from="latest")
+
+The runner's hook calls cost one module-global read when no plan is
+active; production runs never pay more than that.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+RAISE = "raise"
+HANG = "hang"
+CRASH_BEFORE_PUBLISH = "crash_before_publish"
+CRASH_AFTER_PUBLISH = "crash_after_publish"
+KILL_ORCHESTRATOR = "kill_orchestrator"
+
+# kind -> the runner phase whose hook triggers it.
+_KIND_TO_POINT = {
+    RAISE: "in_executor",
+    HANG: "in_executor",
+    CRASH_BEFORE_PUBLISH: "before_publish",
+    CRASH_AFTER_PUBLISH: "after_publish",
+    KILL_ORCHESTRATOR: "at_dispatch",
+}
+
+
+class SimulatedCrash(BaseException):
+    """Stand-in for orchestrator/process death at a precise runner phase.
+
+    BaseException on purpose: a real SIGKILL is not catchable, so no
+    ``except Exception`` in an executor, worker, or retry loop may
+    convert this into an ordinary node failure.
+    """
+
+    def __init__(self, node_id: str, point: str):
+        super().__init__(f"simulated crash at {point} of node {node_id!r}")
+        self.node_id = node_id
+        self.point = point
+
+
+class InjectedFault(RuntimeError):
+    """The exception RAISE/HANG faults surface inside the executor."""
+
+
+@dataclasses.dataclass
+class NodeFault:
+    kind: str
+    message: str = "injected fault"
+    # HANG safety ceiling: the hang waits on the runner's cancel event and
+    # gives up after this long regardless, so a missing/misconfigured
+    # watchdog can never wedge a test run forever.
+    max_hang_s: float = 60.0
+
+    def __post_init__(self):
+        if self.kind not in _KIND_TO_POINT:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; "
+                f"expected one of {sorted(_KIND_TO_POINT)}"
+            )
+
+
+class FaultPlan:
+    """Per-node faults, each fired at most once (so resumed runs succeed).
+
+    ``log`` records ``(node_id, event)`` tuples — tests assert on it to
+    prove e.g. that a hang was released by the watchdog's cancel event
+    rather than by its own safety ceiling (no orphan threads).
+    """
+
+    def __init__(self, faults: Dict[str, NodeFault]):
+        self.faults = dict(faults)
+        self._fired: set = set()
+        self._lock = threading.Lock()
+        self.log: List[Tuple[str, str]] = []
+
+    def _take(self, node_id: str, point: str) -> Optional[NodeFault]:
+        fault = self.faults.get(node_id)
+        if fault is None or _KIND_TO_POINT[fault.kind] != point:
+            return None
+        with self._lock:
+            if node_id in self._fired:
+                return None
+            self._fired.add(node_id)
+        return fault
+
+    def record(self, node_id: str, event: str) -> None:
+        with self._lock:
+            self.log.append((node_id, event))
+
+    @contextmanager
+    def activate(self):
+        """Install this plan for the duration of the block (test-only)."""
+        global _ACTIVE
+        prev = _ACTIVE
+        _ACTIVE = self
+        try:
+            yield self
+        finally:
+            _ACTIVE = prev
+
+
+_ACTIVE: Optional[FaultPlan] = None
+
+
+# ------------------------------------------------------------ runner hooks
+
+
+def at_dispatch(node_id: str) -> None:
+    """Scheduler thread, before the node's driver phase runs."""
+    plan = _ACTIVE
+    if plan is None:
+        return
+    fault = plan._take(node_id, "at_dispatch")
+    if fault is not None:
+        plan.record(node_id, "kill_orchestrator")
+        raise SimulatedCrash(node_id, "at_dispatch")
+
+
+def in_executor(
+    node_id: str, cancel_event: Optional[threading.Event]
+) -> None:
+    """Worker thread, inside the executor attempt (before the real fn)."""
+    plan = _ACTIVE
+    if plan is None:
+        return
+    fault = plan._take(node_id, "in_executor")
+    if fault is None:
+        return
+    if fault.kind == RAISE:
+        plan.record(node_id, "raise")
+        raise InjectedFault(fault.message)
+    # HANG: cooperative stuck-executor — parks until the deadline
+    # watchdog's cancel event (or the safety ceiling) releases it.
+    plan.record(node_id, "hang_start")
+    event = cancel_event or threading.Event()
+    released = event.wait(fault.max_hang_s)
+    plan.record(node_id, "hang_released" if released else "hang_ceiling")
+    raise InjectedFault(
+        f"{fault.message} (hang "
+        f"{'cancelled by watchdog' if released else 'hit safety ceiling'})"
+    )
+
+
+def before_publish(node_id: str) -> None:
+    """Worker thread, executor succeeded, publisher not yet written."""
+    plan = _ACTIVE
+    if plan is None:
+        return
+    if plan._take(node_id, "before_publish") is not None:
+        plan.record(node_id, "crash_before_publish")
+        raise SimulatedCrash(node_id, "before_publish")
+
+
+def after_publish(node_id: str) -> None:
+    """Worker thread, COMPLETE publish committed."""
+    plan = _ACTIVE
+    if plan is None:
+        return
+    if plan._take(node_id, "after_publish") is not None:
+        plan.record(node_id, "crash_after_publish")
+        raise SimulatedCrash(node_id, "after_publish")
