@@ -104,6 +104,26 @@ def test_bench_smoke_emits_compact_stdout_and_full_report():
     assert conc["default_policy"] == "n_dag_roots"
     assert conc["e2e_sched_leg_workers"] == sched[
         "max_parallel_nodes"]["concurrent"]
+    # The sharded-data-plane leg: both modes green, identity checks hold
+    # (row multisets + statistics — the shard-count-invariance contract),
+    # walls measured, config block present.  (The >= 1.3x speedup is a
+    # multicore-host claim, asserted by inspection on the driver's bench;
+    # a 1-cpu CI box can only show parity.)
+    dp = report["data_plane"]["taxi_shards"]
+    assert dp["green"] is True, dp
+    assert dp["rows_identical"] is True
+    assert dp["stats_identical"] is True
+    assert dp["transform_rows_identical"] is True
+    assert dp["single_ingest_stats_s"] > 0
+    assert dp["sharded_ingest_stats_s"] > 0
+    assert dp["shards"] >= 4
+    assert all(n == dp["shards"] for n in dp["shard_layout"].values())
+    assert dp["host_cpus"] >= 1
+    dp_conf = report["data_plane"]["config"]
+    assert dp_conf["bench_leg_shards"] == dp["shards"]
+    assert "TPP_DATA_SHARDS" in dp_conf["default_shard_policy"]
+    # And the compact line carries the data-plane verdict.
+    assert compact["data_plane_green"] is True
     # The A100 comparison point is pinned with provenance (auditable ratio).
     ref = report["a100_reference"]
     assert ref["ex_per_sec"] > 0
@@ -129,3 +149,5 @@ def test_bench_budget_skips_but_emits():
     assert report["taxi"]["skipped_budget"] is True
     assert report["bert"]["skipped_budget"] is True
     assert report["pipeline_e2e"]["bert"]["skipped_budget"] is True
+    assert report["data_plane"]["skipped_budget"] is True
+    assert "data_plane" in compact["skipped"]

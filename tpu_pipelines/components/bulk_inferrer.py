@@ -15,11 +15,36 @@ import logging
 import numpy as np
 
 from tpu_pipelines.data import examples_io
-from tpu_pipelines.data.input_pipeline import BatchIterator, InputConfig
+from tpu_pipelines.data.shard_plan import thread_map
 from tpu_pipelines.dsl.component import Parameter, component
-from tpu_pipelines.trainer.export import load_exported_model
+from tpu_pipelines.trainer.export import (
+    load_exported_model,
+    model_input_columns,
+)
 
 PREDICTIONS_FILE = "predictions"
+
+
+def _shard_batches(uri, split, shard, batch_size, columns):
+    """Fixed-size dict-of-numpy batches over one shard, order preserved,
+    remainder kept (the shuffle-free single-epoch read BulkInferrer needs,
+    without materializing the shard)."""
+    pending = None
+    for chunk in examples_io.iter_column_chunks(
+        uri, split, columns=columns, shards=[shard]
+    ):
+        pending = chunk if pending is None else {
+            k: np.concatenate([pending[k], chunk[k]]) for k in pending
+        }
+        n = len(next(iter(pending.values())))
+        start = 0
+        while n - start >= batch_size:
+            yield {k: v[start:start + batch_size] for k, v in pending.items()}
+            start += batch_size
+        if start:
+            pending = {k: v[start:] for k, v in pending.items()}
+    if pending is not None and len(next(iter(pending.values()))):
+        yield pending
 
 
 @component(
@@ -90,21 +115,31 @@ def BulkInferrer(ctx):
     passthrough = ctx.exec_properties["passthrough_columns"] or []
     batch_size = ctx.exec_properties["batch_size"]
 
-    total = 0
-    written_splits = set(splits)
-    for split in splits:
-        it = BatchIterator(
-            examples_uri, split,
-            InputConfig(batch_size=batch_size, shuffle=False, num_epochs=1,
-                        drop_remainder=False),
-        )
-        # Stream: each batch is predicted and appended to the split's Parquet
-        # writer immediately, so output memory is O(batch), never O(split) —
-        # the Beam-job scaling the reference's BulkInferrer had.
+    # Column projection: decode only what the predict path + passthrough
+    # actually consume (None = unknown model surface, read everything).
+    columns = model_input_columns(
+        loaded, raw=(
+            method == "generate" or ctx.exec_properties["raw_examples"]
+        ),
+    )
+    if columns is not None:
+        columns = sorted(set(columns) | set(passthrough))
+
+    def infer_shard(task):
+        """One shard in, one predictions shard out.  Each batch is predicted
+        and appended to this shard's Parquet writer immediately, so output
+        memory is O(batch), never O(split) — the Beam-job scaling the
+        reference's BulkInferrer had; shards fan out across threads (the
+        jitted predict serializes on-device, but host decode/encode of
+        shard i+1 overlaps the predict of shard i)."""
+        split, shard, n_shards = task
         writer = None
-        n_split = 0
+        schema = None
+        n_preds = 0
         try:
-            for batch in it:
+            for batch in _shard_batches(
+                examples_uri, split, shard, batch_size, columns
+            ):
                 preds = np.asarray(predict(batch))
                 cols = {}
                 for c in passthrough:
@@ -119,15 +154,28 @@ def BulkInferrer(ctx):
                     cols["prediction"] = preds.reshape(len(preds), -1)
                 table = examples_io.table_from_columns(cols)
                 if writer is None:
+                    schema = table.schema
                     writer = examples_io.open_split_writer(
-                        out.uri, split, table.schema
+                        out.uri, split, schema,
+                        shard=shard, num_shards=n_shards,
                     )
                 writer.write_table(table)
-                n_split += len(preds)
+                n_preds += len(preds)
         finally:
             if writer is not None:
                 writer.close()
-        if writer is None:
+        return n_preds, schema
+
+    total = 0
+    written_splits = set(splits)
+    for split in splits:
+        n_shards = examples_io.num_split_shards(examples_uri, split)
+        results = thread_map(
+            infer_shard,
+            [(split, shard, n_shards) for shard in range(n_shards)],
+        )
+        schemas = [s for _, s in results if s is not None]
+        if not schemas:
             # Zero batches (hash-split left this split empty): no file was
             # written, so drop the split from the artifact's listing rather
             # than publishing a split name downstream reads would 404 on.
@@ -135,7 +183,14 @@ def BulkInferrer(ctx):
                 "BulkInferrer: split %r empty; omitted from output", split
             )
             written_splits.discard(split)
-        total += n_split
+        else:
+            for shard, (n, schema) in enumerate(results):
+                if schema is None:  # backfill: complete shard set
+                    examples_io.open_split_writer(
+                        out.uri, split, schemas[0],
+                        shard=shard, num_shards=n_shards,
+                    ).close()
+        total += sum(n for n, _ in results)
     out.properties["num_predictions"] = total
     out.properties["split_names"] = sorted(written_splits)
-    return {"num_predictions": total}
+    return {"num_predictions": total, "projected_columns": columns}

@@ -20,7 +20,10 @@ from tpu_pipelines.evaluation.metrics import (
     check_thresholds,
     evaluate_model,
 )
-from tpu_pipelines.trainer.export import load_exported_model
+from tpu_pipelines.trainer.export import (
+    load_exported_model,
+    model_input_columns,
+)
 
 BLESSING_FILE = "BLESSED"
 NOT_BLESSED_FILE = "NOT_BLESSED"
@@ -28,6 +31,16 @@ NOT_BLESSED_FILE = "NOT_BLESSED"
 
 def _evaluate(model_uri: str, examples_uri: str, props: Dict) -> EvalOutcome:
     loaded = load_exported_model(model_uri)
+    # Column projection: the model's transformed-feature surface plus the
+    # label and slice columns — Parquet never decodes the rest.  None (no
+    # transform graph in the payload) = unknown surface, read everything.
+    columns = model_input_columns(loaded, raw=False)
+    if columns is not None:
+        columns = sorted(
+            set(columns)
+            | {props["label_key"]}
+            | set(props["slice_columns"] or ())
+        )
     batches = BatchIterator(
         examples_uri,
         props["eval_split"],
@@ -35,6 +48,7 @@ def _evaluate(model_uri: str, examples_uri: str, props: Dict) -> EvalOutcome:
             batch_size=props["batch_size"], shuffle=False, num_epochs=1,
             drop_remainder=False,
         ),
+        columns=columns,
     )
     return evaluate_model(
         # Eval data is transformed examples; the payload's transform was

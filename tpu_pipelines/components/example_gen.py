@@ -11,6 +11,8 @@ is vectorized over a string join of the row.
 from __future__ import annotations
 
 import os
+import queue
+import threading
 import time
 from typing import Dict
 
@@ -20,6 +22,7 @@ import pyarrow.compute as pc
 import pyarrow.csv as pacsv
 
 from tpu_pipelines.data import examples_io
+from tpu_pipelines.data.shard_plan import ShardPlan
 from tpu_pipelines.dsl.component import Parameter, component
 from tpu_pipelines.utils.hashing import hash_buckets
 
@@ -46,7 +49,9 @@ def _row_hash_buckets(table: pa.Table, num_buckets: int) -> np.ndarray:
     )
 
 
-def _split_and_write(table: pa.Table, uri: str, splits: Dict[str, int]) -> Dict[str, int]:
+def _split_and_write(
+    table: pa.Table, uri: str, splits: Dict[str, int], num_shards: int = 1
+) -> Dict[str, int]:
     total = sum(splits.values())
     buckets = _row_hash_buckets(table, total)
     counts: Dict[str, int] = {}
@@ -55,32 +60,40 @@ def _split_and_write(table: pa.Table, uri: str, splits: Dict[str, int]) -> Dict[
         hi = lo + weight
         mask = (buckets >= lo) & (buckets < hi)
         sub = table.filter(pa.array(mask))
-        examples_io.write_split(uri, split, sub)
+        # Native layout always (data-%05d-of-N); shard writes parallelize
+        # inside write_split.  Split membership is the per-row hash above —
+        # identical for every num_shards.
+        examples_io.write_split(uri, split, sub, num_shards=num_shards)
         counts[split] = sub.num_rows
         lo = hi
     return counts
 
 
-def _split_and_write_streaming(
-    batches, uri: str, splits: Dict[str, int], schema: pa.Schema
-) -> Dict[str, int]:
-    """Hash-split a stream of record batches into per-split Parquet writers.
-
-    The out-of-core ingest path (the Beam-pipeline equivalent of SURVEY.md
-    §2a ExampleGen): peak memory is O(read block), never O(file).  Row-hash
-    bucketing is per-row content, so streaming and whole-table ingest assign
-    every row to the identical split.  Every split's writer opens upfront
-    from ``schema``, so empty splits still materialize (as empty Parquet),
-    exactly like the whole-table path.
-    """
+def _shard_worker(
+    w: int,
+    q: "queue.Queue",
+    uri: str,
+    splits: Dict[str, int],
+    schema: pa.Schema,
+    num_shards: int,
+    counts: Dict[str, int],
+    lock: "threading.Lock",
+) -> None:
+    """One ingest worker = one shard of every split: hash, filter, encode,
+    write — the per-shard pipeline that makes streaming ingest scale with
+    cores (hashing and Parquet encode release the GIL)."""
     total = sum(splits.values())
-    counts: Dict[str, int] = {s: 0 for s in splits}
     writers = {
-        split: examples_io.open_split_writer(uri, split, schema)
+        split: examples_io.open_split_writer(
+            uri, split, schema, shard=w, num_shards=num_shards
+        )
         for split in splits
     }
     try:
-        for batch in batches:
+        while True:
+            batch = q.get()
+            if batch is None:
+                return
             table = pa.Table.from_batches([batch])
             buckets = _row_hash_buckets(table, total)
             lo = 0
@@ -93,10 +106,67 @@ def _split_and_write_streaming(
                     writers[split].write_table(
                         sub, row_group_size=examples_io.DEFAULT_ROW_GROUP
                     )
-                counts[split] += sub.num_rows
+                with lock:
+                    counts[split] += sub.num_rows
     finally:
-        for w in writers.values():
-            w.close()
+        for wr in writers.values():
+            wr.close()
+
+
+def _split_and_write_streaming(
+    batches, uri: str, splits: Dict[str, int], schema: pa.Schema,
+    num_shards: int = 1,
+) -> Dict[str, int]:
+    """Hash-split a stream of record batches into per-split Parquet shards.
+
+    The out-of-core ingest path (the Beam-pipeline equivalent of SURVEY.md
+    §2a ExampleGen): peak memory is O(read block * num_shards), never
+    O(file).  Row-hash bucketing is per-row content, so streaming,
+    whole-table, and any-shard-count ingest assign every row to the
+    identical split; what ``num_shards`` changes is only how split rows
+    spread across shard files (read blocks round-robin to workers, each
+    worker owning one shard of every split).  Every writer opens upfront
+    from ``schema``, so empty splits/shards still materialize, exactly like
+    the whole-table path.
+    """
+    counts: Dict[str, int] = {s: 0 for s in splits}
+    lock = threading.Lock()
+    # Bounded per-worker queues keep memory at O(read block) per worker
+    # while letting the reader run ahead of slow encoders.
+    queues: list = [queue.Queue(maxsize=4) for _ in range(num_shards)]
+    errors: list = []
+
+    def run_worker(w: int) -> None:
+        try:
+            _shard_worker(
+                w, queues[w], uri, splits, schema, num_shards, counts, lock
+            )
+        except BaseException as e:  # noqa: BLE001 — re-raised in the reader
+            errors.append(e)
+            # Keep draining so the reader's bounded put never deadlocks
+            # against a dead worker.
+            while queues[w].get() is not None:
+                pass
+
+    workers = [
+        threading.Thread(
+            target=run_worker, args=(w,),
+            name=f"tpp-ingest-shard-{w}", daemon=True,
+        )
+        for w in range(num_shards)
+    ]
+    for t in workers:
+        t.start()
+    try:
+        for i, batch in enumerate(batches):
+            queues[i % num_shards].put(batch)
+    finally:
+        for wq in queues:
+            wq.put(None)
+        for t in workers:
+            t.join()
+    if errors:
+        raise errors[0]
     return counts
 
 
@@ -130,6 +200,11 @@ def _convert_options(column_types):
         # span invalidates the execution cache.
         "span": Parameter(type=int, default=None),
         "version": Parameter(type=int, default=None),
+        # Shard files per split (examples_io native layout).  None follows
+        # the ShardPlan precedence: TPP_DATA_SHARDS env, else host_cpus.
+        # Split membership is per-row content hash, so it is byte-identical
+        # at every shard count — only the file spread changes.
+        "num_shards": Parameter(type=int, default=None),
     },
     external_input_parameters=("input_path",),
 )
@@ -147,6 +222,7 @@ def CsvExampleGen(ctx):
         )
     splits = ctx.exec_properties["splits"] or dict(DEFAULT_SPLITS)
     threshold = ctx.exec_properties["streaming_threshold_bytes"]
+    plan = ShardPlan.resolve(ctx.exec_properties.get("num_shards"))
     convert = _convert_options(ctx.exec_properties["column_types"])
     if os.path.isdir(path):
         files = sorted(
@@ -171,7 +247,8 @@ def CsvExampleGen(ctx):
 
         try:
             counts = _split_and_write_streaming(
-                batches(), out.uri, splits, first.schema
+                batches(), out.uri, splits, first.schema,
+                num_shards=plan.num_shards,
             )
         except (pa.ArrowInvalid, pa.ArrowTypeError) as e:
             # The streaming reader infers each column's type from its FIRST
@@ -191,9 +268,12 @@ def CsvExampleGen(ctx):
         table = pa.concat_tables([
             pacsv.read_csv(f, convert_options=convert) for f in files
         ])
-        counts = _split_and_write(table, out.uri, splits)
+        counts = _split_and_write(
+            table, out.uri, splits, num_shards=plan.num_shards
+        )
     out.properties["split_names"] = sorted(counts)
     out.properties["split_counts"] = counts
+    out.properties["num_shards"] = plan.num_shards
     if span is not None:
         out.properties["span"] = span
     if version is not None:
@@ -204,6 +284,8 @@ def CsvExampleGen(ctx):
         "num_examples": n,
         # Observability parity with the per-stage counters Beam jobs expose.
         "ingest_rows_per_sec": round(n / elapsed, 1),
+        "data_shards": plan.num_shards,
+        "shard_plan_source": plan.source,
         **{f"rows_{k}": v for k, v in counts.items()},
     }
     if span is not None:
@@ -226,7 +308,8 @@ def _record_reader(path: str, verify_crc: bool = True):
 
 def _import_record_files(files, out_uri: str, splits: Dict[str, int],
                          per_split: bool,
-                         verify_crc: bool = True) -> Dict[str, int]:
+                         verify_crc: bool = True,
+                         num_shards: int = 1) -> Dict[str, int]:
     """tf.train.Example record files → Parquet splits, O(chunk) memory.
 
     ``per_split=True``: each file IS a split (``<split>.tfrecord``).
@@ -279,7 +362,9 @@ def _import_record_files(files, out_uri: str, splits: Dict[str, int],
         yield first
         yield from it
 
-    return _split_and_write_streaming(chained(), out_uri, splits, first.schema)
+    return _split_and_write_streaming(
+        chained(), out_uri, splits, first.schema, num_shards=num_shards
+    )
 
 
 @component(
@@ -295,6 +380,10 @@ def _import_record_files(files, out_uri: str, splits: Dict[str, int],
         # False = trusted-source opt-out, also the escape hatch for
         # third-party writers that zero or mis-mask the crc fields.
         "verify_record_crc": Parameter(type=bool, default=True),
+        # Shard files per split for the hash-split paths (ShardPlan
+        # precedence, see CsvExampleGen).  The split-per-file import paths
+        # keep one file per split: the import IS the layout there.
+        "num_shards": Parameter(type=int, default=None),
     },
     external_input_parameters=("input_path",),
 )
@@ -312,6 +401,7 @@ def ImportExampleGen(ctx):
     """
     path = ctx.exec_properties["input_path"]
     out = ctx.output("examples")
+    plan = ShardPlan.resolve(ctx.exec_properties.get("num_shards"))
     t0 = time.monotonic()
     counts: Dict[str, int] = {}
     if os.path.isdir(path):
@@ -347,6 +437,7 @@ def ImportExampleGen(ctx):
         counts = _import_record_files(
             [path], out.uri, splits, per_split=False,
             verify_crc=ctx.exec_properties["verify_record_crc"],
+            num_shards=plan.num_shards,
         )
     elif path.endswith(".npz"):
         data = np.load(path)
@@ -361,7 +452,9 @@ def ImportExampleGen(ctx):
                 arrays[name] = pa.array(arr)
         table = pa.table(arrays)
         splits = ctx.exec_properties["splits"] or dict(DEFAULT_SPLITS)
-        counts = _split_and_write(table, out.uri, splits)
+        counts = _split_and_write(
+            table, out.uri, splits, num_shards=plan.num_shards
+        )
     else:
         raise ValueError(f"unsupported import source: {path!r}")
     out.properties["split_names"] = sorted(counts)
@@ -372,4 +465,6 @@ def ImportExampleGen(ctx):
         "ingest_rows_per_sec": round(
             n / max(1e-9, time.monotonic() - t0), 1
         ),
+        "data_shards": plan.num_shards,
+        "shard_plan_source": plan.source,
     }

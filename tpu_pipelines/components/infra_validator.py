@@ -72,8 +72,18 @@ def InfraValidator(ctx):
     error = ""
     latency_p50 = latency_p95 = None
     try:
-        data = examples_io.read_split(ctx.input("examples").uri, split)
-        batch = {k: v[:n] for k, v in data.items()}
+        # First streamed chunk only — the canary needs n rows, not the
+        # split: a full read_split here was O(split) memory and wall for an
+        # 8-row request batch.
+        batch = next(
+            examples_io.iter_column_chunks(
+                ctx.input("examples").uri, split, rows=max(1, n)
+            ),
+            None,
+        )
+        if batch is None:
+            raise ValueError(f"split {split!r} is empty")
+        batch = {k: v[:n] for k, v in batch.items()}
         if ctx.inputs.get("schema"):
             from tpu_pipelines.data.schema import Schema
 

@@ -1,17 +1,29 @@
 """StatisticsGen: full-pass per-split statistics over an Examples artifact.
 
 Capability match for TFX StatisticsGen / TFDV GenerateStatistics (SURVEY.md
-§2a row 2), as vectorized Arrow/numpy reductions instead of Beam.
+§2a row 2), as vectorized Arrow/numpy reductions instead of Beam.  Sharded
+splits (examples_io native layout) accumulate per shard in a process pool
+and merge — the accumulate/merge/extract CombineFn cycle Beam runs across a
+cluster, here across host cores; merged output is identity-equal to the
+single-pass result (exact for counts/min/max/top-k, float-summation-order
+for mean/std, reservoir-exact while the split fits the reservoir).
 """
 
 from __future__ import annotations
 
 from tpu_pipelines.data import examples_io
+from tpu_pipelines.data.shard_plan import ShardPlan, map_shards
 from tpu_pipelines.data.statistics import (
     SplitStatsAccumulator,
+    accumulate_split_shard,
+    merge_accumulators,
     save_statistics,
 )
 from tpu_pipelines.dsl.component import Parameter, component
+
+# Single-pass default (SplitStatsAccumulator) — repeated here so the pool
+# tasks and the sequential path agree without reaching into class defaults.
+_RESERVOIR_SIZE = 1 << 17
 
 
 @component(
@@ -21,6 +33,11 @@ from tpu_pipelines.dsl.component import Parameter, component
         # Rows per streamed chunk; peak host memory is O(chunk + reservoir),
         # never O(split).  0 = the Parquet row-group size.
         "chunk_rows": Parameter(type=int, default=0),
+        # Worker cap for per-shard accumulation (ShardPlan precedence:
+        # this param > TPP_DATA_SHARDS > host_cpus).  Parallelism itself
+        # comes from the artifact's shard layout; a single-file split always
+        # takes the sequential path regardless of this value.
+        "num_shards": Parameter(type=int, default=None),
     },
 )
 def StatisticsGen(ctx):
@@ -31,17 +48,35 @@ def StatisticsGen(ctx):
     chunk_rows = (
         ctx.exec_properties.get("chunk_rows") or examples_io.DEFAULT_ROW_GROUP
     )
+    plan = ShardPlan.resolve(ctx.exec_properties.get("num_shards"))
     stats = {}
+    shard_counts = {}
     for split in splits:
-        acc = SplitStatsAccumulator(split)
-        for table in examples_io.iter_table_chunks(
-            examples.uri, split, rows=chunk_rows
-        ):
-            acc.update(table)
+        n_shards = examples_io.num_split_shards(examples.uri, split)
+        shard_counts[split] = n_shards
+        if n_shards > 1:
+            accs = map_shards(
+                accumulate_split_shard,
+                [
+                    (examples.uri, split, i, chunk_rows, _RESERVOIR_SIZE)
+                    for i in range(n_shards)
+                ],
+                workers=min(plan.num_shards, n_shards),
+            )
+            acc = merge_accumulators(accs)
+        else:
+            acc = SplitStatsAccumulator(split)
+            for table in examples_io.iter_table_chunks(
+                examples.uri, split, rows=chunk_rows
+            ):
+                acc.update(table)
         stats[split] = acc.finalize()
     out = ctx.output("statistics")
     save_statistics(out.uri, stats)
     out.properties["split_names"] = splits
     return {
-        f"num_examples_{s}": stats[s].num_examples for s in splits
+        "data_shards": shard_counts,
+        "shard_workers": plan.num_shards,
+        "shard_plan_source": plan.source,
+        **{f"num_examples_{s}": stats[s].num_examples for s in splits},
     }

@@ -18,6 +18,7 @@ import shutil
 
 from tpu_pipelines.data import examples_io
 from tpu_pipelines.data.schema import Schema
+from tpu_pipelines.data.shard_plan import thread_map
 from tpu_pipelines.dsl.component import Parameter, component
 from tpu_pipelines.transform.expr import OPS
 from tpu_pipelines.transform.graph import TransformGraph
@@ -124,16 +125,16 @@ def Transform(ctx):
                 return cols
         return graph.apply_host(raw)
 
-    counts = {}
-    split_wall = {}
-    t0 = time.perf_counter()
-    for split in splits:
+    def materialize_shard(task):
+        """One shard in, one shard out: apply-fn over the shard's chunks
+        into this shard's writer.  Returns (rows, output schema or None)."""
+        split, shard, n_shards = task
         writer = None
+        schema = None
         n_rows = 0
-        t_split = time.perf_counter()
         try:
             for raw in examples_io.iter_column_chunks(
-                examples_uri, split, rows=chunk_rows
+                examples_uri, split, rows=chunk_rows, shards=[shard]
             ):
                 cols = materialize_chunk(raw)
                 for name in passthrough:
@@ -145,15 +146,45 @@ def Transform(ctx):
                     cols[name] = raw[name]
                 table = examples_io.table_from_columns(cols)
                 if writer is None:
+                    schema = table.schema
                     writer = examples_io.open_split_writer(
-                        transformed_out.uri, split, table.schema
+                        transformed_out.uri, split, schema,
+                        shard=shard, num_shards=n_shards,
                     )
                 writer.write_table(table)
                 n_rows += table.num_rows
         finally:
             if writer is not None:
                 writer.close()
-        counts[split] = n_rows
+        return n_rows, schema
+
+    counts = {}
+    split_wall = {}
+    shard_counts = {}
+    t0 = time.perf_counter()
+    for split in splits:
+        n_shards = examples_io.num_split_shards(examples_uri, split)
+        shard_counts[split] = n_shards
+        t_split = time.perf_counter()
+        # Output layout mirrors the input layout (shard i in -> shard i
+        # out), so per-shard row order — and the concatenated split order —
+        # is identical to the sequential single-writer materialization.
+        results = thread_map(
+            materialize_shard,
+            [(split, shard, n_shards) for shard in range(n_shards)],
+        )
+        schemas = [s for _, s in results if s is not None]
+        if schemas:
+            # Backfill empty shards (schema-only Parquet) so the shard set
+            # stays complete; a fully-empty split writes nothing, matching
+            # the legacy single-writer behavior.
+            for shard, (n, schema) in enumerate(results):
+                if schema is None:
+                    examples_io.open_split_writer(
+                        transformed_out.uri, split, schemas[0],
+                        shard=shard, num_shards=n_shards,
+                    ).close()
+        counts[split] = sum(n for n, _ in results)
         split_wall[split] = round(time.perf_counter() - t_split, 4)
     materialize_s = time.perf_counter() - t0
     total_rows = sum(counts.values())
@@ -180,6 +211,8 @@ def Transform(ctx):
         "materialize_rows_per_sec": (
             round(total_rows / materialize_s, 2) if materialize_s > 0 else 0.0
         ),
+        # Input shard layout per split == output layout (shard i -> shard i).
+        "data_shards": shard_counts,
         # True = every chunk went through the jitted device path (a mid-run
         # fallback to host numpy flips this off).
         "materialize_on_device": bool(on_device),

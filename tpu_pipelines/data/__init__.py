@@ -6,9 +6,12 @@ and host-side batch iterators that feed mesh-sharded ``jax.Array`` batches.
 """
 
 from tpu_pipelines.data.examples_io import (  # noqa: F401
+    num_split_shards,
     read_split,
     read_split_table,
     split_names,
+    split_shard_paths,
     write_split,
 )
 from tpu_pipelines.data.schema import Feature, FeatureType, Schema  # noqa: F401
+from tpu_pipelines.data.shard_plan import ShardPlan  # noqa: F401

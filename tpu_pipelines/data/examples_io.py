@@ -1,75 +1,238 @@
-"""On-disk ``Examples`` artifact format: one Parquet file per split.
+"""On-disk ``Examples`` artifact format: Parquet shards per split.
 
 Layout under an Examples artifact uri::
 
-    <uri>/Split-<name>/data.parquet
+    <uri>/Split-<name>/data-00000-of-00004.parquet   (native, N shards)
+    <uri>/Split-<name>/data.parquet                  (legacy, single file)
 
 Columnar Parquet (via pyarrow) is the TPU-native stand-in for the reference's
 TFRecord-of-tf.Example rows: column reads feed vectorized stats/transform
-directly, and row groups give cheap sharded reads for data-parallel hosts.
+directly.  Multi-shard splits are the native layout — the Parquet analog of
+the Beam ExampleGen family's ``data-*-of-N`` TFRecord shards — and give the
+data plane its unit of parallelism: ExampleGen writes shards concurrently,
+StatisticsGen/Transform/BulkInferrer map workers over shards, and multi-host
+input pipelines take whole files per host instead of strided rows.  Every
+reader here accepts both layouts; a legacy single-file split is simply a
+1-shard split, with no metadata migration.
+
+Sizing: ``DEFAULT_ROW_GROUP`` is the unit of *streaming* (one decode/IO
+quantum); the shard is the unit of *parallelism* (one worker/writer/file).
+A useful shard holds several row groups — shards smaller than one row group
+just fragment the groups and pay per-file overhead with no extra
+parallelism, so pick ``num_shards <= total_rows / DEFAULT_ROW_GROUP`` for
+large splits (tiny splits can ignore this; correctness never depends on it).
+All writers use zstd compression: measurably smaller than the snappy
+default at effectively the same decode speed, and decode parallelizes over
+shards anyway.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Dict, List, Optional
+import re
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
 SPLIT_PREFIX = "Split-"
-DATA_FILE = "data.parquet"
+DATA_FILE = "data.parquet"           # legacy single-file layout
+_SHARD_RE = re.compile(r"^data-(\d{5})-of-(\d{5})\.parquet$")
+COMPRESSION = "zstd"
 # Row-group size for written splits: the unit of streaming reads.  Small
 # enough that a handful of groups fit comfortably in RAM, large enough that
 # columnar decode stays vectorized.
 DEFAULT_ROW_GROUP = 16384
 
 
+def shard_file_name(index: int, count: int) -> str:
+    if not 0 <= index < count:
+        raise ValueError(f"shard index {index} not in [0, {count})")
+    return f"data-{index:05d}-of-{count:05d}.parquet"
+
+
 def split_dir(uri: str, split: str) -> str:
     return os.path.join(uri, f"{SPLIT_PREFIX}{split}")
 
 
+def _shard_files_in(d: str) -> List[str]:
+    try:
+        names = os.listdir(d)
+    except (FileNotFoundError, NotADirectoryError):
+        return []
+    return sorted(n for n in names if _SHARD_RE.match(n))
+
+
+def split_shard_paths(uri: str, split: str) -> List[str]:
+    """Ordered data-file paths of a split — N shard files, or the one legacy
+    ``data.parquet``.  Raises FileNotFoundError if the split is absent and
+    ValueError if the shard set is inconsistent (a partial write)."""
+    d = split_dir(uri, split)
+    shards = _shard_files_in(d)
+    if shards:
+        count = int(_SHARD_RE.match(shards[0]).group(2))
+        expect = [shard_file_name(i, count) for i in range(count)]
+        if shards != expect:
+            raise ValueError(
+                f"split {split!r} at {uri!r} has an inconsistent shard set "
+                f"{shards} (expected {count} files data-*-of-{count:05d}); "
+                "partial write?"
+            )
+        return [os.path.join(d, n) for n in shards]
+    legacy = os.path.join(d, DATA_FILE)
+    if os.path.isfile(legacy):
+        return [legacy]
+    raise FileNotFoundError(
+        f"Examples artifact at {uri!r} has no split {split!r} "
+        f"(available: {split_names(uri)})"
+    )
+
+
 def split_data_path(uri: str, split: str) -> str:
-    """Validated path of a split's data file; raises if the split is absent."""
-    path = os.path.join(split_dir(uri, split), DATA_FILE)
-    if not os.path.isfile(path):
-        raise FileNotFoundError(
-            f"Examples artifact at {uri!r} has no split {split!r} "
-            f"(available: {split_names(uri)})"
+    """Validated path of a SINGLE-file split (legacy layout or one shard);
+    raises for absent splits, and ValueError for multi-shard splits — use
+    ``split_shard_paths`` / the ``shards=`` readers for those."""
+    paths = split_shard_paths(uri, split)
+    if len(paths) > 1:
+        raise ValueError(
+            f"split {split!r} at {uri!r} is sharded into {len(paths)} files; "
+            "use split_shard_paths() or the shards= readers"
         )
-    return path
+    return paths[0]
+
+
+def num_split_shards(uri: str, split: str) -> int:
+    return len(split_shard_paths(uri, split))
 
 
 def split_names(uri: str) -> List[str]:
     if not os.path.isdir(uri):
         return []
-    return sorted(
-        d[len(SPLIT_PREFIX):]
-        for d in os.listdir(uri)
-        if d.startswith(SPLIT_PREFIX)
-        and os.path.isfile(os.path.join(uri, d, DATA_FILE))
-    )
+    out = []
+    for d in sorted(os.listdir(uri)):
+        if not d.startswith(SPLIT_PREFIX):
+            continue
+        full = os.path.join(uri, d)
+        if os.path.isfile(os.path.join(full, DATA_FILE)) or _shard_files_in(
+            full
+        ):
+            out.append(d[len(SPLIT_PREFIX):])
+    return out
+
+
+def _shard_bounds(num_rows: int, num_shards: int) -> List[int]:
+    """Row offsets slicing ``num_rows`` into ``num_shards`` contiguous,
+    maximally-even shards (first ``num_rows % num_shards`` get one extra)."""
+    base, extra = divmod(num_rows, num_shards)
+    bounds = [0]
+    for i in range(num_shards):
+        bounds.append(bounds[-1] + base + (1 if i < extra else 0))
+    return bounds
 
 
 def write_split(
     uri: str, split: str, table: pa.Table,
     row_group_size: int = DEFAULT_ROW_GROUP,
+    num_shards: Optional[int] = None,
+    compression: str = COMPRESSION,
 ) -> str:
+    """Materialize a whole split; returns the split directory.
+
+    ``num_shards=None`` keeps the legacy single ``data.parquet`` (what
+    pre-sharding callers expect); an integer writes the native
+    ``data-%05d-of-%05d`` layout — contiguous row slices, encoded in a
+    thread pool (Parquet encode releases the GIL).  See the module
+    docstring for the row-group-size ↔ shard-size interaction; a shard
+    smaller than ``row_group_size`` simply becomes one small row group.
+    """
     d = split_dir(uri, split)
     os.makedirs(d, exist_ok=True)
-    path = os.path.join(d, DATA_FILE)
-    pq.write_table(table, path, row_group_size=row_group_size)
-    return path
+    if num_shards is None:
+        pq.write_table(
+            table, os.path.join(d, DATA_FILE),
+            row_group_size=row_group_size, compression=compression,
+        )
+        return d
+    bounds = _shard_bounds(table.num_rows, num_shards)
+
+    def write_one(i: int) -> None:
+        pq.write_table(
+            table.slice(bounds[i], bounds[i + 1] - bounds[i]),
+            os.path.join(d, shard_file_name(i, num_shards)),
+            row_group_size=row_group_size, compression=compression,
+        )
+
+    if num_shards == 1:
+        write_one(0)
+    else:
+        workers = min(num_shards, os.cpu_count() or 1)
+        if workers > 1:
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                list(pool.map(write_one, range(num_shards)))
+        else:
+            for i in range(num_shards):
+                write_one(i)
+    return d
 
 
 def open_split_writer(
     uri: str, split: str, schema: pa.Schema,
+    shard: Optional[int] = None,
+    num_shards: Optional[int] = None,
+    compression: str = COMPRESSION,
 ) -> pq.ParquetWriter:
-    """Incremental split writer (chunked materialization path)."""
+    """Incremental split writer (chunked materialization path).
+
+    Default: the legacy single ``data.parquet``.  With ``shard``/
+    ``num_shards``, one writer for that shard of the native layout — a
+    sharding component opens one writer per shard (all ``num_shards`` of
+    them, so the shard set is complete even when some end up empty).  Each
+    ``write_table`` call becomes >= 1 row group, so feed row-group-sized
+    tables (module docstring: the shard is the parallelism unit, the row
+    group the streaming unit)."""
     d = split_dir(uri, split)
     os.makedirs(d, exist_ok=True)
-    return pq.ParquetWriter(os.path.join(d, DATA_FILE), schema)
+    if shard is None:
+        name = DATA_FILE
+    else:
+        if num_shards is None:
+            raise ValueError("shard= requires num_shards=")
+        name = shard_file_name(shard, num_shards)
+    return pq.ParquetWriter(
+        os.path.join(d, name), schema, compression=compression
+    )
+
+
+def _select_paths(
+    uri: str, split: str, shards: Optional[Sequence[int]]
+) -> List[str]:
+    paths = split_shard_paths(uri, split)
+    if shards is None:
+        return paths
+    for s in shards:
+        if not 0 <= s < len(paths):
+            raise IndexError(
+                f"shard {s} out of range for split {split!r} "
+                f"({len(paths)} shard(s))"
+            )
+    return [paths[s] for s in shards]
+
+
+def _iter_record_batches(
+    uri: str,
+    split: str,
+    columns: Optional[List[str]],
+    rows: int,
+    shards: Optional[Sequence[int]],
+):
+    for path in _select_paths(uri, split, shards):
+        pf = pq.ParquetFile(path)
+        try:
+            yield from pf.iter_batches(batch_size=rows, columns=columns)
+        finally:
+            pf.close()
 
 
 def iter_column_chunks(
@@ -77,20 +240,18 @@ def iter_column_chunks(
     split: str,
     columns: Optional[List[str]] = None,
     rows: int = DEFAULT_ROW_GROUP,
+    shards: Optional[Sequence[int]] = None,
 ):
     """Stream a split as dict-of-numpy chunks of ~``rows`` rows each.
 
     The whole split is never resident: pyarrow reads row groups lazily, so
     peak memory is O(rows), independent of split size — the streaming
     contract ExampleGen's row-group layout (write_split) is tuned for.
+    ``shards`` restricts the stream to those shard files (in the given
+    order) — the per-worker read of the sharded data plane.
     """
-    path = split_data_path(uri, split)
-    pf = pq.ParquetFile(path)
-    try:
-        for rb in pf.iter_batches(batch_size=rows, columns=columns):
-            yield columns_from_table(pa.Table.from_batches([rb]))
-    finally:
-        pf.close()
+    for rb in _iter_record_batches(uri, split, columns, rows, shards):
+        yield columns_from_table(pa.Table.from_batches([rb]))
 
 
 def iter_table_chunks(
@@ -98,34 +259,35 @@ def iter_table_chunks(
     split: str,
     columns: Optional[List[str]] = None,
     rows: int = DEFAULT_ROW_GROUP,
+    shards: Optional[Sequence[int]] = None,
 ):
     """Stream a split as Arrow tables of ~``rows`` rows (null semantics
     intact — what the statistics accumulator consumes); peak memory O(rows)."""
-    path = split_data_path(uri, split)
-    pf = pq.ParquetFile(path)
-    try:
-        for rb in pf.iter_batches(batch_size=rows, columns=columns):
-            yield pa.Table.from_batches([rb])
-    finally:
-        pf.close()
+    for rb in _iter_record_batches(uri, split, columns, rows, shards):
+        yield pa.Table.from_batches([rb])
 
 
 def read_split_table(
-    uri: str, split: str, columns: Optional[List[str]] = None
+    uri: str, split: str, columns: Optional[List[str]] = None,
+    shards: Optional[Sequence[int]] = None,
 ) -> pa.Table:
-    path = split_data_path(uri, split)
-    return pq.read_table(path, columns=columns)
+    tables = [
+        pq.read_table(p, columns=columns)
+        for p in _select_paths(uri, split, shards)
+    ]
+    return tables[0] if len(tables) == 1 else pa.concat_tables(tables)
 
 
 def read_split(
-    uri: str, split: str, columns: Optional[List[str]] = None
+    uri: str, split: str, columns: Optional[List[str]] = None,
+    shards: Optional[Sequence[int]] = None,
 ) -> Dict[str, np.ndarray]:
     """Split as a dict of numpy columns.
 
     Strings come back as object arrays; fixed-length list columns (images,
     one-hot vectors) come back stacked as 2-D numeric arrays.
     """
-    table = read_split_table(uri, split, columns)
+    table = read_split_table(uri, split, columns, shards)
     return columns_from_table(table)
 
 
@@ -159,6 +321,13 @@ def table_from_columns(columns: Dict[str, np.ndarray]) -> pa.Table:
     return pa.table(arrays)
 
 
+def shard_row_counts(uri: str, split: str) -> List[int]:
+    """Per-shard row counts from Parquet footers (no data read) — the basis
+    of file-granular shard assignment in the input pipeline."""
+    return [
+        pq.read_metadata(p).num_rows for p in split_shard_paths(uri, split)
+    ]
+
+
 def num_rows(uri: str, split: str) -> int:
-    path = os.path.join(split_dir(uri, split), DATA_FILE)
-    return pq.read_metadata(path).num_rows
+    return sum(shard_row_counts(uri, split))

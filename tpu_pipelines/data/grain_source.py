@@ -2,11 +2,19 @@
 
 SURVEY.md §2b (Beam row) names the replacement for the reference's Beam data
 plane as "sharded map over Grain + multiprocessing" — this is that backend:
-a ``RandomAccessDataSource`` over the Parquet row-group layout ExampleGen
-writes, driven by ``grain.python.DataLoader`` with ``worker_count``
-subprocesses.  Each worker re-opens the Parquet file lazily (handles never
-cross the fork/pickle boundary) and caches its last row group, so random
-access under a shuffled ``IndexSampler`` stays row-group-local per worker.
+a ``RandomAccessDataSource`` over the Parquet layout ExampleGen writes
+(sharded ``data-*-of-N`` files or the legacy single file), driven by
+``grain.python.DataLoader`` with ``worker_count`` subprocesses.  Each worker
+re-opens the Parquet files lazily (handles never cross the fork/pickle
+boundary) and caches its last row group, so random access under a shuffled
+``IndexSampler`` stays row-group-local per worker.
+
+Multi-host sharding is file-granular when the artifact has at least one
+shard file per host (``input_pipeline.assigned_shard_files``): the source is
+built over this host's files only and Grain's own ShardOptions collapse to
+the identity — each host's sampler permutes just the rows it owns.
+Otherwise Grain's contiguous even-block ShardOptions apply over the full
+row range, as before.
 
 Selected through the ordinary input contract:
 ``InputConfig(use_grain=True, grain_workers=N)`` — `BatchIterator` then
@@ -16,9 +24,8 @@ instead of the in-process readers.
 
 from __future__ import annotations
 
-import os
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -27,33 +34,55 @@ from tpu_pipelines.data import examples_io
 
 class ParquetRowSource:
     """Random-access rows of one Examples split (Grain source protocol:
-    ``__len__`` + ``__getitem__``), lazy and per-thread-cached.
+    ``__len__`` + ``__getitem__``), lazy and per-thread-cached, spanning
+    every shard file of the split (or the ``shards`` subset — the
+    file-granular multi-host read).
 
     THREAD SAFETY: Grain's per-worker prefetch drives ``__getitem__`` from a
     ThreadPoolExecutor, and pyarrow's ``ParquetFile.read_row_group`` is not
     safe on a handle shared across threads (concurrent reads segfault in
-    native code).  Every reader thread therefore gets its own handle and its
-    own last-row-group cache via ``threading.local`` — reads stay lock-free
-    and row-group-local per thread."""
+    native code).  Every reader thread therefore gets its own handles and
+    its own last-row-group cache via ``threading.local`` — reads stay
+    lock-free and row-group-local per thread."""
 
-    def __init__(self, uri: str, split: str, columns: Optional[List[str]] = None):
-        self.path = examples_io.split_data_path(uri, split)
+    def __init__(
+        self,
+        uri: str,
+        split: str,
+        columns: Optional[List[str]] = None,
+        shards: Optional[Sequence[int]] = None,
+    ):
+        paths = examples_io.split_shard_paths(uri, split)
+        if shards is not None:
+            paths = [paths[i] for i in shards]
+        self.paths = paths
         self.columns = list(columns) if columns else None
         import pyarrow.parquet as pq
 
         self._local = threading.local()
-        pf = pq.ParquetFile(self.path)
-        try:
-            meta = pf.metadata
-            counts = [
-                meta.row_group(i).num_rows for i in range(meta.num_row_groups)
-            ]
-        finally:
-            pf.close()
-        self._group_ends = np.cumsum(counts)
-        self._n = int(self._group_ends[-1]) if counts else 0
+        # Global row index -> (file, row group): flat per-group tables over
+        # the concatenated shard files, built from footers only.
+        ends: List[int] = []
+        group_file: List[int] = []
+        group_in_file: List[int] = []
+        offset = 0
+        for fi, path in enumerate(self.paths):
+            pf = pq.ParquetFile(path)
+            try:
+                meta = pf.metadata
+                for gi in range(meta.num_row_groups):
+                    offset += meta.row_group(gi).num_rows
+                    ends.append(offset)
+                    group_file.append(fi)
+                    group_in_file.append(gi)
+            finally:
+                pf.close()
+        self._group_ends = np.asarray(ends, np.int64)
+        self._group_file = group_file
+        self._group_in_file = group_in_file
+        self._n = offset
 
-    # ---- pickling: workers get path + layout, never open handles/caches
+    # ---- pickling: workers get paths + layout, never open handles/caches
     def __getstate__(self):
         state = dict(self.__dict__)
         state["_local"] = None
@@ -71,12 +100,18 @@ class ParquetRowSource:
         cache = getattr(local, "cache", None)
         if cache is not None and cache[0] == group:
             return cache[1]
-        pf = getattr(local, "pf", None)
+        handles = getattr(local, "pf", None)
+        if handles is None:
+            handles = local.pf = {}
+        fi = self._group_file[group]
+        pf = handles.get(fi)
         if pf is None:
             import pyarrow.parquet as pq
 
-            pf = local.pf = pq.ParquetFile(self.path)
-        table = pf.read_row_group(group, columns=self.columns)
+            pf = handles[fi] = pq.ParquetFile(self.paths[fi])
+        table = pf.read_row_group(
+            self._group_in_file[group], columns=self.columns
+        )
         cols = examples_io.columns_from_table(table)
         local.cache = (group, cols)
         return cols
@@ -96,7 +131,9 @@ def grain_shard_rows(n_total: int, config) -> int:
     (with drop_remainder, exactly floor(n/k) each; without, the first n%k
     shards get one extra) — not the strided i%k convention of the in-process
     readers.  The single source of this formula for BatchIterator's counts
-    and the aligned-epoch fast path below."""
+    and the aligned-epoch fast path below.  (Under file-granular assignment
+    the shard IS the file subset and this formula is bypassed — see
+    grain_batches.)"""
     base, extra = divmod(n_total, config.num_shards)
     if config.drop_remainder:
         return base
@@ -113,6 +150,12 @@ def grain_batches(uri: str, split: str, config, columns=None):
     every interpreter, but readers never touch jax devices, so no backend
     initializes in them.)
 
+    Over a sharded artifact with >= one file per host, sharding is
+    file-granular: the source holds only this host's shard files and
+    ShardOptions collapse to the identity (input_pipeline.
+    assigned_shard_files is the single decision point, so BatchIterator's
+    row counts match what Grain yields).
+
     When this shard's rows divide evenly into batches (drop_remainder with
     shard_n % batch == 0), ONE multi-epoch loader serves the whole run:
     Grain's IndexSampler reshuffles per epoch internally (verified: each
@@ -125,12 +168,26 @@ def grain_batches(uri: str, split: str, config, columns=None):
     """
     import grain.python as pg
 
-    source = ParquetRowSource(uri, split, columns)
-    shard_options = pg.ShardOptions(
-        shard_index=config.shard_index,
-        shard_count=config.num_shards,
-        drop_remainder=config.drop_remainder,
+    from tpu_pipelines.data.input_pipeline import assigned_shard_files
+
+    file_shards = assigned_shard_files(
+        examples_io.shard_row_counts(uri, split), config
     )
+    source = ParquetRowSource(uri, split, columns, shards=file_shards)
+    if file_shards is not None:
+        # Pre-sharded by file: every row of the source belongs to this host.
+        shard_options = pg.ShardOptions(
+            shard_index=0, shard_count=1,
+            drop_remainder=config.drop_remainder,
+        )
+        shard_n = len(source)
+    else:
+        shard_options = pg.ShardOptions(
+            shard_index=config.shard_index,
+            shard_count=config.num_shards,
+            drop_remainder=config.drop_remainder,
+        )
+        shard_n = grain_shard_rows(len(source), config)
 
     read_options = None
     if (
@@ -166,7 +223,6 @@ def grain_batches(uri: str, split: str, config, columns=None):
             read_options=read_options,
         )
 
-    shard_n = grain_shard_rows(len(source), config)
     if config.drop_remainder and shard_n % config.batch_size == 0:
         # num_epochs=None = infinite, still reshuffled per epoch.
         yield from loader_for(config.num_epochs, config.seed)

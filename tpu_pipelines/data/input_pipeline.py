@@ -4,8 +4,11 @@ The TPU-native stand-in for the reference's tf.data feeding loop (SURVEY.md
 §3.3): static batch shapes (XLA compiles once), per-epoch permutation
 shuffling, per-host sharding for multi-host data parallelism, and a
 ``shard_batch`` device_put at the infeed boundary.  Shard membership is
-backend-specific: the in-process readers use strided rows
-(``i % num_shards == shard_index``); the grain backend uses Grain's
+backend-specific: over a sharded Examples artifact with at least one file
+per host, EVERY backend assigns whole shard files round-robin
+(``assigned_shard_files`` — no host decodes rows it drops); otherwise the
+in-process readers fall back to strided rows
+(``i % num_shards == shard_index``) and the grain backend to Grain's
 contiguous even blocks (see grain_source.py).
 
 Two reader modes behind one iterator contract: splits within the
@@ -27,6 +30,23 @@ from tpu_pipelines.data import examples_io
 from tpu_pipelines.parallel.mesh import shard_batch
 
 Batch = Dict[str, np.ndarray]
+
+
+def assigned_shard_files(
+    shard_rows: list, config: "InputConfig"
+) -> Optional[list]:
+    """File-granular shard assignment: the shard-file indices this host
+    reads (round-robin by file index), or None when file granularity does
+    not apply (single host, or fewer files than hosts) and the reader must
+    fall back to strided rows.  Round-robin keeps every host's row count
+    within one file of even for the even-sized shards ExampleGen writes,
+    and the union over hosts is exactly the split — disjoint and complete
+    by construction."""
+    if config.num_shards <= 1 or len(shard_rows) < config.num_shards:
+        return None
+    return list(
+        range(config.shard_index, len(shard_rows), config.num_shards)
+    )
 
 
 @dataclasses.dataclass
@@ -83,14 +103,28 @@ class BatchIterator:
         self.config = config
         self.transform = transform
         self._uri, self._split, self._columns = uri, split, columns
-        n_total = examples_io.num_rows(uri, split)
+        shard_rows = examples_io.shard_row_counts(uri, split)
+        n_total = sum(shard_rows)
+        # File-granular multi-host sharding: with a sharded artifact and at
+        # least one file per host, each host takes whole shard files
+        # (round-robin by file index) instead of strided i%k rows — no host
+        # decodes rows it will drop, the scaling the strided read left on
+        # the table.  Fewer files than hosts (e.g. a legacy single-file
+        # split) falls back to the strided-row read.
+        self._shard_files = assigned_shard_files(shard_rows, config)
         if config.use_grain:
             # Grain assigns contiguous even blocks, not strided i%k rows;
             # count with the shared formula so num_examples/steps_per_epoch
-            # match what Grain will actually yield.
+            # match what Grain will actually yield (grain_batches makes the
+            # same file-granular decision from the same inputs).
             from tpu_pipelines.data.grain_source import grain_shard_rows
 
-            shard_n = grain_shard_rows(n_total, config)
+            if self._shard_files is not None:
+                shard_n = sum(shard_rows[i] for i in self._shard_files)
+            else:
+                shard_n = grain_shard_rows(n_total, config)
+        elif self._shard_files is not None:
+            shard_n = sum(shard_rows[i] for i in self._shard_files)
         else:
             # Per-host shard: strided rows (i % num_shards == shard_index).
             shard_n = len(range(config.shard_index, n_total, config.num_shards))
@@ -99,12 +133,15 @@ class BatchIterator:
             self._data = None
             self._indices = None
         else:
-            data = examples_io.read_split(uri, split, columns)
+            data = examples_io.read_split(
+                uri, split, columns, shards=self._shard_files
+            )
             if not data:
                 raise ValueError(f"empty split {split!r} at {uri}")
             self._data = data
-            self._indices = np.arange(
-                config.shard_index, n_total, config.num_shards
+            self._indices = (
+                np.arange(shard_n) if self._shard_files is not None
+                else np.arange(config.shard_index, n_total, config.num_shards)
             )
         self._n = shard_n
         if self._n < config.batch_size and config.drop_remainder:
@@ -198,15 +235,20 @@ class BatchIterator:
             return batches, {k: v[leftover] for k, v in pool.items()}
 
         for chunk in examples_io.iter_column_chunks(
-            self._uri, self._split, self._columns
+            self._uri, self._split, self._columns,
+            shards=self._shard_files,
         ):
-            n = rows_in(chunk)
-            take = (
-                np.arange(offset, offset + n) % cfg.num_shards
-            ) == cfg.shard_index
-            offset += n
-            if not take.all():
-                chunk = {k: v[take] for k, v in chunk.items()}
+            if self._shard_files is None:
+                # Strided-row fallback: every host decodes every chunk and
+                # keeps its i%k rows.  (File-granular assignment streams
+                # only this host's shard files — no filter needed.)
+                n = rows_in(chunk)
+                take = (
+                    np.arange(offset, offset + n) % cfg.num_shards
+                ) == cfg.shard_index
+                offset += n
+                if not take.all():
+                    chunk = {k: v[take] for k, v in chunk.items()}
             if rows_in(chunk) == 0:
                 continue
             pending = chunk if pending is None else {

@@ -1,0 +1,137 @@
+"""ShardPlan: how many shards the data plane fans out to, and worker pools.
+
+The sharded-Examples layout (examples_io: ``Split-<name>/data-00000-of-N
+.parquet``) gives every hot data component a unit of intra-component
+parallelism — the Parquet analog of the Beam-based ExampleGen family's
+``data-*-of-N`` TFRecord shards.  This module owns the two decisions every
+sharding component would otherwise re-make:
+
+  * **How many shards?**  ``ShardPlan.resolve(param)``: an explicit component
+    parameter wins, then the ``TPP_DATA_SHARDS`` env var, then ``host_cpus``
+    (capped at ``MAX_DEFAULT_SHARDS`` — beyond that, per-file overhead beats
+    the parallelism on any realistic host).
+  * **How to run per-shard work?**  ``map_shards`` (process pool — the
+    CPU-bound stats/ingest reductions hold the GIL) and ``thread_map``
+    (thread pool — Parquet encode/decode and large-array numpy release the
+    GIL, and the task closures are not picklable).
+
+Both pools degrade gracefully: one task, one worker, or a pool that cannot
+start all fall back to plain sequential execution, so a 1-core host pays
+only the per-file overhead, never a broken pipeline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import multiprocessing
+import os
+from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
+from typing import Callable, List, Optional, Sequence, TypeVar
+
+ENV_SHARDS = "TPP_DATA_SHARDS"
+# Pool backend override: "process" (default), "thread", or "none"
+# (sequential — the debugging escape hatch).
+ENV_POOL = "TPP_DATA_POOL"
+# Worker-count override (testing / oversubscribed hosts).
+ENV_POOL_WORKERS = "TPP_DATA_POOL_WORKERS"
+MAX_DEFAULT_SHARDS = 8
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardPlan:
+    """Resolved shard count for one component execution.
+
+    ``source`` records which rung of the precedence ladder decided
+    (``param`` > ``env`` > ``host_cpus``) — it lands in execution summaries
+    so BENCH/debug output says *why* an artifact has N shards.
+    """
+
+    num_shards: int
+    source: str = "host_cpus"
+
+    def __post_init__(self):
+        if self.num_shards < 1:
+            raise ValueError(
+                f"num_shards must be >= 1, got {self.num_shards}"
+            )
+
+    @classmethod
+    def resolve(cls, param: Optional[int] = None) -> "ShardPlan":
+        """Precedence: explicit component parameter > TPP_DATA_SHARDS env >
+        host CPU count (capped at MAX_DEFAULT_SHARDS)."""
+        if param is not None:
+            return cls(int(param), "param")
+        env = os.environ.get(ENV_SHARDS, "").strip()
+        if env:
+            return cls(int(env), "env")
+        return cls(
+            min(os.cpu_count() or 1, MAX_DEFAULT_SHARDS), "host_cpus"
+        )
+
+
+def _pool_workers(n_tasks: int, workers: Optional[int]) -> int:
+    """Effective worker count: TPP_DATA_POOL_WORKERS overrides everything
+    (the test/oversubscribed-host knob), then the caller's cap, then
+    min(tasks, host cpus)."""
+    env = os.environ.get(ENV_POOL_WORKERS, "").strip()
+    if env:
+        return max(1, min(int(env), n_tasks))
+    if workers is not None:
+        return max(1, min(workers, n_tasks))
+    return max(1, min(n_tasks, os.cpu_count() or 1))
+
+
+def map_shards(
+    fn: Callable[[T], R],
+    tasks: Sequence[T],
+    workers: Optional[int] = None,
+) -> List[R]:
+    """``[fn(t) for t in tasks]`` through a process pool, order preserved.
+
+    ``fn`` and each task must be picklable (module-level function +
+    plain-data args — the per-shard statistics worker contract).  Falls
+    back to a thread pool when fork isn't available, and to sequential
+    when the pool is pointless (one task / one worker) or ``TPP_DATA_POOL``
+    says so.
+    """
+    workers = _pool_workers(len(tasks), workers)
+    mode = os.environ.get(ENV_POOL, "process").strip() or "process"
+    if len(tasks) <= 1 or workers <= 1 or mode == "none":
+        return [fn(t) for t in tasks]
+    if mode == "process":
+        try:
+            # fork, explicitly: spawn would re-import the full framework
+            # (and this environment preloads jax into every interpreter)
+            # per worker — seconds of startup against millisecond tasks.
+            ctx = multiprocessing.get_context("fork")
+            with ProcessPoolExecutor(
+                max_workers=workers, mp_context=ctx
+            ) as pool:
+                return list(pool.map(fn, tasks))
+        except (ValueError, OSError):
+            # No fork on this platform / resource limits: threads still
+            # overlap the GIL-releasing Arrow decode.
+            pass
+    return thread_map(fn, tasks, workers=workers)
+
+
+def thread_map(
+    fn: Callable[[T], R],
+    tasks: Sequence[T],
+    workers: Optional[int] = None,
+) -> List[R]:
+    """``[fn(t) for t in tasks]`` through a thread pool, order preserved.
+
+    For per-shard work whose closures cannot cross a process boundary
+    (Transform's apply-fn, BulkInferrer's jitted predict): Parquet
+    encode/decode and large-array numpy release the GIL, so threads still
+    overlap the IO-heavy parts even though pure-Python stretches serialize.
+    """
+    workers = _pool_workers(len(tasks), workers)
+    if len(tasks) <= 1 or workers <= 1:
+        return [fn(t) for t in tasks]
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        return list(pool.map(fn, tasks))

@@ -170,6 +170,44 @@ class _NumericFeatureAcc:
             self._reservoir[slots[mask]] = rest[mask]
         self.count += len(vals)
 
+    def merge(self, other: "_NumericFeatureAcc") -> None:
+        """Fold another accumulator in (Beam CombineFn merge_accumulators).
+
+        Moments/min/max/zeros merge exactly.  Reservoirs concatenate while
+        the union fits (both exact -> merged exact, so merged finalize ==
+        single-pass finalize for any split that fits the reservoir);
+        overflow falls back to the standard weighted subsample — each kept
+        slot draws from this side with probability count/(count+other) —
+        keeping the merged reservoir an (approximately) uniform sample of
+        the union, the same approximation regime as single-pass overflow.
+        """
+        if not other.count:
+            return
+        self.total += other.total
+        self.total_sq += other.total_sq
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+        self.zeros += other.zeros
+        cap = len(self._reservoir)
+        a = self._reservoir[:self._filled]
+        b = other._reservoir[:other._filled]
+        if len(a) + len(b) <= cap:
+            self._reservoir[len(a):len(a) + len(b)] = b
+            self._filled += len(b)
+        else:
+            take_a = int(self._rng.binomial(
+                cap, self.count / (self.count + other.count)
+            ))
+            take_a = min(take_a, len(a))
+            take_b = min(cap - take_a, len(b))
+            take_a = cap - take_b
+            keep_a = self._rng.choice(len(a), take_a, replace=False)
+            keep_b = self._rng.choice(len(b), take_b, replace=False)
+            self._reservoir[:take_a] = a[keep_a]
+            self._reservoir[take_a:cap] = b[keep_b]
+            self._filled = cap
+        self.count += other.count
+
     def finalize(self) -> Optional[NumericStats]:
         if not self.count:
             return None
@@ -206,6 +244,14 @@ class _StringFeatureAcc:
             self.counts[v] = self.counts.get(v, 0) + int(c)
         self.total_len += int(sum(len(v) for v in svals))
         self.n += len(svals)
+
+    def merge(self, other: "_StringFeatureAcc") -> None:
+        """Exact merge: value counts add, so merged finalize (sorted-unique
+        + stable argsort) is byte-identical to the single-pass result."""
+        for v, c in other.counts.items():
+            self.counts[v] = self.counts.get(v, 0) + c
+        self.total_len += other.total_len
+        self.n += other.n
 
     def finalize(self) -> Optional[StringStats]:
         if not self.n:
@@ -264,6 +310,37 @@ class SplitStatsAccumulator:
                 vals = np.asarray(col.drop_null().to_pylist(), dtype=object)
                 self._string.setdefault(name, _StringFeatureAcc()).update(vals)
 
+    def merge(self, other: "SplitStatsAccumulator") -> None:
+        """Fold another split accumulator in — the merge_accumulators leg of
+        the CombineFn cycle, for per-shard parallel stats: accumulate each
+        shard independently, merge in shard order, finalize once.  Exact for
+        counts/min/max/zeros/missing/top-k; mean/std differ from single-pass
+        only by float summation order; reservoir order statistics are exact
+        while the union fits the reservoir (_NumericFeatureAcc.merge)."""
+        self.num_rows += other.num_rows
+        for name in other._order:
+            if name not in self._types:
+                self._types[name] = other._types[name]
+                self._missing[name] = 0
+                self._order.append(name)
+            elif self._types[name] != other._types[name]:
+                raise ValueError(
+                    f"column {name!r}: type {self._types[name]} vs "
+                    f"{other._types[name]} across shards — shards of one "
+                    "split must share a schema"
+                )
+            self._missing[name] += other._missing[name]
+            if name in other._numeric:
+                if name in self._numeric:
+                    self._numeric[name].merge(other._numeric[name])
+                else:
+                    self._numeric[name] = other._numeric[name]
+            elif name in other._string:
+                if name in self._string:
+                    self._string[name].merge(other._string[name])
+                else:
+                    self._string[name] = other._string[name]
+
     def finalize(self) -> SplitStatistics:
         features: Dict[str, FeatureStats] = {}
         for name in self._order:
@@ -289,3 +366,38 @@ def compute_split_statistics(split: str, table: pa.Table) -> SplitStatistics:
     acc = SplitStatsAccumulator(split)
     acc.update(table)
     return acc.finalize()
+
+
+def accumulate_split_shard(task) -> SplitStatsAccumulator:
+    """One shard's accumulator — the process-pool worker of the sharded
+    StatisticsGen (module-level and plain-data-argumented, so it crosses the
+    pickle boundary of ``shard_plan.map_shards``).
+
+    ``task`` is ``(uri, split, shard, chunk_rows, reservoir_size)``.  The
+    reservoir rng is seeded by shard index so shards sample independently;
+    with the split under the reservoir size (every shard's reservoir exact)
+    the seed is irrelevant and merged results match single-pass exactly.
+    """
+    uri, split, shard, chunk_rows, reservoir_size = task
+    from tpu_pipelines.data import examples_io
+
+    acc = SplitStatsAccumulator(
+        split, reservoir_size=reservoir_size, seed=shard
+    )
+    for table in examples_io.iter_table_chunks(
+        uri, split, rows=chunk_rows, shards=[shard]
+    ):
+        acc.update(table)
+    return acc
+
+
+def merge_accumulators(
+    accs: List[SplitStatsAccumulator],
+) -> SplitStatsAccumulator:
+    """Left-fold in shard order (deterministic merged reservoir/ordering)."""
+    if not accs:
+        raise ValueError("no accumulators to merge")
+    first = accs[0]
+    for other in accs[1:]:
+        first.merge(other)
+    return first

@@ -22,7 +22,7 @@ import dataclasses
 import json
 import os
 import shutil
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import numpy as np
@@ -115,6 +115,34 @@ class LoadedModel:
     # remote-compile platforms).  Tested by test_export_no_weight_constants.
     forward_step: Callable[[Any, Dict[str, Any]], Any] = None
     device_step: Callable[[Any, Dict[str, Any]], Any] = None
+
+
+def model_input_columns(
+    loaded: "LoadedModel", raw: bool
+) -> Optional[List[str]]:
+    """Columns the loaded model's predict path actually consumes, for
+    column-projected Parquet reads (Evaluator/BulkInferrer pass these as
+    ``columns=`` instead of decoding every column).
+
+    ``raw=True`` is the predict/generate surface (embedded transform applied
+    to raw examples): the transform graph's input features.  ``raw=False``
+    is predict_transformed: the transform's output features.  Returns None —
+    read everything — when the payload carries no transform graph (the
+    model's feature selection is then invisible from the spec) so projection
+    can never starve an unknown model.
+    """
+    if loaded.transform is None:
+        return None
+    cols = (
+        loaded.transform.input_feature_names() if raw
+        else loaded.transform.output_feature_names()
+    )
+    # Models may read declared feature lists beyond the transform surface
+    # (e.g. a hyperparameter-selected passthrough column).
+    extra = (loaded.spec.get("hyperparameters") or {}).get("features")
+    if isinstance(extra, (list, tuple)):
+        cols = sorted(set(cols) | {str(c) for c in extra})
+    return cols
 
 
 def _checkpoint_abstract(uri: str, sharding=None) -> Any:

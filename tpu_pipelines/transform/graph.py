@@ -482,6 +482,12 @@ class TransformGraph:
     def output_feature_names(self) -> List[str]:
         return sorted(self.outputs)
 
+    def input_feature_names(self) -> List[str]:
+        """Raw columns the graph actually reads — the projection set for
+        column-pruned reads (schema features the preprocessing_fn never
+        touched don't need to leave the Parquet footer)."""
+        return sorted({n.name for n in self.nodes if n.op == "input"})
+
     def tokenizer_vocab_sizes(self) -> Dict[str, int]:
         """Resolved vocab size per tokenize-producing output column.
 
