@@ -85,6 +85,28 @@ def test_bench_smoke_emits_compact_stdout_and_full_report():
         assert len(e2e["nodes"]) >= min_nodes
         # Scheduler config is recorded per leg (BENCH comparability).
         assert e2e["max_parallel_nodes"] >= 1
+    # RunTrace-derived keys on the taxi e2e leg (ISSUE 4): present and
+    # self-consistent — the sum of scheduler node spans bounds the
+    # measured critical path from above, the longest single node from
+    # below; a fresh home means every driver verdict was a cache miss.
+    tr = report["pipeline_e2e"]["taxi"]["trace"]
+    assert tr is not None and "error" not in tr, tr
+    assert (
+        tr["span_duration_total_s"]
+        >= tr["critical_path_measured_s"]
+        >= tr["longest_node_s"]
+        > 0
+    ), tr
+    assert tr["critical_path_nodes"], tr
+    assert tr["queue_wait_total_s"] >= 0
+    assert tr["gate_wait_total_s"] >= 0
+    assert tr["cache_hit_ratio"] == 0.0  # fresh pipeline home
+    assert tr["events"] > 0
+    # And the trace-off comparison leg ran (overhead bound evidence) —
+    # with TPP_TRACE=0 writing no event log at all.
+    ov = report["pipeline_e2e"]["taxi"]["trace_overhead"]
+    assert ov["wall_trace_on_s"] > 0 and ov["wall_trace_off_s"] > 0
+    assert ov["trace_off_wrote_no_events"] is True
     # The sequential-vs-concurrent scheduler sub-leg: both modes green,
     # walls measured, identical published artifacts/lineage, per-node
     # critical-path breakdown present.  (The strict concurrent<sequential
@@ -99,6 +121,9 @@ def test_bench_smoke_emits_compact_stdout_and_full_report():
     assert sched["max_parallel_nodes"]["sequential"] == 1
     assert sched["max_parallel_nodes"]["concurrent"] > 1
     assert sched["critical_path"] and sched["critical_path_s"] > 0
+    # Both scheduler modes carry their measured (trace-derived) profile.
+    for key in ("trace_concurrent", "trace_sequential"):
+        assert sched[key]["critical_path_measured_s"] > 0, (key, sched[key])
     # And the run-wide concurrency config lands in the report JSON.
     conc = report["concurrency"]
     assert conc["default_policy"] == "n_dag_roots"

@@ -1,0 +1,507 @@
+"""RunTrace: recorder safety, exporters, disabled-mode and crash contracts.
+
+The ISSUE-4 tentpole claims, each proven here:
+  - the recorder is thread-safe under the concurrent scheduler (every
+    line parses, every node's span lands exactly once);
+  - the Perfetto export is schema-valid Chrome trace JSON (X/i/M events
+    with the required fields, named threads);
+  - TPP_TRACE=0 writes ZERO files and leaves the metadata trace
+    byte-identical to a traced run;
+  - per-shard spans match the ShardPlan task fan-out, through the real
+    fork process pool included;
+  - crash faults leave a parsable, truncation-tolerant log that a
+    resumed run (same run id) appends to;
+  - log correlation stamps run_id/node_id onto tpu_pipelines.* records;
+  - the metrics summary is self-consistent (sum of node spans >=
+    measured critical path >= longest node) and the trace CLI
+    summarizes/exports it.
+"""
+
+import json
+import logging
+import os
+import threading
+import time
+
+import pytest
+
+from tpu_pipelines.dsl.component import component
+from tpu_pipelines.dsl.pipeline import Pipeline
+from tpu_pipelines.observability import (
+    TraceRecorder,
+    activate,
+    compute_metrics,
+    events_path,
+    read_events,
+    to_perfetto,
+)
+from tpu_pipelines.orchestration import LocalDagRunner
+
+pytestmark = pytest.mark.observability
+
+
+def _stub(name, outs, ins=None, sleep_s=0.0, resource_class="host"):
+    @component(inputs=ins or {}, outputs=outs, name=name,
+               resource_class=resource_class)
+    def C(ctx):
+        if sleep_s:
+            time.sleep(sleep_s)
+        for key in ctx.outputs:
+            with open(os.path.join(ctx.output(key).uri, "data.txt"),
+                      "w") as f:
+                f.write(f"{ctx.node_id}:{key}")
+        return {"marker": ctx.node_id}
+
+    return C
+
+
+def _diamond(tmp_path, sleep_s=0.05, subdir="d"):
+    Gen = _stub("Gen", {"examples": "Examples"})
+    Left = _stub("Left", {"statistics": "ExampleStatistics"},
+                 {"examples": "Examples"}, sleep_s=sleep_s)
+    Right = _stub("Right", {"schema": "Schema"},
+                  {"examples": "Examples"}, sleep_s=sleep_s)
+    Join = _stub("Join", {"model": "Model"},
+                 {"statistics": "ExampleStatistics", "schema": "Schema"})
+    gen = Gen()
+    left = Left(examples=gen.outputs["examples"])
+    right = Right(examples=gen.outputs["examples"])
+    join = Join(statistics=left.outputs["statistics"],
+                schema=right.outputs["schema"])
+    home = tmp_path / subdir
+    return Pipeline(
+        "diamond", [gen, left, right, join],
+        pipeline_root=str(home / "root"),
+        metadata_path=str(home / "md.sqlite"),
+    )
+
+
+def _events_of(pipeline, result):
+    path = events_path(pipeline.pipeline_root, result.run_id)
+    assert os.path.exists(path), path
+    return read_events(path)
+
+
+# ---------------------------------------------------- recorder + scheduler
+
+
+def test_concurrent_run_trace_parses_and_covers_every_node(tmp_path):
+    """Thread-safety under max_parallel_nodes>1: worker threads and the
+    scheduler interleave writes, yet every line is intact JSON and every
+    node has exactly one scheduler span with its dependency edges."""
+    p = _diamond(tmp_path, sleep_s=0.05)
+    result = LocalDagRunner(max_parallel_nodes=3).run(p)
+    raw = open(events_path(p.pipeline_root, result.run_id)).read()
+    parsed = [json.loads(line) for line in raw.splitlines() if line]
+    events = _events_of(p, result)
+    assert len(events) == len(parsed)  # nothing skipped: no torn lines
+
+    node_spans = [
+        e for e in events
+        if e["cat"] == "scheduler" and e["name"] == "node"
+    ]
+    assert sorted(e["node"] for e in node_spans) == [
+        "Gen", "Join", "Left", "Right",
+    ]
+    by_node = {e["node"]: e for e in node_spans}
+    assert by_node["Join"]["args"]["upstream"] == ["Left", "Right"]
+    assert all(e["args"]["status"] == "COMPLETE" for e in node_spans)
+    # Executor spans came from pool worker threads, not the scheduler.
+    exec_spans = [e for e in events if e["name"] == "executor"]
+    assert {e["node"] for e in exec_spans} == {"Gen", "Join", "Left",
+                                              "Right"}
+    assert any(e["thread"].startswith("tpp-node") for e in exec_spans)
+    # run_start/run_end bracket the run.
+    names = [e["name"] for e in events]
+    assert names[0] == "run_start" and names[-1] == "run_end"
+
+
+def test_metrics_self_consistent_and_queue_gate_waits(tmp_path):
+    """sum(node spans) >= measured critical path >= longest node, and a
+    chip-gated tpu sibling records its gate wait."""
+    Gen = _stub("Gen", {"examples": "Examples"})
+    T1 = _stub("T1", {"model": "Model"}, {"examples": "Examples"},
+               sleep_s=0.15, resource_class="tpu")
+    T2 = _stub("T2", {"transform_graph": "TransformGraph"},
+               {"examples": "Examples"}, sleep_s=0.05, resource_class="tpu")
+    gen = Gen()
+    p = Pipeline(
+        "gated", [gen, T1(examples=gen.outputs["examples"]),
+                  T2(examples=gen.outputs["examples"])],
+        pipeline_root=str(tmp_path / "root"),
+        metadata_path=str(tmp_path / "md.sqlite"),
+    )
+    result = LocalDagRunner(max_parallel_nodes=3).run(p)
+    m = compute_metrics(_events_of(p, result))
+    assert m["node_count"] == 3
+    assert (
+        m["span_duration_total_s"]
+        >= m["critical_path_measured_s"]
+        >= m["longest_node_s"]
+        > 0
+    )
+    # Measured critical path tracks the run's wall-clock (<5% + a fixed
+    # epsilon for the scheduler's poll quantum on tiny runs).
+    assert m["critical_path_measured_s"] <= m["run_wall_s"] * 1.05 + 0.05
+    # One tpu node waited for the chip while its sibling held it.
+    assert m["gate_wait_total_s"] > 0
+    assert m["queue_wait_total_s"] >= m["gate_wait_total_s"]
+    assert m["cache_misses"] == 3 and m["cache_hit_ratio"] == 0.0
+    assert m["run_succeeded"] is True
+    assert m["store_ops"]["publish_execution"]["count"] >= 3
+
+
+def test_cache_hits_recorded_on_warm_rerun(tmp_path):
+    p = _diamond(tmp_path)
+    LocalDagRunner(max_parallel_nodes=3).run(p)
+    result = LocalDagRunner(max_parallel_nodes=3).run(_diamond(tmp_path))
+    m = compute_metrics(_events_of(_diamond(tmp_path), result))
+    assert m["cache_hits"] == 4 and m["cache_hit_ratio"] == 1.0
+    assert all(
+        info["status"] == "CACHED" for info in m["per_node"].values()
+    )
+
+
+# -------------------------------------------------------------- exporters
+
+
+def test_perfetto_export_schema_valid(tmp_path):
+    p = _diamond(tmp_path, sleep_s=0.02)
+    result = LocalDagRunner(max_parallel_nodes=3).run(p)
+    doc = to_perfetto(_events_of(p, result))
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    evs = doc["traceEvents"]
+    assert evs and isinstance(evs, list)
+    for e in evs:
+        assert e["ph"] in ("X", "M", "i")
+        assert isinstance(e["name"], str) and e["name"]
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        if e["ph"] == "X":
+            assert e["ts"] >= 0 and e["dur"] >= 0
+        if e["ph"] == "i":
+            assert e["s"] == "t"
+    # Thread metadata names every track that carries events.
+    named = {
+        (e["pid"], e["tid"]) for e in evs
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    used = {(e["pid"], e["tid"]) for e in evs if e["ph"] == "X"}
+    assert used <= named
+    # JSON-serializable end to end (what export_perfetto writes).
+    json.dumps(doc)
+
+
+# ----------------------------------------------------------- disabled mode
+
+
+def test_disabled_mode_zero_files_and_identical_metadata(tmp_path):
+    """TPP_TRACE=0: no .runs dir, no trace files — and the metadata trace
+    is byte-identical to a traced run's (tracing never touches the store)."""
+    from test_concurrent_runner import _normalized_store_dump
+
+    dumps = {}
+    for sub, flag in (("on", "1"), ("off", "0")):
+        os.environ["TPP_TRACE"] = flag
+        try:
+            p = _diamond(tmp_path, sleep_s=0.01, subdir=sub)
+            result = LocalDagRunner(max_parallel_nodes=3).run(
+                p, run_id="fixed"
+            )
+            dumps[sub] = _normalized_store_dump(
+                p.metadata_path, p.pipeline_root
+            )
+            runs_dir = os.path.join(p.pipeline_root, ".runs")
+            if flag == "0":
+                assert not os.path.exists(runs_dir)
+            else:
+                assert os.path.exists(
+                    events_path(p.pipeline_root, result.run_id)
+                )
+        finally:
+            os.environ.pop("TPP_TRACE", None)
+    assert dumps["on"] == dumps["off"]
+
+
+# ------------------------------------------------------------ shard spans
+
+
+def test_per_shard_spans_match_fanout_process_pool(tmp_path):
+    """map_shards under an active recorder: one data.shard span per task,
+    across the REAL fork process pool (child pids in the log)."""
+    from tpu_pipelines.data.shard_plan import map_shards
+
+    rec = TraceRecorder(str(tmp_path / "run"), "shardtest")
+    tasks = list(range(4))
+    with activate(rec):
+        out = map_shards(_square, tasks, workers=2)
+    rec.close()
+    assert out == [0, 1, 4, 9]
+    events = read_events(rec.events_path)
+    shard_spans = [e for e in events if e["name"] == "shard"]
+    assert len(shard_spans) == len(tasks)
+    assert sorted(e["args"]["shard"] for e in shard_spans) == [0, 1, 2, 3]
+    assert {e["args"]["label"] for e in shard_spans} == {"map_shards"}
+    pool_span, = [e for e in events if e["name"] == "map_shards"]
+    assert pool_span["args"]["tasks"] == 4
+    if pool_span["args"]["pool"] == "process" and os.cpu_count() > 1:
+        # Fork pool: at least one span was written by a child process.
+        assert {e["pid"] for e in shard_spans} != {pool_span["pid"]}
+    m = compute_metrics(events)
+    pool = m["shard_pools"]["map_shards"]
+    assert pool["count"] == 4
+    assert pool["skew"] is None or pool["skew"] >= 1.0
+
+
+def _square(x):
+    return x * x
+
+
+def test_thread_map_spans_and_no_double_wrap(tmp_path):
+    from tpu_pipelines.data.shard_plan import thread_map
+
+    rec = TraceRecorder(str(tmp_path / "run"), "threadtest")
+    with activate(rec):
+        out = thread_map(_square, [1, 2, 3], workers=3)
+    rec.close()
+    assert out == [1, 4, 9]
+    spans = [
+        e for e in read_events(rec.events_path) if e["name"] == "shard"
+    ]
+    assert len(spans) == 3
+    assert {e["args"]["pool"] for e in spans} == {"thread"}
+
+
+def test_map_shards_untouched_without_recorder():
+    from tpu_pipelines.data.shard_plan import map_shards
+
+    assert map_shards(_square, [1, 2, 3], workers=2) == [1, 4, 9]
+
+
+# ------------------------------------------------- crash + resume appends
+
+
+@pytest.mark.robustness
+def test_crash_leaves_parsable_log_and_resume_appends(tmp_path):
+    from tpu_pipelines.testing.faults import (
+        KILL_ORCHESTRATOR,
+        FaultPlan,
+        NodeFault,
+        SimulatedCrash,
+    )
+
+    p = _diamond(tmp_path, sleep_s=0.01)
+    plan = FaultPlan({"Join": NodeFault(KILL_ORCHESTRATOR)})
+    with plan.activate():
+        with pytest.raises(SimulatedCrash):
+            LocalDagRunner(max_parallel_nodes=3).run(p)
+    runs_dir = os.path.join(p.pipeline_root, ".runs")
+    (crashed_run,) = os.listdir(runs_dir)
+    log_path = os.path.join(runs_dir, crashed_run, "trace", "events.jsonl")
+    events = read_events(log_path)
+    assert any(e["name"] == "run_start" for e in events)
+    done = {
+        e["node"] for e in events
+        if e["name"] == "node" and e["args"]["status"] == "COMPLETE"
+    }
+    assert done == {"Gen", "Left", "Right"}  # crash hit at Join dispatch
+    # Simulate a torn final line (SIGKILL mid-append): still parsable.
+    with open(log_path, "a") as f:
+        f.write('{"ev": "instant", "name": "torn')
+    assert len(read_events(log_path)) == len(events)
+
+    n_before = len(open(log_path).read().splitlines())
+    result = LocalDagRunner(max_parallel_nodes=3).run(
+        _diamond(tmp_path, sleep_s=0.01), resume_from="latest"
+    )
+    assert result.succeeded
+    assert result.run_id == crashed_run  # same run id -> same log, appended
+    events = read_events(log_path)
+    assert len(open(log_path).read().splitlines()) > n_before
+    adopted = {e["node"] for e in events if e["name"] == "resume_adopt"}
+    assert adopted == {"Gen", "Left", "Right"}
+    rerun = [
+        e["node"] for e in events
+        if e["name"] == "node" and e["args"]["status"] == "COMPLETE"
+        and e["node"] == "Join"
+    ]
+    assert rerun == ["Join"]
+    m = compute_metrics(events)
+    assert m["adopted_nodes"] == ["Gen", "Left", "Right"]
+
+
+@pytest.mark.robustness
+def test_deadline_expiry_recorded(tmp_path):
+    from tpu_pipelines.testing.faults import FaultPlan, HANG, NodeFault
+
+    Gen = _stub("Gen", {"examples": "Examples"})
+    Hang = _stub("Hang", {"model": "Model"}, {"examples": "Examples"})
+    gen = Gen()
+    hang = Hang(examples=gen.outputs["examples"]).with_execution_timeout(
+        0.3
+    )
+    p = Pipeline(
+        "deadline", [gen, hang],
+        pipeline_root=str(tmp_path / "root"),
+        metadata_path=str(tmp_path / "md.sqlite"),
+    )
+    plan = FaultPlan({"Hang": NodeFault(HANG, max_hang_s=10)})
+    with plan.activate():
+        result = LocalDagRunner(max_parallel_nodes=2).run(
+            p, raise_on_failure=False
+        )
+    assert result.nodes["Hang"].status == "FAILED"
+    events = _events_of(p, result)
+    (expiry,) = [e for e in events if e["name"] == "deadline_expired"]
+    assert expiry["node"] == "Hang"
+    assert expiry["args"]["deadline_s"] == 0.3
+    m = compute_metrics(events)
+    assert m["deadline_expiries"] == ["Hang"]
+    assert m["per_node"]["Hang"]["status"] == "FAILED"
+
+
+# --------------------------------------------------------- log correlation
+
+
+def test_log_correlation_injects_run_and_node_ids(tmp_path):
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    handler = Capture()
+    logger = logging.getLogger("tpu_pipelines.runner")
+    logger.addHandler(handler)
+    old_level = logger.level
+    logger.setLevel(logging.INFO)
+    try:
+        p = _diamond(tmp_path, sleep_s=0.02)
+        result = LocalDagRunner(max_parallel_nodes=3).run(p)
+    finally:
+        logger.removeHandler(handler)
+        logger.setLevel(old_level)
+    tagged = [r for r in records if getattr(r, "node_id", "")]
+    assert tagged, "no node-attributed records from the concurrent run"
+    assert {r.node_id for r in tagged} >= {"Gen", "Join"}
+    assert all(r.run_id == result.run_id for r in tagged)
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def test_trace_cli_summarize_and_export(tmp_path, capsys):
+    from tpu_pipelines.__main__ import main
+
+    p = _diamond(tmp_path, sleep_s=0.02)
+    LocalDagRunner(max_parallel_nodes=3).run(p)
+    perfetto = str(tmp_path / "out" / "trace.json")
+    metrics = str(tmp_path / "out" / "metrics.json")
+    rc = main([
+        "trace", "latest", "--pipeline-root", p.pipeline_root,
+        "--perfetto", perfetto, "--metrics", metrics,
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "critical path" in out
+    assert "Join" in out and "COMPLETE" in out
+    with open(perfetto) as f:
+        assert json.load(f)["traceEvents"]
+    with open(metrics) as f:
+        m = json.load(f)
+    assert m["critical_path_nodes"][-1] == "Join"
+    assert m["node_count"] == 4
+
+
+def test_trace_cli_missing_trace_fails(tmp_path, capsys):
+    from tpu_pipelines.__main__ import main
+
+    os.environ["TPP_TRACE"] = "0"
+    try:
+        p = _diamond(tmp_path, sleep_s=0.01)
+        LocalDagRunner().run(p)
+    finally:
+        os.environ.pop("TPP_TRACE", None)
+    assert main(["trace", "latest", "--pipeline-root",
+                 p.pipeline_root]) == 1
+
+
+def test_inspect_runs_trace_columns(tmp_path, capsys):
+    from tpu_pipelines.__main__ import main
+
+    p = _diamond(tmp_path, sleep_s=0.02)
+    LocalDagRunner(max_parallel_nodes=3).run(p)
+    rc = main([
+        "inspect", "--metadata", p.metadata_path, "runs", "diamond",
+        "--pipeline-root", p.pipeline_root,
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "queue_s" in out and "dur_s" in out and "state" in out
+    assert "Join" in out and "COMPLETE" in out
+
+
+# -------------------------------------------------- cluster annotations
+
+
+def test_cluster_runner_attaches_trace_annotations(tmp_path):
+    pytest.importorskip("yaml")
+    import yaml
+
+    from tpu_pipelines.observability import export_metrics
+    from tpu_pipelines.orchestration import TPUJobRunner, TPUJobRunnerConfig
+
+    p = _diamond(tmp_path, sleep_s=0.02)
+    result = LocalDagRunner(max_parallel_nodes=3).run(p)
+    metrics_path = str(tmp_path / "metrics.json")
+    export_metrics(_events_of(p, result), metrics_path)
+
+    out = TPUJobRunner(TPUJobRunnerConfig(
+        image="img", pipeline_module="m.py",
+        output_dir=str(tmp_path / "manifests"),
+        trace_metrics_path=metrics_path,
+    )).run(p)
+    with open(out["workflow"]) as f:
+        wf = yaml.safe_load(f)
+    cp = json.loads(
+        wf["metadata"]["annotations"]["tpu-pipelines/trace-critical-path"]
+    )
+    assert cp["nodes"][-1] == "Join" and cp["seconds"] > 0
+    by_name = {t["name"]: t for t in wf["spec"]["templates"]}
+    join = by_name["join"]
+    ann = join["metadata"]["annotations"]
+    assert float(ann["tpu-pipelines/measured-duration-s"]) >= 0
+    assert "tpu-pipelines/measured-queue-wait-s" in ann
+
+
+# --------------------------------------------------------- recorder unit
+
+
+def test_recorder_concurrent_writers_no_torn_lines(tmp_path):
+    rec = TraceRecorder(str(tmp_path / "run"), "hammer")
+
+    def hammer(i):
+        for j in range(200):
+            with rec.span(f"s{i}", cat="test", args={"j": j}):
+                pass
+
+    threads = [threading.Thread(target=hammer, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rec.close()
+    events = read_events(rec.events_path)
+    assert len(events) == 8 * 200
+    raw_lines = [
+        line for line in open(rec.events_path).read().splitlines() if line
+    ]
+    assert len(raw_lines) == len(events)  # every single line parsed
+
+
+def test_recorder_emits_after_close_is_noop(tmp_path):
+    rec = TraceRecorder(str(tmp_path / "run"), "closed")
+    rec.instant("before", cat="test")
+    rec.close()
+    rec.instant("after", cat="test")  # must not raise
+    events = read_events(rec.events_path)
+    assert [e["name"] for e in events] == ["before"]

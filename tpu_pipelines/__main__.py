@@ -1,4 +1,4 @@
-"""Framework CLI: ``python -m tpu_pipelines {run,inspect} ...``.
+"""Framework CLI: ``python -m tpu_pipelines {run,inspect,trace} ...``.
 
 ``run`` — execute a pipeline module locally (the ``tfx run`` /
 LocalDagRunner-notebook equivalent):
@@ -18,6 +18,18 @@ there — and this CLI is the user-facing way to read it back:
 
 Reads the shared SQLite schema directly (works on stores written by either
 the python or the native C++ backend).
+
+``trace`` — summarize/export a run's RunTrace event log
+(docs/OBSERVABILITY.md):
+
+    python -m tpu_pipelines trace latest --pipeline-root /pipe/root
+    python -m tpu_pipelines trace <run-id> --pipeline-root /pipe/root \
+        --perfetto trace.json --metrics metrics.json
+
+Prints the measured run profile (per-node durations, critical path,
+queue/gate waits, cache-hit ratio); ``--perfetto`` writes a Chrome/
+Perfetto-loadable timeline, ``--metrics`` the machine-readable summary
+``bench.py`` and the cluster runner consume.
 """
 
 from __future__ import annotations
@@ -36,7 +48,27 @@ def _fmt_props(props: dict, keys=None) -> str:
     return " ".join(f"{k}={v}" for k, v in items)
 
 
-def cmd_runs(store: MetadataStore, pipeline: str) -> int:
+def _run_trace_metrics(pipeline_root: str, run_id: str) -> dict:
+    """Per-node RunTrace metrics for a run, {} when no trace exists."""
+    if not pipeline_root:
+        return {}
+    import os
+
+    from tpu_pipelines.observability import (
+        compute_metrics,
+        events_path,
+        read_events,
+    )
+
+    path = events_path(pipeline_root, run_id)
+    if not os.path.exists(path):
+        return {}
+    return compute_metrics(read_events(path))
+
+
+def cmd_runs(
+    store: MetadataStore, pipeline: str, pipeline_root: str = ""
+) -> int:
     prefix = f"{pipeline}."
     runs = [
         c for c in store.get_contexts("pipeline_run")
@@ -46,10 +78,21 @@ def cmd_runs(store: MetadataStore, pipeline: str) -> int:
         print(f"no runs recorded for pipeline {pipeline!r}", file=sys.stderr)
         return 1
     for ctx in runs:
-        print(f"run {ctx.name[len(prefix):]}  (context #{ctx.id})")
+        run_id = ctx.properties.get("run_id") or ctx.name[len(prefix):]
+        # Trace-derived per-node columns (queue wait) when the run's
+        # RunTrace log is reachable via --pipeline-root; the metadata
+        # store alone still yields state + duration.
+        trace_nodes = _run_trace_metrics(pipeline_root, run_id).get(
+            "per_node", {}
+        )
+        print(f"run {run_id}  (context #{ctx.id})")
+        header = f"  {'node':<24} {'state':<10} {'dur_s':>9}"
+        if trace_nodes:
+            header += f" {'queue_s':>8}"
+        print(header)
         for ex in store.get_executions_by_context(ctx.id):
             wall = ex.properties.get("wall_clock_s", "")
-            wall_s = f"  {wall}s" if wall != "" else ""
+            dur = f"{wall}s" if wall != "" else "-"
             extra = _fmt_props(
                 ex.properties,
                 keys=(
@@ -57,10 +100,62 @@ def cmd_runs(store: MetadataStore, pipeline: str) -> int:
                     "error",
                 ),
             )
-            print(
-                f"  {ex.node_id or ex.type_name:<24} [{ex.state.value}]"
-                f"{wall_s}  {extra}".rstrip()
+            line = (
+                f"  {ex.node_id or ex.type_name:<24} "
+                f"{ex.state.value:<10} {dur:>9}"
             )
+            if trace_nodes:
+                q = trace_nodes.get(ex.node_id, {}).get("queue_wait_s")
+                line += f" {q if q is not None else '-':>8}"
+            print(f"{line}  {extra}".rstrip())
+    return 0
+
+
+def cmd_trace(args) -> int:
+    import os
+
+    from tpu_pipelines.observability import (
+        compute_metrics,
+        export_metrics,
+        export_perfetto,
+        format_summary,
+        read_events,
+        run_trace_dir,
+    )
+
+    runs_dir = os.path.join(args.pipeline_root, ".runs")
+    run_id = args.run_id
+    if run_id == "latest":
+        candidates = sorted(
+            (d for d in (os.listdir(runs_dir) if os.path.isdir(runs_dir)
+                         else [])
+             if os.path.isdir(os.path.join(runs_dir, d))),
+            key=lambda d: os.path.getmtime(os.path.join(runs_dir, d)),
+        )
+        if not candidates:
+            print(f"no traced runs under {runs_dir}", file=sys.stderr)
+            return 1
+        run_id = candidates[-1]
+    events_file = os.path.join(
+        run_trace_dir(args.pipeline_root, run_id), "trace", "events.jsonl"
+    )
+    if not os.path.exists(events_file):
+        print(f"no trace event log at {events_file} (was the run traced? "
+              "TPP_TRACE=0 disables tracing)", file=sys.stderr)
+        return 1
+    events = read_events(events_file)
+    if not events:
+        print(f"trace event log {events_file} is empty", file=sys.stderr)
+        return 1
+    metrics = compute_metrics(events)
+    print(f"run {run_id}  ({len(events)} events, {events_file})")
+    print(format_summary(metrics))
+    if args.perfetto:
+        path = export_perfetto(events, args.perfetto)
+        print(f"perfetto timeline: {path} (load in https://ui.perfetto.dev)")
+    if args.metrics:
+        path = export_metrics(events, args.metrics)
+        print(f"metrics summary: {path}")
     return 0
 
 
@@ -120,8 +215,22 @@ def main(argv=None) -> int:
     isub = inspect.add_subparsers(dest="what", required=True)
 
     p_runs = isub.add_parser("runs", parents=[md_parent],
-                             help="runs + per-node wall-clocks")
+                             help="runs + per-node duration/state columns")
     p_runs.add_argument("pipeline", help="pipeline name")
+    p_runs.add_argument("--pipeline-root", default="",
+                        help="pipeline root; adds trace-derived columns "
+                             "(queue wait) from <root>/.runs/<id>/trace")
+
+    p_trace = sub.add_parser(
+        "trace", help="summarize/export a run's RunTrace event log"
+    )
+    p_trace.add_argument("run_id", help="run id, or 'latest'")
+    p_trace.add_argument("--pipeline-root", required=True,
+                         help="pipeline root containing .runs/<run-id>/")
+    p_trace.add_argument("--perfetto", default="", metavar="OUT_JSON",
+                         help="write a Chrome/Perfetto trace.json here")
+    p_trace.add_argument("--metrics", default="", metavar="OUT_JSON",
+                         help="write the metrics.json summary here")
 
     p_lin = isub.add_parser("lineage", parents=[md_parent],
                             help="provenance chain of an artifact")
@@ -134,12 +243,14 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.cmd == "run":
         return cmd_run(args)
+    if args.cmd == "trace":
+        return cmd_trace(args)
     if not args.metadata:
         inspect.error("the following arguments are required: --metadata")
     store = MetadataStore(args.metadata)
     try:
         if args.what == "runs":
-            return cmd_runs(store, args.pipeline)
+            return cmd_runs(store, args.pipeline, args.pipeline_root)
         if args.what == "lineage":
             return cmd_lineage(store, args.artifact_id)
         return cmd_artifacts(store, args.type)

@@ -28,6 +28,8 @@ import os
 from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
 from typing import Callable, List, Optional, Sequence, TypeVar
 
+from tpu_pipelines.observability import trace as _obs
+
 ENV_SHARDS = "TPP_DATA_SHARDS"
 # Pool backend override: "process" (default), "thread", or "none"
 # (sequential — the debugging escape hatch).
@@ -84,6 +86,34 @@ def _pool_workers(n_tasks: int, workers: Optional[int]) -> int:
     return max(1, min(n_tasks, os.cpu_count() or 1))
 
 
+class _TracedShardFn:
+    """Picklable per-shard wrapper: one ``data.shard`` span per task.
+
+    Process-pool children inherit the active recorder across fork and
+    reopen the event log on first emit, so the per-shard spans land in
+    the run trace with the CHILD's pid — Perfetto renders each pool
+    worker as its own track.  Wrapping happens only when a recorder is
+    active (map_shards/thread_map enumerate the tasks so every span
+    carries its shard index) and is idempotent, so map_shards' thread
+    fallback never double-wraps.
+    """
+
+    __slots__ = ("fn", "label", "pool")
+
+    def __init__(self, fn: Callable, label: str, pool: str):
+        self.fn = fn
+        self.label = label
+        self.pool = pool
+
+    def __call__(self, indexed):
+        i, task = indexed
+        with _obs.span(
+            "shard", cat="data",
+            args={"label": self.label, "shard": i, "pool": self.pool},
+        ):
+            return self.fn(task)
+
+
 def map_shards(
     fn: Callable[[T], R],
     tasks: Sequence[T],
@@ -99,23 +129,33 @@ def map_shards(
     """
     workers = _pool_workers(len(tasks), workers)
     mode = os.environ.get(ENV_POOL, "process").strip() or "process"
-    if len(tasks) <= 1 or workers <= 1 or mode == "none":
-        return [fn(t) for t in tasks]
-    if mode == "process":
-        try:
-            # fork, explicitly: spawn would re-import the full framework
-            # (and this environment preloads jax into every interpreter)
-            # per worker — seconds of startup against millisecond tasks.
-            ctx = multiprocessing.get_context("fork")
-            with ProcessPoolExecutor(
-                max_workers=workers, mp_context=ctx
-            ) as pool:
-                return list(pool.map(fn, tasks))
-        except (ValueError, OSError):
-            # No fork on this platform / resource limits: threads still
-            # overlap the GIL-releasing Arrow decode.
-            pass
-    return thread_map(fn, tasks, workers=workers)
+    n_tasks = len(tasks)
+    if _obs.active_recorder() is not None and not isinstance(
+        fn, _TracedShardFn
+    ):
+        fn = _TracedShardFn(fn, "map_shards", mode)
+        tasks = list(enumerate(tasks))
+    with _obs.span(
+        "map_shards", cat="data",
+        args={"tasks": n_tasks, "workers": workers, "pool": mode},
+    ):
+        if n_tasks <= 1 or workers <= 1 or mode == "none":
+            return [fn(t) for t in tasks]
+        if mode == "process":
+            try:
+                # fork, explicitly: spawn would re-import the full framework
+                # (and this environment preloads jax into every interpreter)
+                # per worker — seconds of startup against millisecond tasks.
+                ctx = multiprocessing.get_context("fork")
+                with ProcessPoolExecutor(
+                    max_workers=workers, mp_context=ctx
+                ) as pool:
+                    return list(pool.map(fn, tasks))
+            except (ValueError, OSError):
+                # No fork on this platform / resource limits: threads still
+                # overlap the GIL-releasing Arrow decode.
+                pass
+        return thread_map(fn, tasks, workers=workers)
 
 
 def thread_map(
@@ -131,6 +171,11 @@ def thread_map(
     overlap the IO-heavy parts even though pure-Python stretches serialize.
     """
     workers = _pool_workers(len(tasks), workers)
+    if _obs.active_recorder() is not None and not isinstance(
+        fn, _TracedShardFn
+    ):
+        fn = _TracedShardFn(fn, "thread_map", "thread")
+        tasks = list(enumerate(tasks))
     if len(tasks) <= 1 or workers <= 1:
         return [fn(t) for t in tasks]
     with ThreadPoolExecutor(max_workers=workers) as pool:

@@ -30,6 +30,9 @@ from tpu_pipelines.metadata.types import (
     ExecutionState,
     LineageNode,
 )
+# Run-scoped op-latency spans (cat="metadata"); every call is a no-op
+# null context unless a LocalDagRunner run with tracing on is active.
+from tpu_pipelines.observability import trace as _obs
 
 class StoreUnavailableError(RuntimeError):
     """The metadata backend cannot serve a request (build timeout, dead
@@ -189,7 +192,9 @@ class MetadataStore:
 
     def put_execution(self, execution: Execution) -> int:
         execution.update_time = time.time()
-        with self._lock:
+        with _obs.span(
+            "put_execution", cat="metadata", node=execution.node_id
+        ), self._lock:
             if execution.id:
                 self._conn.execute(
                     "UPDATE executions SET type_name=?, node_id=?, state=?, "
@@ -377,7 +382,10 @@ class MetadataStore:
         single SQLite transaction: a crash mid-publish leaves no COMPLETE
         execution without its output events (which would poison the cache).
         """
-        with self._lock:
+        with _obs.span(
+            "publish_execution", cat="metadata", node=execution.node_id,
+            args={"state": execution.state.value},
+        ), self._lock:
             self._in_tx = True
             try:
                 self._publish_locked(
@@ -442,7 +450,7 @@ class MetadataStore:
         accessors, so the native backend inherits it unchanged.
         """
         fenced: List[Execution] = []
-        with self._lock:
+        with _obs.span("sweep_stale_executions", cat="metadata"), self._lock:
             for ex in self.get_executions_by_context(run_context_id):
                 if ex.state != ExecutionState.RUNNING:
                     continue
@@ -464,25 +472,26 @@ class MetadataStore:
         """
         if not cache_key:
             return None
-        exec_id = self._latest_cached_execution_id(cache_key)
-        if not exec_id:
-            return None
-        outputs: Dict[str, List[Artifact]] = {}
-        for ev in self.get_events_by_execution(exec_id):
-            if ev.type != EventType.OUTPUT:
-                continue
-            art = self.get_artifact(ev.artifact_id)
-            if art is None or art.state != ArtifactState.LIVE:
+        with _obs.span("get_cached_outputs", cat="metadata"):
+            exec_id = self._latest_cached_execution_id(cache_key)
+            if not exec_id:
                 return None
-            outputs.setdefault(ev.path, []).append((ev.index, art))
-        if not outputs:
-            # A COMPLETE execution with no recorded outputs is corrupt state
-            # (e.g. interrupted legacy publish), never a usable cache hit.
-            return None
-        return {
-            path: [a for _, a in sorted(pairs, key=lambda p: p[0])]
-            for path, pairs in outputs.items()
-        }
+            outputs: Dict[str, List[Artifact]] = {}
+            for ev in self.get_events_by_execution(exec_id):
+                if ev.type != EventType.OUTPUT:
+                    continue
+                art = self.get_artifact(ev.artifact_id)
+                if art is None or art.state != ArtifactState.LIVE:
+                    return None
+                outputs.setdefault(ev.path, []).append((ev.index, art))
+            if not outputs:
+                # A COMPLETE execution with no recorded outputs is corrupt
+                # state (interrupted legacy publish), never a usable hit.
+                return None
+            return {
+                path: [a for _, a in sorted(pairs, key=lambda p: p[0])]
+                for path, pairs in outputs.items()
+            }
 
     def _latest_cached_execution_id(self, cache_key: str) -> int:
         """Id of the newest COMPLETE execution with this key; 0 = miss."""

@@ -1,0 +1,35 @@
+"""Observability: run-scoped tracing, metrics, Perfetto export.
+
+The run-introspection surface the reference stack delegates to its
+substrate (SURVEY.md §5 — KFP UI run timelines, Stackdriver latencies):
+every layer of a run emits structured span events into one append-only
+JSONL (`<pipeline_root>/.runs/<run_id>/trace/events.jsonl`), and two
+exporters turn it into a Perfetto-loadable ``trace.json`` and a
+``metrics.json`` summary (measured critical path, queue/gate waits,
+cache-hit ratio, shard skew).  ``TPP_TRACE=0`` disables everything;
+see docs/OBSERVABILITY.md.
+"""
+
+from tpu_pipelines.observability.trace import (  # noqa: F401
+    ENV_TRACE,
+    RunContextFilter,
+    TraceRecorder,
+    activate,
+    active_recorder,
+    events_path,
+    install_log_correlation,
+    instant,
+    node_log_context,
+    run_trace_dir,
+    set_run_id,
+    span,
+    trace_enabled,
+)
+from tpu_pipelines.observability.export import (  # noqa: F401
+    compute_metrics,
+    export_metrics,
+    export_perfetto,
+    format_summary,
+    read_events,
+    to_perfetto,
+)

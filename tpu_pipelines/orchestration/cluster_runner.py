@@ -101,6 +101,13 @@ class TPUJobRunnerConfig:
     # pipeline's paths (e.g. a GCS FUSE sidecar or bucket mount).
     shared_volume_claim: str = ""
     shared_mount_path: str = "/pipeline"
+    # Path to a prior run's RunTrace metrics.json (observability/export.py
+    # or `python -m tpu_pipelines trace <run_id> --metrics ...`).  When
+    # set, each node template carries the measured duration / queue wait
+    # as annotations and the Workflow carries the measured critical path —
+    # the profile operators read to size parallelism, deadlines, and
+    # preemption budgets without re-running the pipeline.
+    trace_metrics_path: str = ""
 
 
 class TPUJobRunner:
@@ -210,9 +217,28 @@ class TPUJobRunner:
             "--shard-dir", self._tuner_shard_dir(ir, node_id),
         ]
 
+    def _load_trace_metrics(self) -> Dict[str, Any]:
+        """Prior-run RunTrace metrics, {} when not configured.
+
+        A configured-but-unreadable path is a compile-time error: silently
+        emitting un-annotated manifests would defeat the reason the
+        operator pointed at a profile."""
+        path = self.config.trace_metrics_path
+        if not path:
+            return {}
+        with open(path, "r", encoding="utf-8") as f:
+            metrics = json.load(f)
+        if not isinstance(metrics, dict):
+            raise ValueError(
+                f"trace_metrics_path {path!r} is not a metrics.json object"
+            )
+        return metrics
+
     def _workflow(self, ir: PipelineIR) -> Dict[str, Any]:
         cfg = self.config
         name = k8s_name(cfg.workflow_name or ir.name)
+        trace_metrics = self._load_trace_metrics()
+        trace_per_node = trace_metrics.get("per_node", {})
         tasks = []
         for node in ir.nodes:
             task: Dict[str, Any] = {
@@ -330,6 +356,18 @@ class TPUJobRunner:
                 tpl["synchronization"] = {
                     "mutex": {"name": f"{name}-tpu"}
                 }
+            info = trace_per_node.get(node.id)
+            if info:
+                # Measured profile from the prior run's trace: what this
+                # node actually cost, on the template the operator reads.
+                tpl.setdefault("metadata", {}).setdefault(
+                    "annotations", {}
+                ).update({
+                    "tpu-pipelines/measured-duration-s":
+                        str(info.get("wall_s", "")),
+                    "tpu-pipelines/measured-queue-wait-s":
+                        str(info.get("queue_wait_s", "")),
+                })
             templates.append(tpl)
         spec: Dict[str, Any] = {
             "entrypoint": "pipeline-dag",
@@ -355,6 +393,19 @@ class TPUJobRunner:
                 "annotations": {
                     "tpu-pipelines/stage-groups": json.dumps(
                         ir.topo_levels()
+                    ),
+                    **(
+                        {
+                            "tpu-pipelines/trace-critical-path": json.dumps({
+                                "nodes": trace_metrics.get(
+                                    "critical_path_nodes", []
+                                ),
+                                "seconds": trace_metrics.get(
+                                    "critical_path_measured_s", 0.0
+                                ),
+                            }),
+                        }
+                        if trace_metrics else {}
                     ),
                 },
             },

@@ -56,6 +56,7 @@ runner writes to the store during a run.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
 import os
@@ -82,6 +83,7 @@ from tpu_pipelines.metadata.types import (
     Execution,
     ExecutionState,
 )
+from tpu_pipelines.observability import trace as _trace
 from tpu_pipelines.testing import faults as _faults
 from tpu_pipelines.utils.fingerprint import execution_cache_key, fingerprint_dir
 from tpu_pipelines.utils.span import has_span_pattern, resolve_span_pattern
@@ -349,6 +351,19 @@ class LocalDagRunner:
             store.put_context(pipeline_ctx)
             store.put_context(run_ctx)
 
+            # RunTrace (observability/): run-scoped span log.  Off under
+            # spmd_sync — every process would append to the same shared
+            # file — and under TPP_TRACE=0 (nothing is even created).  A
+            # resumed run reuses the prior run_id and so APPENDS to the
+            # crashed run's event log.
+            recorder = None
+            if not self.spmd_sync:
+                _trace.install_log_correlation()
+                _trace.set_run_id(run_id)
+                recorder = _trace.TraceRecorder.maybe_create(
+                    _trace.run_trace_dir(ir.pipeline_root, run_id), run_id
+                )
+
             selected = self._select_nodes(ir, from_nodes, to_nodes)
             if self.spmd_sync and len(selected) != 1:
                 # Per-node collective counts must be identical on every
@@ -374,6 +389,15 @@ class LocalDagRunner:
                         adopted[node.id], produced, failed_upstream,
                         cond_skipped, result,
                     )
+                    if recorder:
+                        nr = adopted[node.id]
+                        recorder.instant(
+                            "resume_adopt", cat="run", node=node.id,
+                            args={
+                                "status": nr.status,
+                                "execution_id": nr.execution_id,
+                            },
+                        )
 
             max_parallel = self._effective_parallelism(ir)
             result.max_parallel_nodes = max_parallel
@@ -397,21 +421,45 @@ class LocalDagRunner:
             # .py).  spmd_sync always stays sequential: its collectives
             # require every process to take identical branches in identical
             # order.
-            if not self.spmd_sync and (
-                max_parallel > 1
-                or has_deadlines
-                or os.environ.get("TPP_FORCE_SCHEDULER") == "1"
-            ):
-                self._run_nodes_concurrent(max_workers=max_parallel, **shared)
-            else:
-                if has_deadlines and self.spmd_sync:
-                    log.warning(
-                        "execution_timeout_s is not enforced under spmd_sync"
-                        " (the schedule must stay collective-deterministic);"
-                        " rely on the substrate deadline"
-                        " (activeDeadlineSeconds)"
+            if recorder:
+                recorder.instant(
+                    "run_start", cat="run",
+                    args={
+                        "pipeline": pipeline.name,
+                        "max_parallel_nodes": max_parallel,
+                        "resume_from": resume_from or "",
+                        "adopted": sorted(adopted),
+                        "dag_fingerprint": dag_fp,
+                    },
+                )
+            try:
+                with _trace.activate(recorder):
+                    if not self.spmd_sync and (
+                        max_parallel > 1
+                        or has_deadlines
+                        or os.environ.get("TPP_FORCE_SCHEDULER") == "1"
+                    ):
+                        self._run_nodes_concurrent(
+                            max_workers=max_parallel, **shared
+                        )
+                    else:
+                        if has_deadlines and self.spmd_sync:
+                            log.warning(
+                                "execution_timeout_s is not enforced under"
+                                " spmd_sync (the schedule must stay"
+                                " collective-deterministic); rely on the"
+                                " substrate deadline"
+                                " (activeDeadlineSeconds)"
+                            )
+                        self._run_nodes_sequential(**shared)
+                if recorder:
+                    recorder.instant(
+                        "run_end", cat="run",
+                        args={"succeeded": result.succeeded},
                     )
-                self._run_nodes_sequential(**shared)
+            finally:
+                if recorder:
+                    recorder.close()
         finally:
             store.close()
         if raise_on_failure and not result.succeeded:
@@ -745,9 +793,11 @@ class LocalDagRunner:
         extras, enable_cache,
     ) -> None:
         """The classic strict-topo-order loop (spmd_sync and pool size 1)."""
+        rec = _trace.active_recorder()
         for node in ir.nodes:
             if node.id in result.nodes:
                 continue  # adopted by resume_from before scheduling began
+            t0_wall, t0_mono = time.time(), time.monotonic()
             try:
                 node_result = self._control_outcome(
                     store, node, selected, produced, failed_upstream,
@@ -771,6 +821,19 @@ class LocalDagRunner:
             self._settle(
                 node_result, produced, failed_upstream, cond_skipped, result
             )
+            if rec:
+                rec.complete(
+                    "node", "scheduler", node.id, t0_wall, t0_mono,
+                    time.monotonic() - t0_mono,
+                    args={
+                        "status": node_result.status,
+                        "execution_id": node_result.execution_id,
+                        "retries": node_result.retries,
+                        "queue_wait_s": 0.0,
+                        "gate_wait_s": 0.0,
+                        "upstream": list(node.upstream),
+                    },
+                )
 
     def _run_nodes_concurrent(
         self, *, store, ir, executors, selected, produced, failed_upstream,
@@ -798,6 +861,32 @@ class LocalDagRunner:
         settled: set = set(result.nodes)
         in_flight: set = set()
         in_flight_plans: Dict[str, _LaunchPlan] = {}
+        rec = _trace.active_recorder()
+        # Trace bookkeeping: when a node became READY (all upstreams
+        # settled), when it first blocked on the tpu chip gate, and when
+        # it was actually dispatched — queue wait and gate wait are the
+        # differences, the per-node span runs dispatch -> settle.
+        ready_at: Dict[str, tuple] = {}        # nid -> (wall, mono)
+        gate_blocked_at: Dict[str, float] = {}  # nid -> mono
+        dispatch_info: Dict[str, tuple] = {}    # nid -> (wall, mono, qw, gw)
+
+        def emit_node(nr: NodeResult, t0: tuple, queue_wait: float,
+                      gate_wait: float) -> None:
+            if rec is None:
+                return
+            wall0, mono0 = t0
+            rec.complete(
+                "node", "scheduler", nr.node_id, wall0, mono0,
+                time.monotonic() - mono0,
+                args={
+                    "status": nr.status,
+                    "execution_id": nr.execution_id,
+                    "retries": nr.retries,
+                    "queue_wait_s": round(queue_wait, 6),
+                    "gate_wait_s": round(gate_wait, 6),
+                    "upstream": list(by_id[nr.node_id].upstream),
+                },
+            )
         # node_id -> absolute monotonic deadline for in-flight timed nodes.
         deadlines: Dict[str, float] = {}
         # Nodes settled FAILED(timeout) by the watchdog whose worker thread
@@ -808,9 +897,14 @@ class LocalDagRunner:
 
         def worker(plan: _LaunchPlan, node_extras: Dict[str, Any]) -> None:
             try:
-                nr = self._execute_and_publish(
-                    store, plan, node_extras, publish_lock
-                )
+                # Worker threads have fresh contextvar contexts: stamp the
+                # run/node ids so this thread's log records are attributable.
+                with _trace.node_log_context(
+                    plan.node.id, rec.run_id if rec else ""
+                ):
+                    nr = self._execute_and_publish(
+                        store, plan, node_extras, publish_lock
+                    )
             except _faults.SimulatedCrash as crash:
                 # Forward the injected orchestrator death to the scheduler
                 # thread, which re-raises it (the whole process "dies").
@@ -844,6 +938,8 @@ class LocalDagRunner:
                     node = by_id[nid]
                     if any(u not in settled for u in node.upstream):
                         continue
+                    if nid not in ready_at:
+                        ready_at[nid] = (time.time(), time.monotonic())
                     try:
                         nr = self._control_outcome(
                             store, node, selected, produced, failed_upstream,
@@ -863,11 +959,22 @@ class LocalDagRunner:
                         unprocessed.remove(nid)
                         settled.add(nid)
                         progressed = True
+                        emit_node(nr, ready_at[nid], 0.0, 0.0)
                         continue
                     if len(in_flight) >= max_workers:
                         continue  # no slot; later control-only nodes may settle
                     if node.resource_class == "tpu" and tpu_in_flight:
-                        continue  # chip busy; host nodes may still dispatch
+                        # chip busy; host nodes may still dispatch
+                        gate_blocked_at.setdefault(nid, time.monotonic())
+                        continue
+                    dispatch_wall, dispatch_mono = (
+                        time.time(), time.monotonic()
+                    )
+                    queue_wait = dispatch_mono - ready_at[nid][1]
+                    gate_wait = (
+                        dispatch_mono - gate_blocked_at.pop(nid)
+                        if nid in gate_blocked_at else 0.0
+                    )
                     try:
                         prepared = self._prepare_node(
                             store, ir, node, executors[nid], produced,
@@ -892,9 +999,16 @@ class LocalDagRunner:
                             cond_skipped, result,
                         )
                         settled.add(nid)
+                        emit_node(
+                            prepared, (dispatch_wall, dispatch_mono),
+                            queue_wait, gate_wait,
+                        )
                         continue
                     in_flight.add(nid)
                     in_flight_plans[nid] = prepared
+                    dispatch_info[nid] = (
+                        dispatch_wall, dispatch_mono, queue_wait, gate_wait
+                    )
                     if prepared.deadline_s > 0:
                         deadlines[nid] = (
                             time.monotonic() + prepared.deadline_s
@@ -945,6 +1059,8 @@ class LocalDagRunner:
                             cond_skipped, result,
                         )
                         settled.add(nid)
+                        dw, dm, qw, gw = dispatch_info.pop(nid)
+                        emit_node(expired, (dw, dm), qw, gw)
                     continue
                 if isinstance(item, BaseException):
                     raise item  # forwarded SimulatedCrash
@@ -963,6 +1079,8 @@ class LocalDagRunner:
                     nr, produced, failed_upstream, cond_skipped, result
                 )
                 settled.add(nr.node_id)
+                dw, dm, qw, gw = dispatch_info.pop(nr.node_id)
+                emit_node(nr, (dw, dm), qw, gw)
         finally:
             # Release every cooperative hang, give timed-out workers a short
             # grace to drain, then shut down — without blocking forever on a
@@ -1026,6 +1144,10 @@ class LocalDagRunner:
                     "timeout: %s", node.id, e,
                 )
         log.warning("node %s: %s", node.id, error)
+        _trace.instant(
+            "deadline_expired", cat="scheduler", node=node.id,
+            args={"deadline_s": plan.deadline_s, "execution_id": ex.id},
+        )
         return NodeResult(
             node_id=node.id, status="FAILED", execution_id=ex.id,
             error=error, wall_clock_s=wall,
@@ -1148,9 +1270,10 @@ class LocalDagRunner:
         )
         if isinstance(prepared, NodeResult):
             return prepared
-        return self._execute_and_publish(
-            store, prepared, extras, publish_lock=None
-        )
+        with _trace.node_log_context(node.id):
+            return self._execute_and_publish(
+                store, prepared, extras, publish_lock=None
+            )
 
     def _prepare_node(
         self,
@@ -1174,6 +1297,20 @@ class LocalDagRunner:
         # Fault hook: kill-orchestrator-at-node-N fires here, in the
         # scheduler thread, before any state for this node is registered.
         _faults.at_dispatch(node.id)
+        with contextlib.ExitStack() as stack:
+            stack.enter_context(_trace.node_log_context(node.id))
+            stack.enter_context(
+                _trace.span("driver", cat="scheduler", node=node.id)
+            )
+            return self._prepare_node_inner(
+                store, ir, node, component, produced, runtime_parameters,
+                contexts, enable_cache, publish_lock, t0,
+            )
+
+    def _prepare_node_inner(
+        self, store, ir, node, component, produced, runtime_parameters,
+        contexts, enable_cache, publish_lock, t0,
+    ):
         node_ctx = Context("node", f"{ir.name}.{node.id}")
         with _maybe_locked(publish_lock):
             store.put_context(node_ctx)
@@ -1271,6 +1408,10 @@ class LocalDagRunner:
             with _maybe_locked(publish_lock):
                 store.publish_execution(ex, inputs, cached, all_ctx)
             log.info("node %s: cache hit (execution %d)", node.id, ex.id)
+            _trace.instant(
+                "cache_hit", cat="scheduler", node=node.id,
+                args={"execution_id": ex.id},
+            )
             return NodeResult(
                 node_id=node.id,
                 status="CACHED",
@@ -1280,6 +1421,8 @@ class LocalDagRunner:
             )
 
         # ---- LAUNCHER: register execution, allocate outputs, run executor
+        if enable_cache:
+            _trace.instant("cache_miss", cat="scheduler", node=node.id)
         ex = Execution(
             type_name=node.component_type,
             node_id=node.id,
@@ -1379,9 +1522,14 @@ class LocalDagRunner:
                         tmp_dir=tmp,
                         extras=extras,
                     )
-                    # Fault hook: raise-in-executor / cooperative hang.
-                    _faults.in_executor(node.id, plan.cancel)
-                    ret = executor(ctx)
+                    with _trace.span(
+                        "executor", cat="executor", node=node.id,
+                        args={"attempt": attempts},
+                    ) as tsp:
+                        # Fault hook: raise-in-executor / cooperative hang.
+                        _faults.in_executor(node.id, plan.cancel)
+                        ret = executor(ctx)
+                        tsp["ok"] = True
                     extra_props = dict(ret or {})
                     error = ""
                     break
@@ -1447,12 +1595,13 @@ class LocalDagRunner:
         # Fault hook: crash-after-success-before-publish (the state a resume
         # must fence: RUNNING execution + written payload dirs, no events).
         _faults.before_publish(node.id)
-        for arts in outputs.values():
-            for a in arts:
-                a.fingerprint = (
-                    external_fps.get(os.path.abspath(a.uri))
-                    or fingerprint_dir(a.uri)
-                )
+        with _trace.span("fingerprint", cat="executor", node=node.id):
+            for arts in outputs.values():
+                for a in arts:
+                    a.fingerprint = (
+                        external_fps.get(os.path.abspath(a.uri))
+                        or fingerprint_dir(a.uri)
+                    )
         ex.state = ExecutionState.COMPLETE
         publish_err = self._publish_fenced(store, plan, publish_lock)
         if publish_err is not None:
@@ -1494,7 +1643,10 @@ class LocalDagRunner:
         backend is unavailable (the caller records a node failure), else
         None."""
         try:
-            with _maybe_locked(publish_lock):
+            with _trace.span(
+                "publish", cat="executor", node=plan.node.id,
+                args={"state": plan.execution.state.value},
+            ), _maybe_locked(publish_lock):
                 if plan.fenced.is_set():
                     return None  # watchdog already published FAILED(timeout)
                 plan.published.set()

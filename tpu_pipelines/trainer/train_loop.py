@@ -644,6 +644,24 @@ def train_loop(
         round(max(0.0, 1.0 - input_wait_s / elapsed), 4)
         if examples_after_t0 else 1.0
     )
+    # Bridge the goodput/badput decomposition into the run trace (no-op
+    # outside a traced pipeline run): the run-wide profile then carries
+    # the same algebra trainer/goodput.py computes for the train loop.
+    from tpu_pipelines.observability import trace as _obs
+
+    _obs.instant(
+        "goodput_summary", cat="trainer",
+        args={
+            "goodput": gsum.get("goodput", proxy_goodput),
+            "source": (
+                "ml_goodput_measurement" if gsum
+                else "host_input_wait_proxy"
+            ),
+            "badput": gsum.get("badput", {}),
+            "goodput_post_compile": proxy_goodput,
+            "steps_completed": step,
+        },
+    )
     result = TrainResult(
         final_metrics=final_metrics,
         examples_per_sec=round(eps, 2),
