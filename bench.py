@@ -841,6 +841,9 @@ def _trace_summary(pipeline_root: str, run_id: str):
         m = compute_metrics(events)
         return {
             "events": len(events),
+            # Full per-node profile: what `trace diff` (and the bench's
+            # own previous-run regression self-report) consumes.
+            "per_node": m["per_node"],
             "critical_path_measured_s": m["critical_path_measured_s"],
             "critical_path_nodes": m["critical_path_nodes"],
             "span_duration_total_s": m["span_duration_total_s"],
@@ -970,6 +973,194 @@ def bench_e2e_bert(smoke: bool) -> dict:
     if smoke:
         env["BERT_TINY"] = "1"
     return _run_example_pipeline("bert", env)
+
+
+def _parse_prom_histogram(text: str, name: str, label_filter: str = ""):
+    """Parse one histogram family out of a Prometheus text scrape:
+    returns {"bounds": [...], "buckets": [per-bucket counts + overflow],
+    "count": n, "sum": s} or None.  Deliberately reads the EXPOSITION,
+    not the in-process registry — the bench certifies what a real
+    Prometheus would ingest."""
+    import re
+
+    pairs = []  # (le, cumulative)
+    count = total = None
+    for line in text.splitlines():
+        if not line.startswith(name) or (
+            label_filter and label_filter not in line
+        ):
+            continue
+        m = re.match(
+            rf'{re.escape(name)}_bucket{{.*le="([^"]+)".*}} (\S+)', line
+        )
+        if m:
+            le = float("inf") if m.group(1) == "+Inf" else float(m.group(1))
+            pairs.append((le, float(m.group(2))))
+            continue
+        m = re.match(rf"{re.escape(name)}_count(?:{{.*}})? (\S+)", line)
+        if m:
+            count = float(m.group(1))
+            continue
+        m = re.match(rf"{re.escape(name)}_sum(?:{{.*}})? (\S+)", line)
+        if m:
+            total = float(m.group(1))
+    if not pairs or count is None:
+        return None
+    pairs.sort(key=lambda p: p[0])
+    bounds = [le for le, _ in pairs if le != float("inf")]
+    cum = [c for _, c in pairs]
+    buckets = [cum[0]] + [b - a for a, b in zip(cum, cum[1:])]
+    return {
+        "bounds": bounds,
+        "buckets": buckets,
+        "count": int(count),
+        "sum": total or 0.0,
+    }
+
+
+def bench_serving(smoke: bool) -> dict:
+    """Live-serving telemetry leg: a ModelServer (micro-batching on) over
+    a toy exported payload, hammered with concurrent REST predicts, then
+    judged from its OWN ``/metrics`` scrape — p50/p99 request latency
+    come out of the Prometheus histogram a real scraper would ingest,
+    and ``/healthz`` must report healthy under load.  The model is a
+    3x2 matmul on purpose: the leg measures the serving pipeline
+    (HTTP + JSON + micro-batcher + dispatch), not the network."""
+    import tempfile
+    import threading
+    import urllib.request
+
+    from tpu_pipelines.observability.metrics import histogram_quantile
+    from tpu_pipelines.serving import ModelServer
+    from tpu_pipelines.trainer.export import export_model
+
+    n_threads = 4
+    n_requests = 80 if smoke else 800
+    with tempfile.TemporaryDirectory() as td:
+        module = os.path.join(td, "toy_model.py")
+        with open(module, "w") as f:
+            f.write(
+                "import jax.numpy as jnp\n"
+                "def build_model(hp):\n"
+                "    return None\n"
+                "def apply_fn(model, params, batch):\n"
+                "    return jnp.asarray(batch['x'], jnp.float32) "
+                "@ params['w']\n"
+            )
+        export_model(
+            serving_model_dir=os.path.join(td, "m", "1"),
+            params={"w": np.eye(3, 2).astype(np.float32)},
+            module_file=module,
+        )
+        server = ModelServer(
+            "bench", os.path.join(td, "m"), batching=True,
+            max_batch_size=16, batch_timeout_s=0.002,
+        )
+        port = server.start()
+        url = f"http://127.0.0.1:{port}/v1/models/bench:predict"
+        body = json.dumps(
+            {"instances": [{"x": [1.0, 2.0, 3.0]}]}
+        ).encode()
+        errors = [0]
+
+        def fire(n: int) -> None:
+            for _ in range(n):
+                try:
+                    req = urllib.request.Request(url, data=body)
+                    with urllib.request.urlopen(req, timeout=30) as r:
+                        r.read()
+                except Exception:  # noqa: BLE001 — counted, not raised
+                    errors[0] += 1
+
+        try:
+            fire(3)  # warm-up: first-bucket XLA compile out of the tail
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=fire, args=(n_requests // n_threads,))
+                for _ in range(n_threads)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ) as r:
+                scrape = r.read().decode()
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10
+            ) as r:
+                health = json.loads(r.read())
+        finally:
+            server.stop()
+    hist = _parse_prom_histogram(
+        scrape, "serving_request_latency_seconds", 'endpoint="predict"'
+    )
+    p50 = p99 = None
+    if hist:
+        series = {"buckets": hist["buckets"], "count": hist["count"],
+                  "sum": hist["sum"]}
+        p50 = histogram_quantile(series, 0.50, hist["bounds"])
+        p99 = histogram_quantile(series, 0.99, hist["bounds"])
+    served = int(hist["count"]) if hist else 0
+    return {
+        "green": (
+            errors[0] == 0 and bool(health.get("healthy"))
+            and served >= n_requests and p99 is not None
+        ),
+        "requests": n_requests + 3,
+        "request_errors": errors[0],
+        "scraped_requests": served,
+        "qps": round(n_requests / wall, 1) if wall else None,
+        "p50_ms": round(p50 * 1e3, 3) if p50 is not None else None,
+        "p99_ms": round(p99 * 1e3, 3) if p99 is not None else None,
+        "mean_ms": (
+            round(hist["sum"] / hist["count"] * 1e3, 3)
+            if hist and hist["count"] else None
+        ),
+        "healthz": health,
+        "concurrency": n_threads,
+    }
+
+
+def _trace_regression_report(prev_report, report: dict, smoke: bool) -> dict:
+    """Self-report regressions vs the PREVIOUS bench run: diff the taxi
+    e2e leg's trace-derived per-node profile against the one the prior
+    run left in BENCH_PARTIAL.json (same smoke mode only — 4-step smoke
+    walls are not comparable to 200-step full walls).  Advisory, not a
+    gate: the flags land in the report and the compact line."""
+    from tpu_pipelines.observability import diff_metrics
+
+    def taxi_trace(rep):
+        if not isinstance(rep, dict):
+            return None
+        tr = ((rep.get("pipeline_e2e") or {}).get("taxi") or {}).get("trace")
+        return tr if isinstance(tr, dict) and tr.get("per_node") else None
+
+    cur = taxi_trace(report)
+    out: dict = {
+        "baseline": None,
+        "regression_flags": [],
+        "threshold": 0.25,
+    }
+    if cur is None:
+        out["note"] = "no current taxi trace to diff"
+        return out
+    prev = taxi_trace(prev_report)
+    if prev is None:
+        out["note"] = "no prior bench trace (first run, or crashed prior)"
+        return out
+    if bool(prev_report.get("smoke")) != smoke:
+        out["note"] = "prior bench ran in a different smoke mode"
+        return out
+    diff = diff_metrics(prev, cur, threshold=out["threshold"])
+    out["baseline"] = "BENCH_PARTIAL.json (previous run)"
+    out["regression_flags"] = diff["regression_flags"]
+    out["regressed"] = diff["regressed"]
+    out["critical_path_delta_frac"] = diff["critical_path_delta_frac"]
+    out["diff"] = diff
+    return out
 
 
 def bench_robustness(smoke: bool) -> dict:
@@ -1558,6 +1749,17 @@ def _compact(report: dict) -> dict:
     if isinstance(dp, dict) and "green" in dp:
         compact["data_plane_green"] = bool(dp.get("green"))
         compact["shard_speedup"] = dp.get("speedup_ingest_stats")
+    # Live-telemetry headline: serving tail latency off the scraped
+    # /metrics histogram, and the previous-run trace-diff verdict.
+    sv = report.get("serving")
+    if isinstance(sv, dict) and "green" in sv:
+        compact["serving_green"] = bool(sv.get("green"))
+        compact["serving_p99_ms"] = sv.get("p99_ms")
+    td = report.get("trace_diff")
+    if isinstance(td, dict):
+        # Capped: the compact line must stay under the driver-tail budget
+        # even if every node regressed.
+        compact["regression_flags"] = td.get("regression_flags", [])[:8]
     if "terminated" in report:
         compact["terminated"] = report["terminated"]
     return compact
@@ -1592,6 +1794,15 @@ def main() -> None:
     os.environ.setdefault("TPP_COMPILE_CACHE", "0")
 
     smoke = bool(int(os.environ.get("BENCH_SMOKE", "0")))
+    # The PREVIOUS bench run's full report, read before the first flush
+    # overwrites it: the baseline for the trace-diff regression
+    # self-report (see _trace_regression_report).
+    prev_report = None
+    try:
+        with open(PARTIAL_FILE) as f:
+            prev_report = json.load(f)
+    except (OSError, ValueError):
+        prev_report = None
     # 1300 s fits the full round-5 leg set (measured 964 s end to end);
     # overrunning an external timeout is survivable anyway — flagship legs
     # run first, every flush prints a compact parseable stdout line, and
@@ -1703,6 +1914,16 @@ def main() -> None:
     # Runs the DAG three times (cold headline + warm trace-on/off pair
     # for the tracing-overhead bound).
     e2e_leg("taxi", bench_e2e_taxi, est_cost_s=260)
+    # Cross-run regression self-report: diff this run's taxi trace
+    # profile against the previous bench run's (advisory flags on the
+    # compact line; `trace diff` is the operator-facing equivalent).
+    report["trace_diff"] = _trace_regression_report(
+        prev_report, report, smoke
+    )
+    _flush(report)
+    # Live serving telemetry: tail latency from the server's own
+    # /metrics scrape + /healthz under concurrent load.
+    leg("serving", bench_serving, est_cost_s=60, retries=1)
     # Wall-clock head of the BASELINE metric: the same taxi DAG sequential
     # vs concurrent, identical-lineage checked (see bench_e2e_taxi_sched).
     e2e_leg("taxi_sched", bench_e2e_taxi_sched, est_cost_s=240)

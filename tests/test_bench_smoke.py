@@ -149,6 +149,27 @@ def test_bench_smoke_emits_compact_stdout_and_full_report():
     assert "TPP_DATA_SHARDS" in dp_conf["default_shard_policy"]
     # And the compact line carries the data-plane verdict.
     assert compact["data_plane_green"] is True
+    # Live-telemetry serving leg (ISSUE 5): tail latency read off the
+    # server's OWN /metrics scrape (Prometheus histogram), healthy under
+    # concurrent load, and surfaced on the compact line.
+    sv = report["serving"]
+    assert sv["green"] is True, sv
+    assert sv["p99_ms"] > 0 and sv["p50_ms"] > 0
+    assert sv["p99_ms"] >= sv["p50_ms"]
+    assert sv["request_errors"] == 0
+    assert sv["healthz"]["healthy"] is True
+    assert compact["serving_green"] is True
+    assert compact["serving_p99_ms"] == sv["p99_ms"]
+    # Cross-run trace-diff self-report: the key is always present and
+    # list-typed (first run against a foreign/absent baseline => []).
+    td = report["trace_diff"]
+    assert isinstance(td["regression_flags"], list)
+    assert isinstance(compact["regression_flags"], list)
+    assert compact["regression_flags"] == td["regression_flags"][:8]
+    # The taxi trace carries the per-node profile `trace diff` consumes.
+    assert tr["per_node"] and all(
+        "wall_s" in v for v in tr["per_node"].values()
+    )
     # The A100 comparison point is pinned with provenance (auditable ratio).
     ref = report["a100_reference"]
     assert ref["ex_per_sec"] > 0
@@ -176,3 +197,7 @@ def test_bench_budget_skips_but_emits():
     assert report["pipeline_e2e"]["bert"]["skipped_budget"] is True
     assert report["data_plane"]["skipped_budget"] is True
     assert "data_plane" in compact["skipped"]
+    assert "serving" in compact["skipped"]
+    # No taxi leg ran, so the trace-diff self-report degrades to empty
+    # flags (never a crash, never a missing key).
+    assert compact["regression_flags"] == []

@@ -195,10 +195,13 @@ def test_perfetto_export_schema_valid(tmp_path):
 
 
 def test_disabled_mode_zero_files_and_identical_metadata(tmp_path):
-    """TPP_TRACE=0: no .runs dir, no trace files — and the metadata trace
-    is byte-identical to a traced run's (tracing never touches the store)."""
+    """TPP_TRACE=0 + no TPP_METRICS_PORT: no .runs dir, no trace files,
+    no extra files of any kind, no metrics listener — and the metadata
+    trace is byte-identical to a traced run's (tracing and telemetry
+    never touch the store)."""
     from test_concurrent_runner import _normalized_store_dump
 
+    assert "TPP_METRICS_PORT" not in os.environ
     dumps = {}
     for sub, flag in (("on", "1"), ("off", "0")):
         os.environ["TPP_TRACE"] = flag
@@ -213,6 +216,14 @@ def test_disabled_mode_zero_files_and_identical_metadata(tmp_path):
             runs_dir = os.path.join(p.pipeline_root, ".runs")
             if flag == "0":
                 assert not os.path.exists(runs_dir)
+                # Zero-footprint contract for the disabled run: exactly
+                # the component payloads + the store, nothing else —
+                # in-memory gauges must not grow a sidecar file.
+                entries = sorted(os.listdir(tmp_path / sub))
+                assert entries == ["md.sqlite", "root"]
+                assert sorted(os.listdir(tmp_path / sub / "root")) == [
+                    "Gen", "Join", "Left", "Right",
+                ]
             else:
                 assert os.path.exists(
                     events_path(p.pipeline_root, result.run_id)

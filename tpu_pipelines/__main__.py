@@ -19,17 +19,24 @@ there — and this CLI is the user-facing way to read it back:
 Reads the shared SQLite schema directly (works on stores written by either
 the python or the native C++ backend).
 
-``trace`` — summarize/export a run's RunTrace event log
+``trace`` — summarize/export/compare a run's RunTrace event log
 (docs/OBSERVABILITY.md):
 
     python -m tpu_pipelines trace latest --pipeline-root /pipe/root
     python -m tpu_pipelines trace <run-id> --pipeline-root /pipe/root \
         --perfetto trace.json --metrics metrics.json
+    python -m tpu_pipelines trace diff <run-a> <run-b> \
+        --pipeline-root /pipe/root [--threshold 0.2]
 
 Prints the measured run profile (per-node durations, critical path,
 queue/gate waits, cache-hit ratio); ``--perfetto`` writes a Chrome/
 Perfetto-loadable timeline, ``--metrics`` the machine-readable summary
-``bench.py`` and the cluster runner consume.
+``bench.py`` and the cluster runner consume.  ``trace diff`` compares
+two runs node by node (baseline first) and exits 3 when any node or the
+critical path regressed past the threshold — the CI tripwire.
+
+``--json`` on ``trace``, ``trace diff``, and ``inspect runs`` switches
+the table output to machine-readable JSON for scripts.
 """
 
 from __future__ import annotations
@@ -67,8 +74,13 @@ def _run_trace_metrics(pipeline_root: str, run_id: str) -> dict:
 
 
 def cmd_runs(
-    store: MetadataStore, pipeline: str, pipeline_root: str = ""
+    store: MetadataStore,
+    pipeline: str,
+    pipeline_root: str = "",
+    as_json: bool = False,
 ) -> int:
+    import json as _json
+
     prefix = f"{pipeline}."
     runs = [
         c for c in store.get_contexts("pipeline_run")
@@ -77,6 +89,7 @@ def cmd_runs(
     if not runs:
         print(f"no runs recorded for pipeline {pipeline!r}", file=sys.stderr)
         return 1
+    json_runs = []
     for ctx in runs:
         run_id = ctx.properties.get("run_id") or ctx.name[len(prefix):]
         # Trace-derived per-node columns (queue wait) when the run's
@@ -85,6 +98,25 @@ def cmd_runs(
         trace_nodes = _run_trace_metrics(pipeline_root, run_id).get(
             "per_node", {}
         )
+        if as_json:
+            json_runs.append({
+                "run_id": run_id,
+                "context_id": ctx.id,
+                "nodes": [
+                    {
+                        "node": ex.node_id or ex.type_name,
+                        "state": ex.state.value,
+                        "execution_id": ex.id,
+                        "properties": ex.properties,
+                        **(
+                            {"trace": trace_nodes[ex.node_id]}
+                            if ex.node_id in trace_nodes else {}
+                        ),
+                    }
+                    for ex in store.get_executions_by_context(ctx.id)
+                ],
+            })
+            continue
         print(f"run {run_id}  (context #{ctx.id})")
         header = f"  {'node':<24} {'state':<10} {'dur_s':>9}"
         if trace_nodes:
@@ -108,55 +140,129 @@ def cmd_runs(
                 q = trace_nodes.get(ex.node_id, {}).get("queue_wait_s")
                 line += f" {q if q is not None else '-':>8}"
             print(f"{line}  {extra}".rstrip())
+    if as_json:
+        print(_json.dumps({"pipeline": pipeline, "runs": json_runs},
+                          indent=1, sort_keys=True, default=str))
     return 0
 
 
-def cmd_trace(args) -> int:
+def _resolve_run_id(pipeline_root: str, run_id: str):
+    """Resolve 'latest' to the newest run dir; (run_id, error) tuple."""
+    import os
+
+    if run_id != "latest":
+        return run_id, None
+    runs_dir = os.path.join(pipeline_root, ".runs")
+    candidates = sorted(
+        (d for d in (os.listdir(runs_dir) if os.path.isdir(runs_dir)
+                     else [])
+         if os.path.isdir(os.path.join(runs_dir, d))),
+        key=lambda d: os.path.getmtime(os.path.join(runs_dir, d)),
+    )
+    if not candidates:
+        return None, f"no traced runs under {runs_dir}"
+    return candidates[-1], None
+
+
+def _load_run_metrics(pipeline_root: str, run_id: str):
+    """((run_id, events, metrics), error) for one traced run."""
     import os
 
     from tpu_pipelines.observability import (
         compute_metrics,
-        export_metrics,
-        export_perfetto,
-        format_summary,
         read_events,
         run_trace_dir,
     )
 
-    runs_dir = os.path.join(args.pipeline_root, ".runs")
-    run_id = args.run_id
-    if run_id == "latest":
-        candidates = sorted(
-            (d for d in (os.listdir(runs_dir) if os.path.isdir(runs_dir)
-                         else [])
-             if os.path.isdir(os.path.join(runs_dir, d))),
-            key=lambda d: os.path.getmtime(os.path.join(runs_dir, d)),
-        )
-        if not candidates:
-            print(f"no traced runs under {runs_dir}", file=sys.stderr)
-            return 1
-        run_id = candidates[-1]
+    run_id, err = _resolve_run_id(pipeline_root, run_id)
+    if err:
+        return None, err
     events_file = os.path.join(
-        run_trace_dir(args.pipeline_root, run_id), "trace", "events.jsonl"
+        run_trace_dir(pipeline_root, run_id), "trace", "events.jsonl"
     )
     if not os.path.exists(events_file):
-        print(f"no trace event log at {events_file} (was the run traced? "
-              "TPP_TRACE=0 disables tracing)", file=sys.stderr)
-        return 1
+        return None, (
+            f"no trace event log at {events_file} (was the run traced? "
+            "TPP_TRACE=0 disables tracing)"
+        )
     events = read_events(events_file)
     if not events:
-        print(f"trace event log {events_file} is empty", file=sys.stderr)
+        return None, f"trace event log {events_file} is empty"
+    return (run_id, events, compute_metrics(events)), None
+
+
+def cmd_trace(args) -> int:
+    import json as _json
+
+    from tpu_pipelines.observability import (
+        export_metrics,
+        export_perfetto,
+        format_summary,
+    )
+
+    if args.run_id[0] == "diff":
+        return cmd_trace_diff(args)
+    if len(args.run_id) != 1:
+        print("trace takes one run id (or: trace diff <a> <b>)",
+              file=sys.stderr)
+        return 2
+    loaded, err = _load_run_metrics(args.pipeline_root, args.run_id[0])
+    if err:
+        print(err, file=sys.stderr)
         return 1
-    metrics = compute_metrics(events)
-    print(f"run {run_id}  ({len(events)} events, {events_file})")
-    print(format_summary(metrics))
+    run_id, events, metrics = loaded
+    if args.json:
+        print(_json.dumps(
+            {"run_id": run_id, "events": len(events), **metrics},
+            indent=1, sort_keys=True,
+        ))
+    else:
+        print(f"run {run_id}  ({len(events)} events)")
+        print(format_summary(metrics))
     if args.perfetto:
         path = export_perfetto(events, args.perfetto)
-        print(f"perfetto timeline: {path} (load in https://ui.perfetto.dev)")
+        if not args.json:
+            print(
+                f"perfetto timeline: {path} "
+                "(load in https://ui.perfetto.dev)"
+            )
     if args.metrics:
         path = export_metrics(events, args.metrics)
-        print(f"metrics summary: {path}")
+        if not args.json:
+            print(f"metrics summary: {path}")
     return 0
+
+
+def cmd_trace_diff(args) -> int:
+    """``trace diff <run_a> <run_b>``: per-node deltas + regression
+    flags; exit 0 = clean, 3 = regressed past threshold, 1 = error."""
+    import json as _json
+
+    from tpu_pipelines.observability import diff_metrics, format_diff
+
+    ids = args.run_id[1:]
+    if len(ids) != 2:
+        print("trace diff needs exactly two run ids: trace diff <a> <b>",
+              file=sys.stderr)
+        return 2
+    loaded = []
+    for rid in ids:
+        got, err = _load_run_metrics(args.pipeline_root, rid)
+        if err:
+            print(err, file=sys.stderr)
+            return 1
+        loaded.append(got)
+    (id_a, _, metrics_a), (id_b, _, metrics_b) = loaded
+    diff = diff_metrics(metrics_a, metrics_b, threshold=args.threshold)
+    if args.json:
+        print(_json.dumps(
+            {"run_a": id_a, "run_b": id_b, **diff},
+            indent=1, sort_keys=True,
+        ))
+    else:
+        print(f"trace diff: {id_a} (baseline) -> {id_b}")
+        print(format_diff(diff))
+    return 3 if diff["regressed"] else 0
 
 
 def cmd_lineage(store: MetadataStore, artifact_id: int) -> int:
@@ -220,17 +326,31 @@ def main(argv=None) -> int:
     p_runs.add_argument("--pipeline-root", default="",
                         help="pipeline root; adds trace-derived columns "
                              "(queue wait) from <root>/.runs/<id>/trace")
+    p_runs.add_argument("--json", action="store_true",
+                        help="machine-readable output (one JSON object)")
 
     p_trace = sub.add_parser(
-        "trace", help="summarize/export a run's RunTrace event log"
+        "trace",
+        help="summarize/export a run's RunTrace event log, or compare "
+             "two runs: trace diff <a> <b>",
     )
-    p_trace.add_argument("run_id", help="run id, or 'latest'")
+    p_trace.add_argument(
+        "run_id", nargs="+",
+        help="run id or 'latest'; or: diff <run-a> <run-b>",
+    )
     p_trace.add_argument("--pipeline-root", required=True,
                          help="pipeline root containing .runs/<run-id>/")
     p_trace.add_argument("--perfetto", default="", metavar="OUT_JSON",
                          help="write a Chrome/Perfetto trace.json here")
     p_trace.add_argument("--metrics", default="", metavar="OUT_JSON",
                          help="write the metrics.json summary here")
+    p_trace.add_argument("--json", action="store_true",
+                         help="machine-readable output (one JSON object)")
+    p_trace.add_argument(
+        "--threshold", type=float, default=0.2,
+        help="diff regression threshold as a fraction (default 0.2 = "
+             "20%% slower flags; exit code 3 on any flag)",
+    )
 
     p_lin = isub.add_parser("lineage", parents=[md_parent],
                             help="provenance chain of an artifact")
@@ -250,7 +370,10 @@ def main(argv=None) -> int:
     store = MetadataStore(args.metadata)
     try:
         if args.what == "runs":
-            return cmd_runs(store, args.pipeline, args.pipeline_root)
+            return cmd_runs(
+                store, args.pipeline, args.pipeline_root,
+                as_json=args.json,
+            )
         if args.what == "lineage":
             return cmd_lineage(store, args.artifact_id)
         return cmd_artifacts(store, args.type)

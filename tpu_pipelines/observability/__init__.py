@@ -1,4 +1,4 @@
-"""Observability: run-scoped tracing, metrics, Perfetto export.
+"""Observability: run-scoped tracing, live telemetry, Perfetto export.
 
 The run-introspection surface the reference stack delegates to its
 substrate (SURVEY.md §5 — KFP UI run timelines, Stackdriver latencies):
@@ -8,6 +8,13 @@ exporters turn it into a Perfetto-loadable ``trace.json`` and a
 ``metrics.json`` summary (measured critical path, queue/gate waits,
 cache-hit ratio, shard skew).  ``TPP_TRACE=0`` disables everything;
 see docs/OBSERVABILITY.md.
+
+Live telemetry (this PR's layer on top): ``metrics.py`` is the
+dependency-free counters/gauges/histograms registry with Prometheus
+text exposition (serving ``/metrics``, the runner's opt-in
+``TPP_METRICS_PORT`` server), ``health.py`` the heartbeat/stall/NaN
+watchdogs, and ``diff_metrics``/``trace diff`` the cross-run
+regression comparison.
 """
 
 from tpu_pipelines.observability.trace import (  # noqa: F401
@@ -27,9 +34,22 @@ from tpu_pipelines.observability.trace import (  # noqa: F401
 )
 from tpu_pipelines.observability.export import (  # noqa: F401
     compute_metrics,
+    diff_metrics,
     export_metrics,
     export_perfetto,
+    format_diff,
     format_summary,
     read_events,
     to_perfetto,
+)
+from tpu_pipelines.observability.metrics import (  # noqa: F401
+    MetricsRegistry,
+    default_registry,
+    histogram_quantile,
+    latency_buckets,
+    start_http_server,
+)
+from tpu_pipelines.observability.health import (  # noqa: F401
+    HealthMonitor,
+    stall_timeout_from_env,
 )

@@ -271,6 +271,168 @@ def export_metrics(events: List[Dict[str, Any]], out_path: str) -> str:
     return out_path
 
 
+# ----------------------------------------------------------- trace diff
+
+
+def diff_metrics(
+    run_a: Dict[str, Any],
+    run_b: Dict[str, Any],
+    threshold: float = 0.2,
+    min_abs_s: float = 0.05,
+) -> Dict[str, Any]:
+    """Compare two runs' ``compute_metrics`` summaries: per-node
+    duration/wait deltas, cache-hit delta, critical-path delta, and
+    regression flags.
+
+    ``run_a`` is the baseline, ``run_b`` the candidate.  A node (or the
+    critical path) is flagged as a regression when the candidate is more
+    than ``threshold`` slower AND the absolute growth exceeds
+    ``min_abs_s`` (relative thresholds alone flag microsecond noise on
+    tiny nodes).  Inputs are duck-typed: any dict carrying ``per_node``
+    and the headline keys works, so bench summaries diff as well as full
+    metrics.json payloads.
+    """
+    nodes_a = run_a.get("per_node") or {}
+    nodes_b = run_b.get("per_node") or {}
+    per_node: Dict[str, Dict[str, Any]] = {}
+    regressions: List[Dict[str, Any]] = []
+
+    def rel(a: float, b: float):
+        return round(b / a - 1.0, 4) if a else None
+
+    for nid in sorted(set(nodes_a) | set(nodes_b)):
+        a, b = nodes_a.get(nid), nodes_b.get(nid)
+        if a is None or b is None:
+            per_node[nid] = {
+                "only_in": "b" if a is None else "a",
+                "wall_a_s": a.get("wall_s") if a else None,
+                "wall_b_s": b.get("wall_s") if b else None,
+            }
+            continue
+        wall_a = float(a.get("wall_s", 0.0))
+        wall_b = float(b.get("wall_s", 0.0))
+        entry = {
+            "wall_a_s": round(wall_a, 4),
+            "wall_b_s": round(wall_b, 4),
+            "wall_delta_s": round(wall_b - wall_a, 4),
+            "wall_delta_frac": rel(wall_a, wall_b),
+            "queue_wait_delta_s": round(
+                float(b.get("queue_wait_s", 0.0))
+                - float(a.get("queue_wait_s", 0.0)), 4,
+            ),
+            "status_a": a.get("status", ""),
+            "status_b": b.get("status", ""),
+            # CACHED<->COMPLETE flips explain most wall deltas; surface
+            # them next to the numbers instead of leaving a mystery.
+            "cache_flip": (
+                a.get("status") != b.get("status")
+                and "CACHED" in (a.get("status"), b.get("status"))
+            ),
+            "regressed": False,
+        }
+        if (
+            wall_b - wall_a > min_abs_s
+            and wall_a > 0
+            and wall_b > wall_a * (1.0 + threshold)
+            and not entry["cache_flip"]
+        ):
+            entry["regressed"] = True
+            regressions.append({
+                "metric": f"{nid}.wall_s",
+                "a": round(wall_a, 4),
+                "b": round(wall_b, 4),
+                "frac": entry["wall_delta_frac"],
+            })
+        per_node[nid] = entry
+
+    cp_a = float(run_a.get("critical_path_measured_s") or 0.0)
+    cp_b = float(run_b.get("critical_path_measured_s") or 0.0)
+    if cp_b - cp_a > min_abs_s and cp_a > 0 and cp_b > cp_a * (
+        1.0 + threshold
+    ):
+        regressions.append({
+            "metric": "critical_path_measured_s",
+            "a": round(cp_a, 4),
+            "b": round(cp_b, 4),
+            "frac": rel(cp_a, cp_b),
+        })
+
+    def _get(d, key):
+        v = d.get(key)
+        return float(v) if v is not None else None
+
+    cache_a = _get(run_a, "cache_hit_ratio")
+    cache_b = _get(run_b, "cache_hit_ratio")
+    return {
+        "schema_version": 1,
+        "threshold": threshold,
+        "min_abs_s": min_abs_s,
+        "per_node": per_node,
+        "critical_path_a_s": round(cp_a, 4),
+        "critical_path_b_s": round(cp_b, 4),
+        "critical_path_delta_s": round(cp_b - cp_a, 4),
+        "critical_path_delta_frac": rel(cp_a, cp_b),
+        "queue_wait_delta_s": round(
+            (float(run_b.get("queue_wait_total_s") or 0.0))
+            - (float(run_a.get("queue_wait_total_s") or 0.0)), 4,
+        ),
+        "cache_hit_ratio_a": cache_a,
+        "cache_hit_ratio_b": cache_b,
+        "regression_flags": [r["metric"] for r in regressions],
+        "regressions": regressions,
+        "regressed": bool(regressions),
+    }
+
+
+def format_diff(diff: Dict[str, Any]) -> str:
+    """Human-readable ``trace diff`` table."""
+    lines: List[str] = []
+    lines.append(
+        f"critical path {diff['critical_path_a_s']}s -> "
+        f"{diff['critical_path_b_s']}s "
+        f"(delta {diff['critical_path_delta_s']:+}s"
+        + (
+            f", {diff['critical_path_delta_frac']:+.1%}"
+            if diff["critical_path_delta_frac"] is not None else ""
+        )
+        + f") · threshold {diff['threshold']:.0%}"
+    )
+    lines.append(
+        f"{'node':<24} {'a_s':>9} {'b_s':>9} {'delta_s':>9} "
+        f"{'delta%':>8}  flag"
+    )
+    for nid, e in sorted(
+        diff["per_node"].items(),
+        key=lambda kv: -(kv[1].get("wall_delta_s") or 0.0),
+    ):
+        if "only_in" in e:
+            lines.append(
+                f"{nid:<24} {'-':>9} {'-':>9} {'-':>9} {'-':>8}  "
+                f"only in run {e['only_in']}"
+            )
+            continue
+        frac = e["wall_delta_frac"]
+        flag = (
+            "REGRESSED" if e["regressed"]
+            else ("cache-flip" if e["cache_flip"] else "")
+        )
+        lines.append(
+            f"{nid:<24} {e['wall_a_s']:>9.3f} {e['wall_b_s']:>9.3f} "
+            f"{e['wall_delta_s']:>+9.3f} "
+            f"{(f'{frac:+.1%}' if frac is not None else '-'):>8}  {flag}"
+        )
+    if diff["regressions"]:
+        lines.append(
+            "regressions: " + ", ".join(
+                f"{r['metric']} ({r['frac']:+.1%})"
+                for r in diff["regressions"]
+            )
+        )
+    else:
+        lines.append("no regressions at this threshold")
+    return "\n".join(lines)
+
+
 def format_summary(metrics: Dict[str, Any]) -> str:
     """Human-readable run profile for the ``trace`` CLI."""
     lines: List[str] = []

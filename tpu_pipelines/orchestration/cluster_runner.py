@@ -108,6 +108,13 @@ class TPUJobRunnerConfig:
     # the profile operators read to size parallelism, deadlines, and
     # preemption budgets without re-running the pipeline.
     trace_metrics_path: str = ""
+    # Live-telemetry scrape port (observability/metrics.py).  When > 0,
+    # every node pod gets TPP_METRICS_PORT in its env (the local runner
+    # then serves /metrics + /healthz on it for the duration of the node)
+    # and the matching prometheus.io/scrape|port|path pod annotations, so
+    # a cluster Prometheus with kubernetes_sd discovers the pods with no
+    # per-pipeline scrape config.  0 = no server, no annotations.
+    metrics_port: int = 0
 
 
 class TPUJobRunner:
@@ -216,6 +223,24 @@ class TPUJobRunner:
             "--shard", f"{shard}/{num_shards}",
             "--shard-dir", self._tuner_shard_dir(ir, node_id),
         ]
+
+    def _metrics_annotations(self) -> Dict[str, str]:
+        """prometheus.io discovery annotations matching the node's live
+        /metrics server ({} when metrics_port is unset)."""
+        port = self.config.metrics_port
+        if port <= 0:
+            return {}
+        return {
+            "prometheus.io/scrape": "true",
+            "prometheus.io/port": str(port),
+            "prometheus.io/path": "/metrics",
+        }
+
+    def _metrics_env(self) -> List[Dict[str, str]]:
+        port = self.config.metrics_port
+        if port <= 0:
+            return []
+        return [{"name": "TPP_METRICS_PORT", "value": str(port)}]
 
     def _load_trace_metrics(self) -> Dict[str, Any]:
         """Prior-run RunTrace metrics, {} when not configured.
@@ -368,6 +393,17 @@ class TPUJobRunner:
                     "tpu-pipelines/measured-queue-wait-s":
                         str(info.get("queue_wait_s", "")),
                 })
+            if cfg.metrics_port > 0:
+                # Live telemetry: the pod serves /metrics + /healthz on
+                # TPP_METRICS_PORT (local_runner) and the annotations let
+                # a kubernetes_sd Prometheus discover it automatically.
+                tpl.setdefault("metadata", {}).setdefault(
+                    "annotations", {}
+                ).update(self._metrics_annotations())
+                if "container" in tpl:
+                    tpl["container"].setdefault("env", []).extend(
+                        self._metrics_env()
+                    )
             templates.append(tpl)
         spec: Dict[str, Any] = {
             "entrypoint": "pipeline-dag",
@@ -430,6 +466,7 @@ class TPUJobRunner:
                 "name": "TPP_TUNER_SHARD_DIR",
                 "value": self._tuner_shard_dir(ir, node_id),
             })
+        env.extend(self._metrics_env())
         container = {
             "name": "worker",
             "image": cfg.image,
@@ -451,12 +488,19 @@ class TPUJobRunner:
         }
         if cfg.shared_volume_claim:
             pod_spec["volumes"] = self._volumes()
+        pod_template: Dict[str, Any] = {"spec": pod_spec}
+        metrics_ann = self._metrics_annotations()
+        if metrics_ann:
+            # On the POD template (not the JobSet object): kubernetes_sd
+            # Prometheus discovers pods, and each worker pod serves its
+            # own /metrics.
+            pod_template["metadata"] = {"annotations": metrics_ann}
         job_spec: Dict[str, Any] = {
             "parallelism": cfg.num_hosts,
             "completions": cfg.num_hosts,
             "completionMode": "Indexed",
             "backoffLimit": 0,
-            "template": {"spec": pod_spec},
+            "template": pod_template,
         }
         deadline_s = self._node_deadline_s(ir, ir.node(node_id))
         if deadline_s:

@@ -170,6 +170,104 @@ class PipelineRunError(RuntimeError):
         self.result = result
 
 
+class _RunTelemetry:
+    """Live run-progress telemetry for one pipeline run.
+
+    Publishes nodes pending/running/done/failed gauges, per-node
+    dispatch heartbeats, and a run info metric into the process metrics
+    registry (in-memory — zero file/socket footprint), and optionally
+    serves them: ``TPP_METRICS_PORT`` starts a background ``/metrics`` +
+    ``/healthz`` HTTP server for the duration of the run — the opt-in
+    scrape surface for long pipelines (matching the cluster runner's
+    prometheus.io annotations).  Everything here is best-effort: a taken
+    port logs a warning and the run proceeds unobserved.
+    """
+
+    def __init__(self, pipeline_name: str, run_id: str):
+        from tpu_pipelines.observability.metrics import default_registry
+
+        reg = default_registry()
+        self._g_pending = reg.gauge(
+            "pipeline_nodes_pending", "Nodes not yet dispatched.",
+        )
+        self._g_running = reg.gauge(
+            "pipeline_nodes_running", "Nodes currently executing.",
+        )
+        self._g_done = reg.gauge(
+            "pipeline_nodes_done",
+            "Nodes settled successfully (COMPLETE/CACHED/skips).",
+        )
+        self._g_failed = reg.gauge(
+            "pipeline_nodes_failed", "Nodes settled FAILED.",
+        )
+        self._g_heartbeat = reg.gauge(
+            "pipeline_node_heartbeat_ts",
+            "Wall-clock (epoch s) of the node's last scheduler event "
+            "(dispatch or settle).",
+            labels=("node",),
+        )
+        self._c_dispatch = reg.counter(
+            "pipeline_node_dispatch_total",
+            "Executor dispatches per node (retries re-count).",
+            labels=("node",),
+        )
+        reg.gauge(
+            "pipeline_run_info",
+            "1 for the currently running pipeline run.",
+            labels=("pipeline", "run_id"),
+        ).labels(pipeline_name, run_id).set(1)
+        self._failed = 0
+        self._server = None
+        self._info = {"pipeline": pipeline_name, "run_id": run_id}
+        port = os.environ.get("TPP_METRICS_PORT", "").strip()
+        if port and port != "0":
+            from tpu_pipelines.observability.metrics import (
+                start_http_server,
+            )
+
+            try:
+                self._server = start_http_server(
+                    reg, port=int(port), health_fn=self._health
+                )
+                log.info(
+                    "metrics server on :%d (/metrics, /healthz)",
+                    self._server.port,
+                )
+            except (OSError, ValueError) as e:
+                log.warning(
+                    "TPP_METRICS_PORT=%s: metrics server not started: %s",
+                    port, e,
+                )
+
+    def _health(self) -> Dict[str, Any]:
+        return {
+            "healthy": self._failed == 0,
+            **self._info,
+            "nodes_failed": self._failed,
+        }
+
+    def progress(self, pending: int, running: int, result: "RunResult",
+                 ) -> None:
+        failed = sum(
+            1 for nr in result.nodes.values() if nr.status == "FAILED"
+        )
+        self._failed = failed
+        self._g_pending.set(pending)
+        self._g_running.set(running)
+        self._g_done.set(len(result.nodes) - failed)
+        self._g_failed.set(failed)
+
+    def heartbeat(self, node_id: str, dispatched: bool = False) -> None:
+        self._g_heartbeat.labels(node_id).set(time.time())
+        if dispatched:
+            self._c_dispatch.labels(node_id).inc()
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+
+
 @dataclasses.dataclass
 class NodeResult:
     node_id: str
@@ -401,6 +499,13 @@ class LocalDagRunner:
 
             max_parallel = self._effective_parallelism(ir)
             result.max_parallel_nodes = max_parallel
+            # Live telemetry (observability/metrics.py): run-progress
+            # gauges + per-node heartbeats, plus the opt-in
+            # TPP_METRICS_PORT scrape server.  Under spmd_sync each k8s
+            # pod owns its network namespace so every process may bind;
+            # same-host peers lose the bind race and log a warning (the
+            # constructor's OSError guard), never fail the run.
+            telemetry = _RunTelemetry(pipeline.name, run_id)
             shared = dict(
                 store=store, ir=ir, executors=executors, selected=selected,
                 produced=produced, failed_upstream=failed_upstream,
@@ -408,6 +513,7 @@ class LocalDagRunner:
                 runtime_parameters=runtime_parameters,
                 pipeline_ctx=pipeline_ctx, run_ctx=run_ctx,
                 extras=extras, enable_cache=pipeline.enable_cache,
+                telemetry=telemetry,
             )
             # Deadline enforcement needs the executor in a worker thread the
             # watchdog can outlive, so any configured deadline routes the run
@@ -458,6 +564,7 @@ class LocalDagRunner:
                         args={"succeeded": result.succeeded},
                     )
             finally:
+                telemetry.close()
                 if recorder:
                     recorder.close()
         finally:
@@ -790,13 +897,16 @@ class LocalDagRunner:
     def _run_nodes_sequential(
         self, *, store, ir, executors, selected, produced, failed_upstream,
         cond_skipped, result, runtime_parameters, pipeline_ctx, run_ctx,
-        extras, enable_cache,
+        extras, enable_cache, telemetry,
     ) -> None:
         """The classic strict-topo-order loop (spmd_sync and pool size 1)."""
         rec = _trace.active_recorder()
+        remaining = sum(1 for n in ir.nodes if n.id not in result.nodes)
         for node in ir.nodes:
             if node.id in result.nodes:
                 continue  # adopted by resume_from before scheduling began
+            telemetry.progress(remaining - 1, 1, result)
+            telemetry.heartbeat(node.id, dispatched=True)
             t0_wall, t0_mono = time.time(), time.monotonic()
             try:
                 node_result = self._control_outcome(
@@ -821,6 +931,9 @@ class LocalDagRunner:
             self._settle(
                 node_result, produced, failed_upstream, cond_skipped, result
             )
+            remaining -= 1
+            telemetry.progress(remaining, 0, result)
+            telemetry.heartbeat(node.id)
             if rec:
                 rec.complete(
                     "node", "scheduler", node.id, t0_wall, t0_mono,
@@ -838,7 +951,7 @@ class LocalDagRunner:
     def _run_nodes_concurrent(
         self, *, store, ir, executors, selected, produced, failed_upstream,
         cond_skipped, result, runtime_parameters, pipeline_ctx, run_ctx,
-        extras, enable_cache, max_workers: int,
+        extras, enable_cache, telemetry, max_workers: int,
     ) -> None:
         """Ready-set scheduler: dispatch any node whose upstreams have all
         published, lowest topo index first; executors run in a worker pool
@@ -872,6 +985,7 @@ class LocalDagRunner:
 
         def emit_node(nr: NodeResult, t0: tuple, queue_wait: float,
                       gate_wait: float) -> None:
+            telemetry.heartbeat(nr.node_id)  # settle heartbeat
             if rec is None:
                 return
             wall0, mono0 = t0
@@ -925,6 +1039,7 @@ class LocalDagRunner:
         )
         try:
             while unprocessed or in_flight:
+                telemetry.progress(len(unprocessed), len(in_flight), result)
                 progressed = False
                 # With a single worker, hold back later nodes until the
                 # in-flight one settles: control-plane publishes (cond-skip
@@ -1020,6 +1135,7 @@ class LocalDagRunner:
                     # expiry and at drain, so well-behaved long-runners
                     # (and the fault harness's injected hangs) can abort.
                     node_extras["cancel_event"] = prepared.cancel
+                    telemetry.heartbeat(nid, dispatched=True)
                     pool.submit(worker, prepared, node_extras)
                 if progressed:
                     continue
@@ -1081,6 +1197,7 @@ class LocalDagRunner:
                 settled.add(nr.node_id)
                 dw, dm, qw, gw = dispatch_info.pop(nr.node_id)
                 emit_node(nr, (dw, dm), qw, gw)
+            telemetry.progress(len(unprocessed), len(in_flight), result)
         finally:
             # Release every cooperative hang, give timed-out workers a short
             # grace to drain, then shut down — without blocking forever on a

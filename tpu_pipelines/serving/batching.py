@@ -74,6 +74,7 @@ class RequestBatcher:
         *,
         max_batch_size: int = 64,
         batch_timeout_s: float = 0.005,
+        registry=None,
     ):
         if max_batch_size < 1:
             raise ValueError(f"max_batch_size must be >= 1, got {max_batch_size}")
@@ -86,6 +87,34 @@ class RequestBatcher:
         self._queue: "queue.Queue" = queue.Queue()
         self._closed = False
         self._close_lock = threading.Lock()
+        # Futures of the group currently inside predict_fn: what close()
+        # must fail if the worker never comes back (a wedged device call
+        # would otherwise leave submit() callers hanging to their full
+        # timeout_s).  Written only by the worker thread.
+        self._inflight: List["Future[np.ndarray]"] = []
+        # Live telemetry (observability/metrics.py), opt-in via registry:
+        # queue depth is read at scrape time (the gauge calls qsize()),
+        # batch sizes/counts update per device call.
+        self._m_batch_size = None
+        self._m_batches = None
+        self._m_requests = None
+        if registry is not None:
+            registry.gauge(
+                "serving_batcher_queue_depth",
+                "Requests waiting in the micro-batcher queue.",
+            ).set_function(self._queue.qsize)
+            self._m_batch_size = registry.gauge(
+                "serving_batch_size",
+                "Rows in the most recent coalesced device batch.",
+            )
+            self._m_batches = registry.counter(
+                "serving_batches_total",
+                "Coalesced device calls issued by the micro-batcher.",
+            )
+            self._m_requests = registry.counter(
+                "serving_batched_requests_total",
+                "Requests served through the micro-batcher.",
+            )
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
 
@@ -108,11 +137,35 @@ class RequestBatcher:
             self._queue.put((batch, n_rows, fut))
         return fut.result(timeout=timeout_s)
 
-    def close(self) -> None:
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Shut down: reject new submits, serve-or-fail everything queued.
+
+        Every pre-close ``submit`` either completes normally (the worker
+        drains the queue ahead of the close sentinel) or gets a
+        ``RuntimeError`` — never a silently hanging future.  If the
+        worker does not come back within ``timeout_s`` (predict_fn
+        wedged), the in-flight group's futures are failed too, so
+        blocked callers return immediately instead of waiting out their
+        own submit timeout."""
         with self._close_lock:
+            if self._closed:
+                return
             self._closed = True
             self._queue.put(None)  # wake the worker
-        self._worker.join(timeout=5)
+        self._worker.join(timeout=timeout_s)
+        if self._worker.is_alive():
+            # Wedged device call: its group's futures would otherwise
+            # hang until each caller's submit timeout.  Fail them now —
+            # if predict_fn eventually returns, the worker's set_result
+            # on a done future is swallowed below.
+            for fut in list(self._inflight):
+                if not fut.done():
+                    try:
+                        fut.set_exception(RuntimeError(
+                            "batcher closed while request was in flight"
+                        ))
+                    except Exception:  # noqa: BLE001 — lost the race: done
+                        pass
         self._drain_failures("batcher closed")  # anything the worker missed
 
     # ------------------------------------------------------------- worker
@@ -161,7 +214,11 @@ class RequestBatcher:
                     break
                 group.append(nxt)
                 rows += nxt[1]
-            self._execute(group)
+            self._inflight = [entry[2] for entry in group]
+            try:
+                self._execute(group)
+            finally:
+                self._inflight = []
 
     def _predict_group(self, group) -> None:
         merged = {
@@ -175,9 +232,17 @@ class RequestBatcher:
         preds = np.asarray(self.predict_fn(padded))[:total]
         self.batches_run += 1
         self.requests_served += len(group)
+        if self._m_batches is not None:
+            self._m_batches.inc()
+            self._m_requests.inc(len(group))
+            self._m_batch_size.set(total)
         offset = 0
         for _, n, fut in group:
-            fut.set_result(preds[offset:offset + n])
+            if not fut.done():  # close() may have failed a wedged group
+                try:
+                    fut.set_result(preds[offset:offset + n])
+                except Exception:  # noqa: BLE001 — lost the close race
+                    pass
             offset += n
 
     def _execute(self, group) -> None:

@@ -26,11 +26,16 @@ import json
 import logging
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 import numpy as np
 
+from tpu_pipelines.observability.metrics import (
+    CONTENT_TYPE_LATEST,
+    MetricsRegistry,
+)
 from tpu_pipelines.trainer.export import LoadedModel, load_exported_model
 
 log = logging.getLogger("tpu_pipelines.serving")
@@ -71,6 +76,7 @@ class ModelServer:
         batching: bool = False,
         max_batch_size: int = 64,
         batch_timeout_s: float = 0.005,
+        metrics_registry: Optional[MetricsRegistry] = None,
     ):
         self.model_name = model_name
         self.base_dir = base_dir
@@ -80,6 +86,32 @@ class ModelServer:
         self._loaded_version: Optional[str] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._stopped = False
+        # Live telemetry (observability/metrics.py): per-server registry by
+        # default so two servers in one process never mix series; callers
+        # may inject a shared registry.  In-memory only — the sole exposure
+        # is this server's own GET /metrics route.
+        self.metrics = metrics_registry or MetricsRegistry()
+        self._m_requests = self.metrics.counter(
+            "serving_requests_total",
+            "HTTP requests handled, by endpoint and status code.",
+            labels=("endpoint", "code"),
+        )
+        self._m_latency = self.metrics.histogram(
+            "serving_request_latency_seconds",
+            "End-to-end request latency (parse + model + reply), "
+            "by endpoint.",
+            labels=("endpoint",),
+        )
+        self._m_model_info = self.metrics.gauge(
+            "serving_model_info",
+            "1 for the currently served model version, 0 for prior ones.",
+            labels=("model", "version"),
+        )
+        self._m_reloads = self.metrics.counter(
+            "serving_model_reloads_total",
+            "Successful model version loads (including the initial one).",
+        )
         # Micro-batching (serving/batching.py): coalesce concurrent requests
         # into padded fixed-bucket device calls.  The batcher resolves the
         # current model at call time, so hot-swaps apply to queued requests.
@@ -91,6 +123,7 @@ class ModelServer:
                 lambda b: np.asarray(self._predict_fn()(b)),
                 max_batch_size=max_batch_size,
                 batch_timeout_s=batch_timeout_s,
+                registry=self.metrics,
             )
         self.reload()
 
@@ -116,8 +149,13 @@ class ModelServer:
             return version
         loaded = load_exported_model(vdir)
         with self._lock:
+            prior = self._loaded_version
             self._loaded = loaded
             self._loaded_version = version
+        if prior is not None:
+            self._m_model_info.labels(self.model_name, prior).set(0)
+        self._m_model_info.labels(self.model_name, version).set(1)
+        self._m_reloads.inc()
         log.info("loaded %s version %s", self.model_name, version)
         return version
 
@@ -205,6 +243,25 @@ class ModelServer:
             return {"outputs": []}
         return {"outputs": np.asarray(generate_fn(batch)).tolist()}
 
+    # -------------------------------------------------------------- health
+
+    def health(self) -> Dict[str, Any]:
+        """The ``GET /healthz`` payload: liveness + which version serves.
+
+        Healthy = a model is loaded and the batcher (when enabled) is
+        accepting work; the probe never touches the device, so a slow
+        model cannot fail the liveness check."""
+        with self._lock:
+            loaded = self._loaded is not None
+            version = self._loaded_version
+        batcher_open = self._batcher is None or not self._batcher._closed
+        return {
+            "healthy": loaded and batcher_open and not self._stopped,
+            "model": self.model_name,
+            "version": version,
+            "batching": self._batcher is not None,
+        }
+
     # ---------------------------------------------------------------- HTTP
 
     def start(self, port: int = 0, host: str = "127.0.0.1") -> int:
@@ -220,40 +277,86 @@ class ModelServer:
             def log_message(self, fmt, *args):  # route to logging, not stderr
                 log.debug("http: " + fmt, *args)
 
-            def _reply(self, code: int, obj: Dict[str, Any]) -> None:
+            def _reply(
+                self,
+                code: int,
+                obj: Dict[str, Any],
+                endpoint: str = "",
+            ) -> None:
                 body = json.dumps(obj).encode("utf-8")
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+                if endpoint:
+                    server._m_requests.labels(endpoint, code).inc()
 
             def do_GET(self):
-                if self.path == f"/v1/models/{server.model_name}":
+                if self.path == "/metrics":
+                    # Prometheus text exposition of this server's
+                    # registry (request latencies, batcher depth, model
+                    # info) — the scrape endpoint the cluster runner's
+                    # prometheus.io annotations point at.
+                    body = server.metrics.to_prometheus().encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type", CONTENT_TYPE_LATEST)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    server._m_requests.labels("metrics", 200).inc()
+                elif self.path == "/healthz":
+                    health = server.health()
+                    self._reply(
+                        200 if health["healthy"] else 503, health,
+                        endpoint="healthz",
+                    )
+                elif self.path == f"/v1/models/{server.model_name}":
+                    t0 = time.perf_counter()
                     self._reply(200, {
                         "model_version_status": [{
                             "version": server.version,
                             "state": "AVAILABLE",
                         }],
-                    })
+                    }, endpoint="status")
+                    server._m_latency.labels("status").observe(
+                        time.perf_counter() - t0
+                    )
                 else:
-                    self._reply(404, {"error": f"unknown path {self.path}"})
+                    self._reply(
+                        404, {"error": f"unknown path {self.path}"},
+                        endpoint="other",
+                    )
 
             def do_POST(self):
                 routes = {
-                    f"/v1/models/{server.model_name}:predict": server.predict,
-                    f"/v1/models/{server.model_name}:generate": server.generate,
+                    f"/v1/models/{server.model_name}:predict":
+                        ("predict", server.predict),
+                    f"/v1/models/{server.model_name}:generate":
+                        ("generate", server.generate),
                 }
-                handler = routes.get(self.path)
-                if handler is None:
-                    self._reply(404, {"error": f"unknown path {self.path}"})
+                route = routes.get(self.path)
+                if route is None:
+                    self._reply(
+                        404, {"error": f"unknown path {self.path}"},
+                        endpoint="other",
+                    )
                     return
+                endpoint, handler = route
+                t0 = time.perf_counter()
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     payload = json.loads(self.rfile.read(n) or b"{}")
-                    self._reply(200, handler(payload))
+                    self._reply(200, handler(payload), endpoint=endpoint)
                 except Exception as e:
-                    self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                    self._reply(
+                        400, {"error": f"{type(e).__name__}: {e}"},
+                        endpoint=endpoint,
+                    )
+                finally:
+                    server._m_latency.labels(endpoint).observe(
+                        time.perf_counter() - t0
+                    )
 
         class Httpd(ThreadingHTTPServer):
             # socketserver's default listen backlog is 5; a concurrent-client
@@ -269,6 +372,7 @@ class ModelServer:
         return self._httpd.server_address[1]
 
     def stop(self) -> None:
+        self._stopped = True
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

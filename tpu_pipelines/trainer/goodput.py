@@ -19,6 +19,7 @@ import datetime
 import json
 import logging
 import os
+import time
 from typing import Any, Dict, List, Optional
 
 log = logging.getLogger("tpu_pipelines.trainer")
@@ -32,30 +33,74 @@ class LocalEntryLogger:
     """Duck-types ``ml_goodput_measurement``'s ``_CloudLogger`` interface
     (``write_cloud_logging_entry`` / ``read_cloud_logging_entries``) over an
     in-memory list, optionally mirrored to a JSONL file for post-hoc
-    inspection (`model_run/goodput_log.jsonl`)."""
+    inspection (`model_run/goodput_log.jsonl`).
 
-    def __init__(self, job_name: str, jsonl_path: str = ""):
+    Mirror failures (a full or read-only disk) never break training, and
+    no longer latch the mirror off forever: every failure is counted in
+    the metrics registry (``goodput_mirror_failures_total``), writes are
+    suppressed for ``mirror_retry_backoff_s``, then retried ONCE — a
+    transient ENOSPC recovers, a genuinely dead path disables the mirror
+    after its second strike.
+    """
+
+    def __init__(
+        self,
+        job_name: str,
+        jsonl_path: str = "",
+        mirror_retry_backoff_s: float = 30.0,
+    ):
         self.job_name = job_name
         self.job_start_time = None  # attribute the real logger also exposes
         self._entries: List[Dict[str, Any]] = []
         self._jsonl_path = jsonl_path
-        self._jsonl_failed = False
+        self._mirror_retry_backoff_s = mirror_retry_backoff_s
+        self._mirror_retry_at: Optional[float] = None  # monotonic
+        self._mirror_dead = False
+        from tpu_pipelines.observability.metrics import default_registry
+
+        self._m_mirror_failures = default_registry().counter(
+            "goodput_mirror_failures_total",
+            "Goodput JSONL mirror write failures (OSError).",
+        )
 
     def write_cloud_logging_entry(self, entry) -> None:
         if entry is None or entry.get("job_name") != self.job_name:
             return
         self._entries.append(entry)
-        if self._jsonl_path and not self._jsonl_failed:
-            try:
-                parent = os.path.dirname(self._jsonl_path)
-                if parent:
-                    os.makedirs(parent, exist_ok=True)
-                with open(self._jsonl_path, "a") as f:
-                    f.write(json.dumps(entry, default=str) + "\n")
-            except OSError as e:
-                # Accounting must never break training; keep in-memory only.
-                self._jsonl_failed = True
-                log.warning("goodput jsonl mirror disabled: %s", e)
+        if not self._jsonl_path or self._mirror_dead:
+            return
+        if (
+            self._mirror_retry_at is not None
+            and time.monotonic() < self._mirror_retry_at
+        ):
+            return  # backing off; the entry stays in-memory only
+        try:
+            parent = os.path.dirname(self._jsonl_path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            with open(self._jsonl_path, "a") as f:
+                f.write(json.dumps(entry, default=str) + "\n")
+        except OSError as e:
+            self._m_mirror_failures.inc()
+            if self._mirror_retry_at is None:
+                # First strike this episode: back off, then retry once.
+                self._mirror_retry_at = (
+                    time.monotonic() + self._mirror_retry_backoff_s
+                )
+                log.warning(
+                    "goodput jsonl mirror failed (%s); retrying once "
+                    "after %gs", e, self._mirror_retry_backoff_s,
+                )
+            else:
+                # The post-backoff retry also failed: the path is dead.
+                self._mirror_dead = True
+                log.warning(
+                    "goodput jsonl mirror disabled after retry: %s", e
+                )
+        else:
+            # A success closes the failure episode: a future failure gets
+            # its own backoff + single retry.
+            self._mirror_retry_at = None
 
     def read_cloud_logging_entries(
         self, start_time=None, end_time=None, last_entry_info=None

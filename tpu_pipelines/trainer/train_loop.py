@@ -119,6 +119,17 @@ class TrainLoopConfig:
     # compile) so throughput is unaffected; costs wall-clock, so off by
     # default and enabled by the bench's flagship leg.
     collect_cost_analysis: bool = False
+    # Live telemetry (observability/metrics.py + health.py): the loop
+    # always publishes step-time / examples-per-sec / input-wait / device
+    # -memory gauges into the process metrics registry (in-memory — zero
+    # file/socket footprint) and heartbeats a HealthMonitor whose NaN and
+    # loss-spike checks ride the log_every host transfer.  The stall
+    # watchdog THREAD starts only when a timeout is configured:
+    # None = read env TPP_STALL_TIMEOUT_S, 0 = no watchdog thread.
+    stall_timeout_s: Optional[float] = None
+    # Called as cb(kind, detail) when a watchdog fires ("stall", "nan",
+    # "loss_spike") — wire pagers, or sys.exit for fail-fast jobs.
+    health_alert_cb: Optional[Callable[[str, str], None]] = None
 
 
 LossFn = Callable[[Any, Dict[str, jax.Array], jax.Array], Tuple[jax.Array, Dict[str, jax.Array]]]
@@ -473,6 +484,64 @@ def train_loop(
         tb_writer.flush()
         last_tb[kind] = at_step
 
+    # ---- live telemetry: gauges + health watchdog (observability/)
+    from tpu_pipelines.observability.health import HealthMonitor
+    from tpu_pipelines.observability.metrics import default_registry
+
+    reg = default_registry()
+    g_step_s = reg.gauge(
+        "train_step_seconds", "Mean wall time per step over the last "
+        "log_every window.",
+    )
+    g_eps = reg.gauge(
+        "train_examples_per_sec", "Window throughput at log_every cadence.",
+    )
+    g_tps = reg.gauge(
+        "train_tokens_per_sec", "Window token throughput (0 when the "
+        "batch carries no token-shaped integer feature).",
+    )
+    g_input_wait = reg.gauge(
+        "train_host_input_wait_seconds_total",
+        "Cumulative post-compile host time spent feeding batches "
+        "(the goodput proxy's numerator).",
+    )
+    g_device_mem = reg.gauge(
+        "train_device_memory_bytes",
+        "bytes_in_use on device 0 (0 where the backend reports none).",
+    )
+    g_steps = reg.gauge("train_steps_total", "Steps completed so far.")
+    # tokens/example: the widest trailing extent among integer features
+    # (token ids); mask-like siblings share the shape, max() dedups them.
+    tokens_per_example = max(
+        (
+            int(np.prod(np.asarray(v).shape[1:]))
+            for v in first_batch.values()
+            if np.asarray(v).dtype.kind in "iu" and np.asarray(v).ndim >= 2
+        ),
+        default=0,
+    )
+    monitor = HealthMonitor(
+        "train_loop",
+        stall_timeout_s=config.stall_timeout_s,
+        on_alert=config.health_alert_cb,
+    )
+
+    def _publish_window(at_step: int, window_steps: int, window_s: float,
+                        loss: Optional[float]) -> None:
+        if window_steps > 0 and window_s > 0:
+            step_s = window_s / window_steps
+            g_step_s.set(step_s)
+            g_eps.set(config.batch_size / step_s)
+            g_tps.set(config.batch_size * tokens_per_example / step_s)
+        g_input_wait.set(input_wait_s)
+        g_steps.set(at_step)
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            g_device_mem.set(float((stats or {}).get("bytes_in_use", 0)))
+        except Exception:  # noqa: BLE001 — not every backend reports
+            pass
+        monitor.heartbeat(at_step, loss=loss)
+
     metrics_hist: list = []
     metrics = None   # stays None when resume starts at/past train_steps
     t_start = None
@@ -482,6 +551,7 @@ def train_loop(
     profiling = False
     batch = first_batch
     step = start_step
+    window_anchor = (step, time.perf_counter())  # telemetry window start
     while step < config.train_steps:
         if config.profile_dir and not profiling and step - start_step == config.profile_from:
             jax.profiler.start_trace(config.profile_dir)
@@ -493,6 +563,7 @@ def train_loop(
             input_wait_s += time.perf_counter() - t_in
         state, metrics = train_step(state, device_batch)
         step += 1
+        monitor.heartbeat(step)  # liveness only; loss rides log cadence
         if profiling and step - start_step >= config.profile_to:
             jax.block_until_ready(metrics["loss"])
             jax.profiler.stop_trace()
@@ -526,6 +597,15 @@ def train_loop(
                 metrics_cb(step, host_metrics)
             tb_write("train", step, host_metrics)
             log.info("step %d: %s", step, host_metrics)
+            # Telemetry window: the host loss just materialized above, so
+            # the NaN/spike checks are free here; gauges cover the span
+            # since the previous log point.
+            now = time.perf_counter()
+            _publish_window(
+                step, step - window_anchor[0], now - window_anchor[1],
+                host_metrics.get("loss"),
+            )
+            window_anchor = (step, now)
         if mngr is not None and checkpoint_every:
             mngr.save(step, args=_ocp_save_args(state))
         if (
@@ -562,8 +642,14 @@ def train_loop(
         # Host read of the final step's output: the step sequence is a
         # dependency chain, so this proves every timed step executed (see
         # t_start note on why block_until_ready is not sufficient).
-        np.asarray(metrics["loss"])
+        final_loss = float(np.asarray(metrics["loss"]))
+        now = time.perf_counter()
+        _publish_window(
+            step, step - window_anchor[0], now - window_anchor[1],
+            final_loss,
+        )
     jax.block_until_ready(state.params)
+    monitor.close()
     elapsed = max(1e-9, time.perf_counter() - (t_start or time.perf_counter()))
     eps = examples_after_t0 / elapsed if examples_after_t0 else 0.0
 
