@@ -753,6 +753,68 @@ def _critical_path(ir, node_walls: dict) -> tuple:
     return list(reversed(path)), round(best[end], 2)
 
 
+def bench_lint(smoke: bool) -> dict:
+    """Static-analyzer health over the six shipped examples (ISSUE 6).
+
+    Compiles every example and runs BOTH analyzer layers (TPP1xx graph
+    rules on the IR, TPP2xx code rules over executors + module files)
+    without executing anything.  ``findings_total`` must stay 0: a shipped
+    example that lints dirty means either a seeded regression in an
+    example or an over-eager rule — both block.  Also records the
+    graph-layer latency to keep the "milliseconds before a chip is
+    touched" claim measured, not asserted.
+    """
+    import tempfile
+
+    from tpu_pipelines.analysis import analyze_ir, analyze_pipeline
+    from tpu_pipelines.dsl.compiler import Compiler
+    from tpu_pipelines.utils.module_loader import load_fn
+
+    names = ("taxi", "mnist", "resnet", "bert", "t5", "staged")
+    env = {"BERT_TINY": "1", "T5_TINY": "1", "RESNET_IMAGE_SIZE": "8",
+           "RESNET_DEPTH": "18"}
+    saved = {k: os.environ.get(k) for k in list(env) + ["TPP_PIPELINE_HOME"]}
+    os.environ.update(env)
+    per_example = {}
+    graph_ms = {}
+    total = 0
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            os.environ["TPP_PIPELINE_HOME"] = td
+            for name in names:
+                module = os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "examples", name, "pipeline.py",
+                )
+                pipeline = load_fn(module, "create_pipeline")()
+                ir = Compiler().compile(pipeline)
+                t0 = time.perf_counter()
+                graph_findings = analyze_ir(ir)
+                graph_ms[name] = round(
+                    (time.perf_counter() - t0) * 1000, 2
+                )
+                findings = analyze_pipeline(pipeline, ir=ir)
+                del graph_findings  # subset of `findings`; timed only
+                total += len(findings)
+                per_example[name] = {
+                    "findings": len(findings),
+                    "rules": sorted({f.rule for f in findings}),
+                }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return {
+        "green": total == 0,
+        "findings_total": total,
+        "per_example": per_example,
+        "graph_layer_ms": graph_ms,
+        "graph_layer_ms_max": max(graph_ms.values()) if graph_ms else None,
+    }
+
+
 def _run_example_pipeline(
     name: str,
     env: dict,
@@ -1760,6 +1822,11 @@ def _compact(report: dict) -> dict:
         # Capped: the compact line must stay under the driver-tail budget
         # even if every node regressed.
         compact["regression_flags"] = td.get("regression_flags", [])[:8]
+    # Analyzer health: total `tpp lint` findings over the six shipped
+    # examples (must be 0 — see bench_lint).
+    lint = report.get("lint")
+    if isinstance(lint, dict) and "findings_total" in lint:
+        compact["lint_findings"] = lint["findings_total"]
     if "terminated" in report:
         compact["terminated"] = report["terminated"]
     return compact
@@ -1888,6 +1955,10 @@ def main() -> None:
 
     # Order: cheapest evidence first, flagship second, e2e-BERT (the
     # north-star green target) before e2e-taxi, probes last.
+    # Analyzer health first: compile-and-lint all six examples costs
+    # seconds (module imports dominate) and its findings_total==0 verdict
+    # is the cheapest whole-repo sanity signal in the round.
+    leg("lint", bench_lint, est_cost_s=30, retries=1)
     leg("taxi", bench_taxi, est_cost_s=90, post=taxi_best_of_2)
     leg("taxi_device", bench_taxi_device, est_cost_s=60, retries=1)
     leg("bert", bench_bert, est_cost_s=120)

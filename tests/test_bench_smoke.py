@@ -174,6 +174,18 @@ def test_bench_smoke_emits_compact_stdout_and_full_report():
     ref = report["a100_reference"]
     assert ref["ex_per_sec"] > 0
     assert "source" in ref and "provenance" in ref
+    # Static-analyzer health (ISSUE 6): all six examples lint clean and
+    # the compact line carries the analyzer verdict.
+    lint = report["lint"]
+    assert lint["green"] is True, lint
+    assert lint["findings_total"] == 0
+    assert sorted(lint["per_example"]) == [
+        "bert", "mnist", "resnet", "staged", "t5", "taxi",
+    ]
+    assert all(v["findings"] == 0 for v in lint["per_example"].values())
+    # "milliseconds before a chip is touched": the graph layer is measured.
+    assert lint["graph_layer_ms_max"] < 1000
+    assert compact["lint_findings"] == 0
 
 
 def test_bench_budget_skips_but_emits():

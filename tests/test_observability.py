@@ -195,20 +195,25 @@ def test_perfetto_export_schema_valid(tmp_path):
 
 
 def test_disabled_mode_zero_files_and_identical_metadata(tmp_path):
-    """TPP_TRACE=0 + no TPP_METRICS_PORT: no .runs dir, no trace files,
-    no extra files of any kind, no metrics listener — and the metadata
-    trace is byte-identical to a traced run's (tracing and telemetry
-    never touch the store)."""
+    """TPP_TRACE=0 + no TPP_METRICS_PORT + TPP_LINT unset: no .runs dir,
+    no trace files, no extra files of any kind, no metrics listener — and
+    the metadata trace is byte-identical to a traced run's (tracing,
+    telemetry, and the lint pre-flight never touch the store).  The third
+    leg runs WITH lint="error" (the diamond lints warn-only) to prove an
+    enabled-but-passing gate is also invisible to the store."""
     from test_concurrent_runner import _normalized_store_dump
 
     assert "TPP_METRICS_PORT" not in os.environ
+    assert "TPP_LINT" not in os.environ
     dumps = {}
-    for sub, flag in (("on", "1"), ("off", "0")):
+    for sub, flag, lint in (
+        ("on", "1", None), ("off", "0", None), ("lint", "0", "error"),
+    ):
         os.environ["TPP_TRACE"] = flag
         try:
             p = _diamond(tmp_path, sleep_s=0.01, subdir=sub)
             result = LocalDagRunner(max_parallel_nodes=3).run(
-                p, run_id="fixed"
+                p, run_id="fixed", lint=lint
             )
             dumps[sub] = _normalized_store_dump(
                 p.metadata_path, p.pipeline_root
@@ -231,6 +236,7 @@ def test_disabled_mode_zero_files_and_identical_metadata(tmp_path):
         finally:
             os.environ.pop("TPP_TRACE", None)
     assert dumps["on"] == dumps["off"]
+    assert dumps["off"] == dumps["lint"]
 
 
 # ------------------------------------------------------------ shard spans

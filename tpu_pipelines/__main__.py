@@ -1,4 +1,4 @@
-"""Framework CLI: ``python -m tpu_pipelines {run,inspect,trace} ...``.
+"""Framework CLI: ``python -m tpu_pipelines {run,lint,inspect,trace} ...``.
 
 ``run`` — execute a pipeline module locally (the ``tfx run`` /
 LocalDagRunner-notebook equivalent):
@@ -6,6 +6,20 @@ LocalDagRunner-notebook equivalent):
     python -m tpu_pipelines run --pipeline-module examples/taxi/pipeline.py
     python -m tpu_pipelines run --pipeline-module p.py --param steps=500 \
         --from-node Trainer          # partial run, upstream from cache
+
+``lint`` — static pipeline + executor analysis (docs/ANALYSIS.md): compiles
+the module's pipeline and runs the TPP1xx graph rules on the IR plus the
+TPP2xx code rules on every executor and module-file entry point, without
+executing anything:
+
+    python -m tpu_pipelines lint --pipeline-module examples/taxi/pipeline.py
+    python -m tpu_pipelines lint --pipeline-module p.py --json --fail-on warn
+
+Exit codes mirror ``trace diff``: 0 = clean at the --fail-on level
+(default: error), 3 = blocking findings, 1 = the module itself failed to
+load/compile.  The same analysis gates ``LocalDagRunner.run(...,
+lint="error")`` / env ``TPP_LINT`` and the cluster runner's manifest
+emission.
 
 ``inspect`` — the MLMD-UI / KFP-UI equivalent surface (SURVEY.md §5
 metrics/observability): the metadata store is the observability backbone —
@@ -308,6 +322,24 @@ def main(argv=None) -> int:
                        help="scheduler worker-pool size (default: DAG root "
                             "count, or TPP_MAX_PARALLEL_NODES; 1 = strict "
                             "sequential)")
+    p_run.add_argument("--lint", default=None, choices=["error", "warn", "off"],
+                       help="pre-flight static analysis gate (default: env "
+                            "TPP_LINT, else off); 'error' refuses to run on "
+                            "ERROR findings, 'warn' on any finding")
+
+    p_lint = sub.add_parser(
+        "lint",
+        help="static pipeline + executor analysis; exit 0 clean, 3 on "
+             "blocking findings (docs/ANALYSIS.md)",
+    )
+    p_lint.add_argument("--pipeline-module", required=True,
+                        help="file defining create_pipeline() -> Pipeline")
+    p_lint.add_argument("--json", action="store_true",
+                        help="machine-readable output (one JSON object)")
+    p_lint.add_argument("--fail-on", default="error",
+                        choices=["error", "warn"],
+                        help="findings at/above this severity exit 3 "
+                             "(default: error)")
 
     inspect = sub.add_parser("inspect", help="read the metadata store")
     # On the parent AND each leaf, so both argument orders work:
@@ -363,6 +395,8 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.cmd == "run":
         return cmd_run(args)
+    if args.cmd == "lint":
+        return cmd_lint(args)
     if args.cmd == "trace":
         return cmd_trace(args)
     if not args.metadata:
@@ -379,6 +413,43 @@ def main(argv=None) -> int:
         return cmd_artifacts(store, args.type)
     finally:
         store.close()
+
+
+def cmd_lint(args) -> int:
+    """``lint --pipeline-module M [--json] [--fail-on error|warn]``."""
+    import json as _json
+
+    from tpu_pipelines.analysis import (
+        EXIT_GATED,
+        analyze_pipeline,
+        format_findings,
+        gated,
+        lint_report,
+    )
+    from tpu_pipelines.utils.module_loader import load_fn
+
+    try:
+        pipeline = load_fn(args.pipeline_module, "create_pipeline")()
+        findings = analyze_pipeline(pipeline)
+    except Exception as e:
+        # The module failing to load/compile is a tool error (1), not a
+        # lint verdict (3): CI must distinguish "pipeline is broken at
+        # import" from "pipeline linted dirty".
+        print(f"lint: cannot analyze {args.pipeline_module}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    blocking = gated(findings, args.fail_on)
+    if args.json:
+        report = lint_report(findings)
+        report["fail_on"] = args.fail_on
+        report["gated"] = len(blocking)
+        print(_json.dumps(report, indent=1, sort_keys=True))
+    else:
+        print(format_findings(findings))
+        if blocking:
+            print(f"lint: {len(blocking)} finding(s) at/above "
+                  f"--fail-on={args.fail_on}; refusing (exit {EXIT_GATED})")
+    return EXIT_GATED if blocking else 0
 
 
 def cmd_run(args) -> int:
@@ -400,17 +471,24 @@ def cmd_run(args) -> int:
         except json.JSONDecodeError:
             params[name] = raw  # plain string value
     pipeline = load_fn(args.pipeline_module, "create_pipeline")()
-    result = LocalDagRunner(
-        max_retries=args.max_retries,
-        max_parallel_nodes=args.max_parallel_nodes,
-    ).run(
-        pipeline,
-        runtime_parameters=params,
-        from_nodes=args.from_node or None,
-        to_nodes=args.to_node or None,
-        raise_on_failure=False,
-        resume_from=args.resume_from,
-    )
+    from tpu_pipelines.analysis import EXIT_GATED, LintGateError
+
+    try:
+        result = LocalDagRunner(
+            max_retries=args.max_retries,
+            max_parallel_nodes=args.max_parallel_nodes,
+        ).run(
+            pipeline,
+            runtime_parameters=params,
+            from_nodes=args.from_node or None,
+            to_nodes=args.to_node or None,
+            raise_on_failure=False,
+            resume_from=args.resume_from,
+            lint=args.lint,
+        )
+    except LintGateError as e:
+        print(str(e), file=sys.stderr)
+        return EXIT_GATED
     print(f"run {result.run_id}: "
           f"{'OK' if result.succeeded else 'FAILED'}")
     for node_id, nr in result.nodes.items():

@@ -1,0 +1,414 @@
+"""Layer 2: TPP2xx code rules over executor and module-file sources.
+
+Where the graph rules see the IR, these see the *code* each node will run:
+``inspect.getsource`` of every component executor plus the user entry
+points the component loads by path (Trainer ``run_fn``, Transform
+``preprocessing_fn`` — declared per component via ``LINT_MODULE_FNS``).
+Three hazard families, all of which today fail minutes into a run or
+silently poison the execution cache:
+
+  * cache staleness — closures defeating the source-only executor
+    fingerprint (TPP201);
+  * fork safety — payloads handed to ``ShardPlan.map_shards`` that cannot
+    cross the fork/pickle boundary (TPP202);
+  * JAX tracing hazards inside jitted regions — host sync, impurity,
+    Python control flow on tracers (TPP203/204/205).
+
+Detection is intentionally static + shallow: the analyzer never calls user
+code (loading a module file executes its top level, same as the runner
+would; that is the one exception and failures are themselves a finding,
+TPP206).  Heuristics err toward silence outside jit regions and are
+line-suppressible with ``# tpp: disable=TPPnnn``.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set
+
+from tpu_pipelines.analysis.findings import (
+    ERROR,
+    WARN,
+    Finding,
+    suppressed_in_source,
+)
+from tpu_pipelines.data.shard_plan import fork_unsafe_reason
+from tpu_pipelines.utils.fingerprint import stable_token
+
+_HOST_SYNC_BUILTINS = {"float", "int", "bool"}
+# Dotted-call prefixes that bake a host-side value in at trace time.
+_IMPURE_PREFIXES = (
+    "time.time", "time.perf_counter", "time.monotonic",
+    "random.", "np.random.", "numpy.random.",
+)
+
+
+# --------------------------------------------------------------- jit regions
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.jit' for Attribute chains, 'jit' for Names, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """Does this expression denote the jit transform itself?"""
+    name = _dotted(node)
+    return name == "jit" or name.endswith(".jit")
+
+
+def _jit_marked(deco: ast.AST) -> bool:
+    """True for @jit / @jax.jit / @jax.jit(...) / @partial(jax.jit, ...)."""
+    if _is_jit_expr(deco):
+        return True
+    if isinstance(deco, ast.Call):
+        if _is_jit_expr(deco.func):
+            return True
+        if _dotted(deco.func).endswith("partial"):
+            return any(_is_jit_expr(a) for a in deco.args)
+    return False
+
+
+def _jit_regions(tree: ast.AST):
+    """Yield (fn_node, param_names) for every statically-visible jitted
+    region: decorated defs, defs wrapped by ``f = jax.jit(f)`` style
+    assignments, and ``jax.jit(lambda ...)`` literals."""
+    wrapped: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_expr(node.func):
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    wrapped.add(arg.id)
+                elif isinstance(arg, ast.Lambda):
+                    yield arg, {a.arg for a in arg.args.args}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in wrapped or any(
+                _jit_marked(d) for d in node.decorator_list
+            ):
+                args = node.args
+                params = {
+                    a.arg
+                    for a in (
+                        list(args.posonlyargs) + list(args.args)
+                        + list(args.kwonlyargs)
+                    )
+                }
+                yield node, params
+
+
+def _region_body(region: ast.AST):
+    if isinstance(region, ast.Lambda):
+        return [region.body]
+    return region.body
+
+
+# ------------------------------------------------------------ source loading
+
+
+class _Source:
+    """A callable's source + real file/line mapping, or None if unknown."""
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+        self.file = ""
+        self.start = 1
+        self.lines: List[str] = []
+        self.tree: Optional[ast.AST] = None
+        try:
+            self.file = inspect.getsourcefile(fn) or ""
+            lines, start = inspect.getsourcelines(fn)
+        except (OSError, TypeError):
+            return
+        self.start = start
+        self.lines = lines
+        try:
+            self.tree = ast.parse(textwrap.dedent("".join(lines)))
+        except SyntaxError:
+            self.tree = None
+
+    def line_of(self, node: ast.AST) -> int:
+        return self.start + getattr(node, "lineno", 1) - 1
+
+    def text_at(self, node: ast.AST) -> str:
+        idx = getattr(node, "lineno", 1) - 1
+        if 0 <= idx < len(self.lines):
+            return self.lines[idx]
+        return ""
+
+
+def _finding(
+    src: _Source, node: ast.AST, rule: str, severity: str, node_id: str,
+    message: str, fix: str,
+) -> Optional[Finding]:
+    if suppressed_in_source(src.text_at(node), rule):
+        return None
+    return Finding(
+        rule=rule, severity=severity, node_id=node_id, message=message,
+        file=src.file, line=src.line_of(node), fix=fix,
+    )
+
+
+# ------------------------------------------------------------------- checks
+
+
+def _check_jit_hazards(
+    src: _Source, node_id: str, fn_label: str
+) -> List[Finding]:
+    out: List[Finding] = []
+    for region, params in _jit_regions(src.tree):
+        region_name = getattr(region, "name", "<lambda>")
+        for stmt in _region_body(region):
+            for node in ast.walk(stmt):
+                f = _check_jit_node(
+                    src, node, params, node_id, fn_label, region_name
+                )
+                if f:
+                    out.append(f)
+    return out
+
+
+def _check_jit_node(
+    src, node, params, node_id, fn_label, region_name
+) -> Optional[Finding]:
+    if isinstance(node, ast.Call):
+        # TPP203: host sync — .item() or float()/int()/bool() on a value.
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+            return _finding(
+                src, node, "TPP203", ERROR, node_id,
+                f"{fn_label}: .item() inside jitted {region_name!r} forces "
+                "a device->host sync (on a tracer it fails at trace time)",
+                "return the array and read it outside the jitted region, "
+                "or use jax.debug.print for diagnostics",
+            )
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in _HOST_SYNC_BUILTINS
+            and node.args
+            and not isinstance(node.args[0], ast.Constant)
+        ):
+            return _finding(
+                src, node, "TPP203", ERROR, node_id,
+                f"{fn_label}: {node.func.id}() on a traced value inside "
+                f"jitted {region_name!r} concretizes the tracer "
+                "(host sync / ConcretizationTypeError)",
+                "keep values as jax arrays inside jit; convert outside",
+            )
+        # TPP204: impurity — host time/randomness baked in at trace time.
+        dotted = _dotted(node.func)
+        if dotted and any(
+            dotted == p or dotted.startswith(p) for p in _IMPURE_PREFIXES
+        ):
+            return _finding(
+                src, node, "TPP204", WARN, node_id,
+                f"{fn_label}: {dotted}() inside jitted {region_name!r} "
+                "runs once at trace time, then is constant for every "
+                "compiled call",
+                "pass the value in as an argument, or use jax.random with "
+                "an explicit key",
+            )
+    # TPP205: Python control flow on a traced value.
+    if isinstance(node, (ast.If, ast.While)):
+        names = {
+            n.id for n in ast.walk(node.test) if isinstance(n, ast.Name)
+        }
+        hits = sorted(names & params)
+        if hits:
+            return _finding(
+                src, node.test, "TPP205", WARN, node_id,
+                f"{fn_label}: Python `{type(node).__name__.lower()}` on "
+                f"argument(s) {hits} inside jitted {region_name!r}; if "
+                "the value is traced this fails at trace time, and if "
+                "static it silently specializes the compile",
+                "use jax.lax.cond/select or jnp.where; mark genuinely "
+                "static args with static_argnums",
+            )
+    return None
+
+
+def _check_map_shards_payload(
+    src: _Source, node_id: str, fn_label: str, fn: Callable
+) -> List[Finding]:
+    """TPP202: payloads handed to map_shards must survive fork+pickle."""
+    out: List[Finding] = []
+    nested_defs = {
+        n.name
+        for n in ast.walk(src.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _dotted(node.func)
+        if not (callee == "map_shards" or callee.endswith(".map_shards")):
+            continue
+        if not node.args:
+            continue
+        payload = node.args[0]
+        if isinstance(payload, ast.Lambda):
+            f = _finding(
+                src, payload, "TPP202", ERROR, node_id,
+                f"{fn_label}: lambda passed to map_shards cannot be "
+                "pickled across the fork process pool",
+                "hoist it to a module-level function taking plain-data "
+                "args (the per-shard worker contract), or use thread_map",
+            )
+            if f:
+                out.append(f)
+        elif isinstance(payload, ast.Name):
+            if payload.id in nested_defs:
+                f = _finding(
+                    src, payload, "TPP202", ERROR, node_id,
+                    f"{fn_label}: nested function {payload.id!r} passed "
+                    "to map_shards is not picklable (and its closure "
+                    "rides into the fork)",
+                    "hoist the worker to module level; pass captured "
+                    "state as explicit plain-data task args",
+                )
+                if f:
+                    out.append(f)
+            else:
+                out.extend(_check_resolved_payload(
+                    src, payload, node_id, fn_label, fn
+                ))
+    return out
+
+
+def _check_resolved_payload(
+    src: _Source, payload: ast.Name, node_id: str, fn_label: str,
+    fn: Callable,
+) -> List[Finding]:
+    """Resolve a module-level payload name and inspect its captured state
+    (closure cells + defaults) for fork-unsafe values."""
+    target = getattr(fn, "__globals__", {}).get(payload.id)
+    if not callable(target):
+        return []
+    out = []
+    for kind, name, value in _captured_state(target):
+        reason = fork_unsafe_reason(value)
+        if reason is None:
+            continue
+        f = _finding(
+            src, payload, "TPP202", ERROR, node_id,
+            f"{fn_label}: map_shards worker {payload.id!r} carries a "
+            f"{reason} via {kind} {name!r}; it cannot cross the fork/"
+            "pickle boundary (locks deadlock, handles alias, device "
+            "arrays are invalid in the child)",
+            "open handles / build device state inside the worker, per "
+            "shard, instead of capturing it",
+        )
+        if f:
+            out.append(f)
+    return out
+
+
+def _captured_state(fn: Callable):
+    """(kind, name, value) for closure cells and argument defaults."""
+    code = getattr(fn, "__code__", None)
+    cells = getattr(fn, "__closure__", None) or ()
+    names = getattr(code, "co_freevars", ()) if code else ()
+    for name, cell in zip(names, cells):
+        try:
+            yield "closure cell", name, cell.cell_contents
+        except ValueError:
+            continue
+    for i, value in enumerate(getattr(fn, "__defaults__", None) or ()):
+        yield "default", f"arg[{-len(fn.__defaults__) + i}]", value
+    for name, value in (getattr(fn, "__kwdefaults__", None) or {}).items():
+        yield "default", name, value
+
+
+def _check_closure_staleness(
+    src: _Source, node_id: str, fn_label: str, fn: Callable
+) -> List[Finding]:
+    """TPP201: fingerprint_callable hashes source + stably-encodable
+    captured values.  A closure cell whose value has no stable encoding is
+    invisible to the executor version hash — edit the captured config and
+    yesterday's cached executions still hit."""
+    out: List[Finding] = []
+    for kind, name, value in _captured_state(fn):
+        if kind != "closure cell":
+            continue
+        token, stable = stable_token(value)
+        del token
+        if stable:
+            continue
+        if suppressed_in_source(src.lines[0] if src.lines else "", "TPP201"):
+            continue
+        out.append(Finding(
+            rule="TPP201", severity=WARN, node_id=node_id,
+            message=(
+                f"{fn_label}: closure captures {name!r} "
+                f"({type(value).__name__}) with no stable encoding; the "
+                "executor version hash cannot see changes to it, so "
+                "cached executions go stale silently"
+            ),
+            file=src.file, line=src.start,
+            fix="pass it through exec_properties (cache-keyed) or make "
+                "it a JSON-native / stably-reprable value",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------- entrypoint
+
+
+def check_callable(
+    fn: Callable, node_id: str, label: str = ""
+) -> List[Finding]:
+    """All TPP2xx checks for one callable; silent when source is missing
+    (builtins, C extensions — nothing static analysis can say)."""
+    src = _Source(fn)
+    label = label or getattr(fn, "__qualname__", repr(fn))
+    out: List[Finding] = []
+    out.extend(_check_closure_staleness(src, node_id, label, fn))
+    if src.tree is None:
+        return out
+    out.extend(_check_jit_hazards(src, node_id, label))
+    out.extend(_check_map_shards_payload(src, node_id, label, fn))
+    return out
+
+
+def check_component_code(comp: Any) -> List[Finding]:
+    """TPP2xx findings for one Component: its executor plus every module-
+    file entry point it declares via ``LINT_MODULE_FNS``."""
+    out: List[Finding] = []
+    cls = type(comp)
+    executor = getattr(cls, "EXECUTOR", None)
+    if executor is not None:
+        out.extend(check_callable(executor, comp.id, f"executor {cls.__name__}"))
+    module_file = comp.exec_properties.get("module_file")
+    if isinstance(module_file, str) and module_file:
+        for entry in getattr(cls, "LINT_MODULE_FNS", ()):
+            out.extend(_check_module_entry(comp.id, module_file, entry))
+    return out
+
+
+def _check_module_entry(
+    node_id: str, module_file: str, entry: str
+) -> List[Finding]:
+    from tpu_pipelines.utils.module_loader import load_fn
+
+    try:
+        fn = load_fn(module_file, entry)
+    except Exception as e:  # import error, missing attr, bad path
+        return [Finding(
+            rule="TPP206", severity=ERROR, node_id=node_id,
+            message=(
+                f"module entry point {entry!r} failed to load from "
+                f"{module_file}: {type(e).__name__}: {e}"
+            ),
+            file=module_file,
+            fix=f"the runner will fail at this node; fix {entry!r} in "
+                "the module file before running",
+        )]
+    return check_callable(fn, node_id, f"{entry} ({module_file})")

@@ -1,0 +1,219 @@
+"""Finding model + rule catalog for the static pipeline analyzer.
+
+`tpp lint` is the compile-time contract check the reference stack gets from
+its DSL→IR compiler (PAPER.md §[PUBLIC-TFX]): a pipeline is *validated*
+before anything executes.  Every check in `graph_rules` (TPP1xx, IR-level)
+and `code_rules` (TPP2xx, executor-AST-level) emits `Finding`s — structured,
+stable-id, attributable to a node and usually a file:line — so runners, the
+CLI, and CI can gate on them uniformly.
+
+Severity semantics:
+  * ERROR — the run (or its execution cache) WILL misbehave: nondeterministic
+    cache keys, unpicklable fork payloads, host sync inside jit, wiring that
+    cannot resolve.  Gates refuse to run by default (`--fail-on error`).
+  * WARN — correct but wasteful or fragile: dead-end nodes, chip-mutex
+    serialization, redundant deadlines.  Opt into gating with
+    `--fail-on warn`.
+
+Suppression is per node per rule: `comp.with_lint_suppressions("TPP103")`
+(compiled into `NodeIR.lint_suppress`), or — for code rules — a trailing
+`# tpp: disable=TPP203` comment on the offending source line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, Iterable, List, Sequence
+
+ERROR = "error"
+WARN = "warn"
+
+_SEVERITY_RANK = {WARN: 1, ERROR: 2}
+
+# Stable rule catalog.  Ids are append-only: a released TPPnnn never changes
+# meaning (suppressions and CI configs reference them by id).
+RULES: Dict[str, Dict[str, str]] = {
+    # ---- TPP1xx: IR graph rules (analyze_ir / graph_rules.py) ----
+    "TPP101": {
+        "severity": WARN,
+        "title": "dead-end node: no output is consumed and the component "
+                 "has no declared side effect",
+    },
+    "TPP102": {
+        "severity": ERROR,
+        "title": "deadline sanity: execution_timeout_s inconsistent with "
+                 "the docs/RECOVERY.md precedence/retry contract",
+    },
+    "TPP103": {
+        "severity": WARN,
+        "title": "tpu-class nodes share a topo level: with "
+                 "max_parallel_nodes>1 they serialize on the chip mutex",
+    },
+    "TPP104": {
+        "severity": ERROR,
+        "title": "cache-unsafe exec property: value's encoding embeds a "
+                 "memory address, poisoning the execution cache key",
+    },
+    "TPP105": {
+        "severity": WARN,
+        "title": "unresolved runtime parameter: no default and no value "
+                 "until run start",
+    },
+    "TPP106": {
+        "severity": ERROR,
+        "title": "input references a producer that is not in the pipeline",
+    },
+    "TPP107": {
+        "severity": ERROR,
+        "title": "duplicate node id",
+    },
+    # ---- TPP2xx: executor/AST code rules (code_rules.py) ----
+    "TPP201": {
+        "severity": WARN,
+        "title": "executor closure captures an un-fingerprintable value: "
+                 "editing it cannot invalidate cached executions",
+    },
+    "TPP202": {
+        "severity": ERROR,
+        "title": "fork-unsafe map_shards payload: lambda/nested function "
+                 "or captured lock/handle/device array cannot cross the "
+                 "fork boundary",
+    },
+    "TPP203": {
+        "severity": ERROR,
+        "title": "host sync inside a jitted region (.item()/float()/int() "
+                 "on a traced value)",
+    },
+    "TPP204": {
+        "severity": WARN,
+        "title": "impure call inside a jitted region (time/random baked "
+                 "in at trace time)",
+    },
+    "TPP205": {
+        "severity": WARN,
+        "title": "Python branch on a traced value inside a jitted region",
+    },
+    "TPP206": {
+        "severity": ERROR,
+        "title": "module-file entry point cannot be loaded",
+    },
+}
+
+GRAPH_RULE_PREFIX = "TPP1"
+CODE_RULE_PREFIX = "TPP2"
+
+# Trailing-comment suppression for code rules:  `x.item()  # tpp: disable=TPP203`
+# (comma-separates multiple ids; bare `# tpp: disable` silences every rule on
+# that line).
+_DISABLE_RE = re.compile(
+    r"#\s*tpp:\s*disable(?:=(?P<ids>[A-Z0-9, ]+))?", re.IGNORECASE
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One analyzer result, stable and machine-consumable.
+
+    ``file``/``line`` point at the offending source for code rules and at
+    nothing for pure graph rules (the node id is the address there).
+    ``fix`` is the one-line remediation hint printed next to the finding.
+    """
+
+    rule: str
+    severity: str           # "error" | "warn"
+    message: str
+    node_id: str = ""
+    file: str = ""
+    line: int = 0
+    fix: str = ""
+
+    def location(self) -> str:
+        if self.file:
+            return f"{self.file}:{self.line}" if self.line else self.file
+        return ""
+
+    def to_json(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    def format(self) -> str:
+        loc = self.location()
+        parts = [
+            f"{self.node_id or '<pipeline>'}:",
+            self.severity.upper(),
+            self.rule,
+            self.message,
+        ]
+        line = " ".join(parts)
+        if loc:
+            line += f"  ({loc})"
+        if self.fix:
+            line += f"\n    fix: {self.fix}"
+        return line
+
+
+def severity_rank(severity: str) -> int:
+    return _SEVERITY_RANK.get(severity, 0)
+
+
+def max_severity(findings: Iterable[Finding]) -> str:
+    """Highest severity present, '' when there are no findings."""
+    best = ""
+    for f in findings:
+        if severity_rank(f.severity) > severity_rank(best):
+            best = f.severity
+    return best
+
+
+def count_by_severity(findings: Iterable[Finding]) -> Dict[str, int]:
+    counts = {ERROR: 0, WARN: 0}
+    for f in findings:
+        counts[f.severity] = counts.get(f.severity, 0) + 1
+    return counts
+
+
+def gated(findings: Sequence[Finding], fail_on: str) -> List[Finding]:
+    """The findings that trip a gate configured at ``fail_on`` level.
+
+    ``fail_on`` is "error" (default: only ERRORs gate) or "warn" (any
+    finding gates).  Unknown levels gate nothing — the runner treats a
+    typo'd TPP_LINT as advisory rather than bricking the run.
+    """
+    floor = severity_rank(fail_on)
+    if floor == 0:
+        return []
+    return [f for f in findings if severity_rank(f.severity) >= floor]
+
+
+def suppressed_in_source(line_text: str, rule: str) -> bool:
+    """True when the source line carries a `# tpp: disable` for ``rule``."""
+    m = _DISABLE_RE.search(line_text)
+    if not m:
+        return False
+    ids = m.group("ids")
+    if not ids:
+        return True  # bare disable: everything on this line
+    return rule.upper() in {s.strip().upper() for s in ids.split(",")}
+
+
+def apply_node_suppressions(
+    findings: Sequence[Finding], suppress_by_node: Dict[str, Sequence[str]]
+) -> List[Finding]:
+    """Drop findings whose node suppressed that rule (NodeIR.lint_suppress)."""
+    out = []
+    for f in findings:
+        rules = {r.upper() for r in suppress_by_node.get(f.node_id, ())}
+        if f.rule.upper() in rules:
+            continue
+        out.append(f)
+    return out
+
+
+def sort_findings(findings: Iterable[Finding]) -> List[Finding]:
+    """Stable display order: errors first, then rule id, then node."""
+    return sorted(
+        findings,
+        key=lambda f: (
+            -severity_rank(f.severity), f.rule, f.node_id, f.file, f.line,
+        ),
+    )

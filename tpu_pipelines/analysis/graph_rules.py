@@ -1,0 +1,286 @@
+"""Layer 1: TPP1xx graph rules over the compiled ``PipelineIR``.
+
+These run in milliseconds on the same IR every runner consumes, so the
+CLI gate, the LocalDagRunner pre-flight, and the cluster runner's
+pre-emit check all see exactly what would execute — not the DSL objects.
+Each rule is a pure function ``(ir) -> [Finding]``; the registry at the
+bottom is what ``analyze_ir`` iterates, and fixtures in
+tests/test_analysis.py pin one deliberately broken pipeline per rule id.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from tpu_pipelines.analysis.findings import ERROR, WARN, Finding
+from tpu_pipelines.dsl.compiler import PipelineIR, is_runtime_param
+from tpu_pipelines.utils.fingerprint import find_unjsonable
+
+# The deadline watchdog publishes FAILED(timeout) only after the executor
+# attempt actually started; a sub-second budget cannot cover even process
+# startup + driver phase, so it is near-certainly a units mistake
+# (minutes-as-seconds is the one we've seen; seconds-as-milliseconds is this
+# one).
+MIN_SANE_TIMEOUT_S = 1.0
+
+
+def check_dead_end_nodes(ir: PipelineIR) -> List[Finding]:
+    """TPP101: a node with outputs that nothing consumes and no declared
+    side effect computes into the void — usually a wiring mistake (the
+    author meant to feed it downstream) or dead weight on the critical
+    path.  Sink components (Pusher, validators, BulkInferrer, Evaluator)
+    declare ``IS_SINK`` and are exempt: their value IS the side effect /
+    gate, not the artifact."""
+    consumed: Set[Tuple[str, str]] = set()
+    for node in ir.nodes:
+        for refs in node.inputs.values():
+            for ref in refs:
+                if ref.producer:
+                    consumed.add((ref.producer, ref.output_key))
+    out = []
+    for node in ir.nodes:
+        if not node.outputs or getattr(node, "is_sink", False):
+            continue
+        if any((node.id, key) in consumed for key in node.outputs):
+            continue
+        out.append(Finding(
+            rule="TPP101", severity=WARN, node_id=node.id,
+            message=(
+                f"outputs {sorted(node.outputs)} are not consumed by any "
+                "node; the node burns schedule time for artifacts nothing "
+                "reads"
+            ),
+            fix=(
+                "wire an output into a downstream component, drop the "
+                "node, or mark the component IS_SINK = True if its side "
+                "effect is the point"
+            ),
+        ))
+    return out
+
+
+def check_deadline_sanity(ir: PipelineIR) -> List[Finding]:
+    """TPP102: deadline values that contradict the docs/RECOVERY.md
+    contract.  The deadline covers the node's WHOLE launcher phase — all
+    retry attempts included — and expiry is terminal, so a malformed or
+    sub-second budget does not fail fast, it fails *always*."""
+    out = []
+    default = float(getattr(ir, "default_node_timeout_s", 0.0) or 0.0)
+    for node in ir.nodes:
+        t = float(getattr(node, "execution_timeout_s", 0.0) or 0.0)
+        if t < 0:
+            out.append(Finding(
+                rule="TPP102", severity=ERROR, node_id=node.id,
+                message=f"execution_timeout_s={t} is negative",
+                fix="use 0 for no deadline, a positive budget otherwise",
+            ))
+        elif 0 < t < MIN_SANE_TIMEOUT_S:
+            out.append(Finding(
+                rule="TPP102", severity=ERROR, node_id=node.id,
+                message=(
+                    f"execution_timeout_s={t} is sub-second; the deadline "
+                    "covers every retry attempt, so this node can never "
+                    "complete (likely a units mistake)"
+                ),
+                fix="deadlines are in seconds; budget the slowest attempt "
+                    "times (1 + max_retries)",
+            ))
+        elif t > 0 and node.is_resolver:
+            out.append(Finding(
+                rule="TPP102", severity=WARN, node_id=node.id,
+                message=(
+                    "deadline set on a resolver node: resolvers answer "
+                    "from the metadata store and never launch an executor, "
+                    "so the watchdog has nothing to fence"
+                ),
+                fix="drop the execution_timeout_s on this node",
+            ))
+        elif t > 0 and default > 0 and t == default:
+            out.append(Finding(
+                rule="TPP102", severity=WARN, node_id=node.id,
+                message=(
+                    f"per-node deadline {t}s duplicates the pipeline "
+                    f"default (node_timeout_s={default}); the override is "
+                    "redundant and hides the single knob"
+                ),
+                fix="remove the per-node override and keep "
+                    "Pipeline(node_timeout_s=...)",
+            ))
+    return out
+
+
+def check_tpu_level_conflicts(ir: PipelineIR) -> List[Finding]:
+    """TPP103: two+ tpu-class nodes in one topo level LOOK parallel to the
+    scheduler but serialize on the chip mutex (at most one tpu executor
+    holds the device) — PR 4's RunTrace measures exactly this as
+    ``gate_wait`` on the second node.  The DAG shape promises concurrency
+    the hardware contract will revoke; restructure or accept the wait."""
+    out = []
+    try:
+        levels = ir.topo_levels()
+    except KeyError:
+        # Dangling upstream edge: the IR is structurally broken and
+        # TPP106 reports the real problem; depth analysis is meaningless.
+        return out
+    for depth, level in enumerate(levels):
+        tpu_nodes = sorted(
+            nid for nid in level
+            if getattr(ir.node(nid), "resource_class", "host") == "tpu"
+        )
+        if len(tpu_nodes) < 2:
+            continue
+        others = ", ".join(tpu_nodes[1:])
+        for nid in tpu_nodes:
+            out.append(Finding(
+                rule="TPP103", severity=WARN, node_id=nid,
+                message=(
+                    f"topo level {depth} holds {len(tpu_nodes)} tpu-class "
+                    f"nodes ({', '.join(tpu_nodes)}); with "
+                    "max_parallel_nodes>1 they serialize on the chip mutex "
+                    "and the extras accrue measured gate-wait (RunTrace "
+                    "gate_wait_s)"
+                ),
+                fix=(
+                    "chain them explicitly, move one off the chip "
+                    "(resource_class='host'), or suppress if the wait is "
+                    "accepted"
+                ),
+            ))
+    return out
+
+
+def check_cache_unsafe_properties(ir: PipelineIR) -> List[Finding]:
+    """TPP104: exec-property values outside the JSON-native set feed the
+    execution cache key through a repr fallback.  A repr embedding a
+    memory address (`<obj at 0x7f..>`) changes every process, so the node
+    NEVER cache-hits — or worse, two different configs collide once the
+    address is scrubbed.  ERROR for address-bearing values, WARN for any
+    other non-JSON-native value (deterministically encoded today, but the
+    encoding sees only ``str(value)``, not the value's real state)."""
+    out = []
+    for node in ir.nodes:
+        for path, value, has_addr in find_unjsonable(node.exec_properties):
+            where = f"exec_properties[{path}]"
+            if has_addr:
+                out.append(Finding(
+                    rule="TPP104", severity=ERROR, node_id=node.id,
+                    message=(
+                        f"{where} = {type(value).__name__!r} encodes with "
+                        "a memory address; the execution cache key is "
+                        "nondeterministic across processes"
+                    ),
+                    fix=(
+                        "pass JSON-native values (str/int/float/bool/"
+                        "list/dict) or give the object a deterministic "
+                        "__repr__ without the address"
+                    ),
+                ))
+            else:
+                out.append(Finding(
+                    rule="TPP104", severity=WARN, node_id=node.id,
+                    message=(
+                        f"{where} = {type(value).__name__!r} is not "
+                        "JSON-native; the cache key sees only str(value), "
+                        "so state changes invisible to str() cannot "
+                        "invalidate cached executions"
+                    ),
+                    fix="pass JSON-native values or encode the state "
+                        "explicitly (e.g. dataclasses.asdict)",
+                ))
+    return out
+
+
+def check_unresolved_runtime_parameters(ir: PipelineIR) -> List[Finding]:
+    """TPP105: a RuntimeParameter placeholder with no default resolves to
+    None unless `run(runtime_parameters={...})` supplies it — a latent
+    TypeError minutes into the run instead of a lint line now."""
+    out = []
+    for node in ir.nodes:
+        for key, value in _walk_props(node.exec_properties):
+            if is_runtime_param(value) and value.get("default") is None:
+                name = value["__runtime_parameter__"]
+                out.append(Finding(
+                    rule="TPP105", severity=WARN, node_id=node.id,
+                    message=(
+                        f"exec_properties[{key}] is "
+                        f"RuntimeParameter({name!r}) with no default; the "
+                        "executor sees None unless every run supplies it"
+                    ),
+                    fix=f"give {name!r} a default, or document/enforce "
+                        "the runtime_parameters contract in CI",
+                ))
+    return out
+
+
+def check_missing_producers(ir: PipelineIR) -> List[Finding]:
+    """TPP106: an input ref naming a producer that is not in the node set
+    can never resolve — typically a component consumed a channel from an
+    object that was never added to (or was removed from) the pipeline."""
+    ids = {n.id for n in ir.nodes}
+    out = []
+    for node in ir.nodes:
+        for key, refs in node.inputs.items():
+            for ref in refs:
+                if ref.producer and ref.producer not in ids:
+                    out.append(Finding(
+                        rule="TPP106", severity=ERROR, node_id=node.id,
+                        message=(
+                            f"input {key!r} references producer "
+                            f"{ref.producer!r} which is not in the "
+                            "pipeline"
+                        ),
+                        fix="add the producer component to the pipeline "
+                            "or rewire the input",
+                    ))
+        for up in node.upstream:
+            if up not in ids:
+                out.append(Finding(
+                    rule="TPP106", severity=ERROR, node_id=node.id,
+                    message=f"upstream {up!r} is not in the pipeline",
+                    fix="add the missing component or drop the edge",
+                ))
+    return out
+
+
+def check_duplicate_node_ids(ir: PipelineIR) -> List[Finding]:
+    """TPP107: duplicate node ids alias each other's artifacts, cache
+    entries, and metadata rows.  The Pipeline constructor refuses this at
+    authoring time; the rule catches hand-built or post-processed IR."""
+    seen: Dict[str, int] = {}
+    for node in ir.nodes:
+        seen[node.id] = seen.get(node.id, 0) + 1
+    return [
+        Finding(
+            rule="TPP107", severity=ERROR, node_id=nid,
+            message=f"node id {nid!r} appears {n} times in the IR",
+            fix="use .with_id()/instance_name= to disambiguate",
+        )
+        for nid, n in sorted(seen.items()) if n > 1
+    ]
+
+
+def _walk_props(obj, prefix=""):
+    """Yield (path, value) over nested dict/list exec-property trees."""
+    if isinstance(obj, dict):
+        if is_runtime_param(obj):
+            yield prefix or "<root>", obj
+            return
+        for k, v in obj.items():
+            yield from _walk_props(v, f"{prefix}.{k}" if prefix else str(k))
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            yield from _walk_props(v, f"{prefix}[{i}]")
+    else:
+        yield prefix or "<root>", obj
+
+
+# Registry consumed by analyze_ir, in stable catalog order.
+GRAPH_RULES = (
+    check_dead_end_nodes,
+    check_deadline_sanity,
+    check_tpu_level_conflicts,
+    check_cache_unsafe_properties,
+    check_unresolved_runtime_parameters,
+    check_missing_producers,
+    check_duplicate_node_ids,
+)

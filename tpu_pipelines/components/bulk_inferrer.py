@@ -70,6 +70,7 @@ def _shard_batches(uri, split, shard, batch_size, columns):
         "predict_method": Parameter(type=str, default="forward"),
     },
     resource_class="tpu",
+    is_sink=True,
 )
 def BulkInferrer(ctx):
     from tpu_pipelines.components.evaluator import is_blessed

@@ -105,6 +105,7 @@ def _evaluate(model_uri: str, examples_uri: str, props: Dict) -> EvalOutcome:
         "require_baseline": Parameter(type=bool, default=False),
     },
     resource_class="tpu",
+    is_sink=True,
 )
 def Evaluator(ctx):
     props = ctx.exec_properties

@@ -245,6 +245,7 @@ def compare_splits(
         # Fail the pipeline on ERROR-severity anomalies.
         "fail_on_anomalies": Parameter(type=bool, default=True),
     },
+    is_sink=True,
 )
 def ExampleValidator(ctx):
     stats = load_statistics(ctx.input("statistics").uri)

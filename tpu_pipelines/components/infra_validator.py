@@ -38,6 +38,7 @@ def serving_batch_filter(batch, schema, environment):
 @component(
     inputs={"model": "Model", "examples": "Examples", "schema": "Schema"},
     optional_inputs=("schema",),
+    is_sink=True,
     outputs={"blessing": "InfraBlessing"},
     parameters={
         "split": Parameter(type=str, default="eval"),

@@ -24,6 +24,7 @@ from tpu_pipelines.dsl.component import Parameter, component
         "infra_blessing": "InfraBlessing",
     },
     optional_inputs=("blessing", "infra_blessing"),
+    is_sink=True,
     outputs={"pushed_model": "PushedModel"},
     parameters={
         "push_destination": Parameter(type=str, required=True),

@@ -40,6 +40,7 @@ from tpu_pipelines.utils.module_loader import load_fn
     },
     external_input_parameters=("module_file",),
     resource_class="tpu",
+    lint_module_fns=("run_fn",),
 )
 def Trainer(ctx):
     run_fn = load_fn(ctx.exec_properties["module_file"], "run_fn")

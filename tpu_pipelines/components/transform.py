@@ -54,6 +54,7 @@ log = logging.getLogger(__name__)
     },
     external_input_parameters=("module_file",),
     resource_class="tpu",
+    lint_module_fns=("preprocessing_fn",),
 )
 def Transform(ctx):
     module_file = ctx.exec_properties["module_file"]

@@ -417,6 +417,7 @@ def load_shard_results(
     },
     external_input_parameters=("module_file",),
     resource_class="tpu",
+    lint_module_fns=("run_fn",),
 )
 def Tuner(ctx):
     module_file = ctx.exec_properties["module_file"]

@@ -74,6 +74,43 @@ class ShardPlan:
         )
 
 
+def fork_unsafe_reason(value) -> Optional[str]:
+    """Why ``value`` must not ride into a ``map_shards`` fork, or None.
+
+    This is the pickle/fork half of the per-shard worker contract
+    (module-level function + plain-data args): locks deadlock in the child
+    (the owning thread does not exist there), open handles alias the
+    parent's file offsets, database connections and sockets share kernel
+    state, and device arrays reference parent-process runtime buffers the
+    child cannot touch.  The TPP202 lint rule (tpu_pipelines/analysis)
+    reports captures of these before a run ever forks.
+    """
+    import io
+    import socket
+    import sqlite3
+    import threading
+
+    lock_types = (
+        type(threading.Lock()), type(threading.RLock()),
+        threading.Event, threading.Condition, threading.Semaphore,
+        threading.BoundedSemaphore, threading.Barrier,
+    )
+    if isinstance(value, lock_types):
+        return "thread synchronization primitive"
+    if isinstance(value, io.IOBase):
+        return "open file handle"
+    if isinstance(value, sqlite3.Connection):
+        return "sqlite connection"
+    if isinstance(value, socket.socket):
+        return "socket"
+    # Device arrays, ducked so this module never imports jax: jaxlib's
+    # ArrayImpl (and tracer types) live under jax/jaxlib modules.
+    mod = type(value).__module__ or ""
+    if mod.split(".")[0] in ("jaxlib", "jax") and hasattr(value, "devices"):
+        return "device array"
+    return None
+
+
 def _pool_workers(n_tasks: int, workers: Optional[int]) -> int:
     """Effective worker count: TPP_DATA_POOL_WORKERS overrides everything
     (the test/oversubscribed-host knob), then the caller's cap, then

@@ -15,7 +15,7 @@ from typing import Any, Dict, List
 
 from tpu_pipelines.dsl.component import Component, RuntimeParameter
 from tpu_pipelines.dsl.pipeline import Pipeline
-from tpu_pipelines.utils.fingerprint import fingerprint_callable
+from tpu_pipelines.utils.fingerprint import canonical_json, fingerprint_callable
 
 IR_SCHEMA_VERSION = "tpu-pipelines-ir/v1"
 
@@ -79,6 +79,13 @@ class NodeIR:
     # default, then env TPP_NODE_TIMEOUT_S).  Local runner: scheduler
     # watchdog; cluster runner: activeDeadlineSeconds.
     execution_timeout_s: float = 0.0
+    # Declared side effect (Component.IS_SINK): exempts the node from the
+    # TPP101 dead-end analyzer rule — its unconsumed outputs are expected.
+    is_sink: bool = False
+    # Analyzer rule ids suppressed for this node (Component.LINT_SUPPRESS /
+    # .with_lint_suppressions()); tpu_pipelines/analysis drops matching
+    # findings.  Operational metadata: excluded from the DAG fingerprint.
+    lint_suppress: List[str] = dataclasses.field(default_factory=list)
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -97,6 +104,8 @@ class NodeIR:
             "conditions": list(self.conditions),
             "resource_class": self.resource_class,
             "execution_timeout_s": self.execution_timeout_s,
+            "is_sink": self.is_sink,
+            "lint_suppress": list(self.lint_suppress),
         }
 
 
@@ -129,8 +138,12 @@ class PipelineIR:
         (nodes, wiring, exec-properties, executor code) must be refused —
         adopted outputs would no longer be what the current DAG produces.
         Deliberately EXCLUDES relocatable/operational fields (pipeline_root,
-        metadata_path, enable_cache, resource_class, timeouts): moving the
-        home or retuning deadlines does not change what a node computes.
+        metadata_path, enable_cache, resource_class, timeouts, lint
+        metadata): moving the home or retuning deadlines does not change
+        what a node computes.  Nodes are serialized SORTED BY ID, not in
+        list order, so reordering component declarations — which permutes
+        same-level siblings in the topo order — cannot change the
+        fingerprint of a structurally identical DAG.
         """
         structural = [
             {
@@ -151,12 +164,14 @@ class PipelineIR:
                 "is_resolver": n.is_resolver,
                 "conditions": list(n.conditions),
             }
-            for n in self.nodes
+            for n in sorted(self.nodes, key=lambda n: n.id)
         ]
-        payload = json.dumps(
+        # canonical_json, not default=str: an exec property whose repr
+        # embeds a memory address must not make the DAG fingerprint (and
+        # with it resume_from) nondeterministic across processes.
+        payload = canonical_json(
             {"schema": self.schema_version, "name": self.name,
              "nodes": structural},
-            sort_keys=True, default=str,
         )
         return hashlib.sha256(payload.encode()).hexdigest()
 
@@ -175,7 +190,10 @@ class PipelineIR:
         level share no data dependency, so a scheduler may run a whole level
         concurrently — the local runner's ready-set scheduling realizes the
         same parallelism dynamically; the cluster runner records the groups
-        as a workflow annotation."""
+        as a workflow annotation.  Ids within a level are SORTED so the
+        groups (like the fingerprint) are invariant under component-
+        declaration reordering — siblings share no dependency, so order
+        inside a group carries no scheduling meaning."""
         level: Dict[str, int] = {}
         for n in self.nodes:  # self.nodes is topologically ordered
             level[n.id] = 1 + max(
@@ -187,7 +205,7 @@ class PipelineIR:
             while len(groups) <= depth:
                 groups.append([])
             groups[depth].append(n.id)
-        return groups
+        return [sorted(g) for g in groups]
 
     def n_roots(self) -> int:
         """Number of DAG roots — the concurrent runner's default pool size."""
@@ -244,6 +262,10 @@ class Compiler:
                     resource_class=getattr(comp, "RESOURCE_CLASS", "host"),
                     execution_timeout_s=float(
                         getattr(comp, "execution_timeout_s", 0.0) or 0.0
+                    ),
+                    is_sink=bool(getattr(comp, "IS_SINK", False)),
+                    lint_suppress=sorted(
+                        getattr(comp, "lint_suppress", ()) or ()
                     ),
                 )
             )

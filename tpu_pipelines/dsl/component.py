@@ -147,6 +147,19 @@ class Component:
     # Locally a scheduler watchdog enforces it; on the cluster it maps to
     # activeDeadlineSeconds (Argo template / JobSet job).
     EXECUTION_TIMEOUT_S: float = 0.0
+    # Declared side effect: the node's value is what it DOES (push a model,
+    # gate a blessing, write external predictions), not the artifacts it
+    # emits — so the TPP101 dead-end lint rule must not flag its unconsumed
+    # outputs.  Pusher/validators/BulkInferrer/Evaluator set this.
+    IS_SINK: bool = False
+    # Lint rule ids suppressed for every instance of this component
+    # (per-instance: .with_lint_suppressions("TPP103")).  Compiled into
+    # NodeIR.lint_suppress; see docs/ANALYSIS.md.
+    LINT_SUPPRESS: tuple = ()
+    # Module-file entry points the Layer-2 analyzer walks in addition to
+    # EXECUTOR: names loaded from exec_properties["module_file"] at run
+    # time (Trainer: run_fn; Transform: preprocessing_fn).
+    LINT_MODULE_FNS: tuple = ()
 
     def __init__(self, instance_name: str = "", **kwargs: Any):
         cls = type(self)
@@ -154,6 +167,7 @@ class Component:
         self.input_channels: Dict[str, List[Channel]] = {}
         self.exec_properties: Dict[str, Any] = {}
         self.execution_timeout_s = float(cls.EXECUTION_TIMEOUT_S or 0.0)
+        self.lint_suppress: List[str] = [str(r) for r in cls.LINT_SUPPRESS]
 
         for key, value in kwargs.items():
             # A key may name both an input and a parameter (e.g. Trainer's
@@ -238,6 +252,25 @@ class Component:
         self.execution_timeout_s = float(seconds)
         return self
 
+    def with_lint_suppressions(self, *rules: str) -> "Component":
+        """Suppress analyzer rules for THIS node (chainable).
+
+        ``rules`` are catalog ids ("TPP103"); unknown ids raise so a typo
+        cannot silently disable nothing.  Suppressions compile into the IR
+        and apply to both graph (TPP1xx) and code (TPP2xx) findings.
+        """
+        from tpu_pipelines.analysis.findings import RULES
+
+        for r in rules:
+            if r.upper() not in RULES:
+                raise ValueError(
+                    f"{self.id}: unknown lint rule {r!r}; known rules: "
+                    f"{sorted(RULES)}"
+                )
+            if r.upper() not in self.lint_suppress:
+                self.lint_suppress.append(r.upper())
+        return self
+
     def __repr__(self) -> str:
         return f"{type(self).__name__}(id={self.id!r})"
 
@@ -251,6 +284,8 @@ def component(
     optional_inputs: tuple = (),
     resource_class: str = "host",
     execution_timeout_s: float = 0.0,
+    is_sink: bool = False,
+    lint_module_fns: tuple = (),
 ) -> Callable[[ExecutorFn], Type[Component]]:
     """Decorator: build a Component subclass from a bare executor function.
 
@@ -285,6 +320,8 @@ def component(
                 "EXTERNAL_INPUT_PARAMETERS": tuple(external_input_parameters),
                 "RESOURCE_CLASS": resource_class,
                 "EXECUTION_TIMEOUT_S": float(execution_timeout_s),
+                "IS_SINK": bool(is_sink),
+                "LINT_MODULE_FNS": tuple(lint_module_fns),
             },
         )
 

@@ -115,6 +115,12 @@ class TPUJobRunnerConfig:
     # a cluster Prometheus with kubernetes_sd discovers the pods with no
     # per-pipeline scrape config.  0 = no server, no annotations.
     metrics_port: int = 0
+    # Static-analysis gate on the compiled IR (docs/ANALYSIS.md) before any
+    # manifest is emitted: "error" (default) refuses on ERROR findings,
+    # "warn" on any finding, "off" disables.  Graph rules (TPP1xx) only —
+    # executor/module sources belong to the image, not this host, so the
+    # Layer-2 code rules run in the pods via the local runner's TPP_LINT.
+    lint: str = "error"
 
 
 class TPUJobRunner:
@@ -126,6 +132,16 @@ class TPUJobRunner:
     def run(self, pipeline: Pipeline) -> Dict[str, str]:
         ir = Compiler().compile(pipeline)
         cfg = self.config
+        if (cfg.lint or "").lower() in ("error", "warn"):
+            # A workflow that cannot succeed must not reach the cluster:
+            # YAML that fans out to N pods before the misconfiguration
+            # surfaces wastes chips and poisons the shared store.
+            from tpu_pipelines.analysis import analyze_ir, gate_or_raise
+
+            gate_or_raise(
+                analyze_ir(ir), cfg.lint.lower(),
+                f"cluster compile ({pipeline.name})",
+            )
         os.makedirs(cfg.output_dir, exist_ok=True)
         out: Dict[str, str] = {}
 

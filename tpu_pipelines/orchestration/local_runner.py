@@ -391,8 +391,17 @@ class LocalDagRunner:
         raise_on_failure: bool = True,
         extras: Optional[Dict[str, Any]] = None,
         resume_from: Optional[str] = None,
+        lint: Optional[str] = None,
     ) -> RunResult:
         """Execute the pipeline.
+
+        ``lint`` opts into the static-analysis pre-flight gate
+        (docs/ANALYSIS.md): "error" refuses to run on any ERROR finding,
+        "warn" on any finding at all; env ``TPP_LINT`` is the fleet-wide
+        default when the argument is None, and "off"/unset skips the
+        analyzer entirely — zero behavior change, byte-identical metadata
+        trace.  The gate runs BEFORE the metadata store is opened, so a
+        refused run leaves no trace anywhere.
 
         ``from_nodes``/``to_nodes`` bound a partial run (TFX partial-run
         semantics): nodes outside the range are not executed; their outputs are
@@ -406,6 +415,22 @@ class LocalDagRunner:
         longer matches the one recorded for that run.
         """
         ir = Compiler().compile(pipeline)
+        lint_level = None
+        if not self.spmd_sync:
+            # Under spmd_sync every process would lint (and potentially
+            # load module files) redundantly; the cluster runner already
+            # gated the IR at manifest-emission time.
+            from tpu_pipelines.analysis import resolve_lint_level
+
+            lint_level = resolve_lint_level(lint)
+        if lint_level:
+            from tpu_pipelines.analysis import analyze_pipeline, gate_or_raise
+
+            findings = analyze_pipeline(pipeline, ir=ir)
+            gate_or_raise(
+                findings, lint_level, f"LocalDagRunner pre-flight "
+                f"({pipeline.name})",
+            )
         executors = {c.id: c for c in pipeline.components}
         from tpu_pipelines.metadata import open_store
 
