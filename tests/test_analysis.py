@@ -266,6 +266,78 @@ def test_tpp107_duplicate_node_ids(tmp_path):
     assert f107[0].severity == "error"
 
 
+def test_tpp108_retry_policy_under_spmd(tmp_path):
+    """Seeded fixture: a node retry policy + the spmd_sync execution
+    context (stamped by `lint --spmd-sync` / multi-host run_node —
+    distribution degree lives in runner configs, so like TPP106/107 the
+    DSL alone cannot author this state)."""
+    gen = _gen().with_retry_policy(max_attempts=3, base_delay_s=0.1)
+    sink = _consumer(gen, name="S", outs={})
+    pipeline = _pipeline([gen, sink], tmp_path)
+    # Without the spmd context the policy is fine (the runner will use it).
+    assert "TPP108" not in _rules(analyze_pipeline(pipeline))
+    findings = analyze_pipeline(pipeline, spmd_sync=True)
+    f108 = [f for f in findings if f.rule == "TPP108"]
+    assert len(f108) == 1 and f108[0].node_id == "Gen"
+    assert f108[0].severity == "error"
+    assert "substrate" in f108[0].fix
+
+
+def test_tpp108_pipeline_default_policy_flags_every_node(tmp_path):
+    gen = _gen()
+    sink = _consumer(gen, name="S", outs={})
+    pipeline = _pipeline(
+        [gen, sink], tmp_path,
+        retry_policy={"max_attempts": 2, "base_delay_s": 0.1},
+    )
+    findings = analyze_pipeline(pipeline, spmd_sync=True)
+    f108 = [f for f in findings if f.rule == "TPP108"]
+    assert {f.node_id for f in f108} == {"Gen", "S"}
+    # The runtime mirror of the rule: the spmd runner refuses outright.
+    from tpu_pipelines.orchestration import LocalDagRunner
+
+    with pytest.raises(ValueError, match="spmd_sync is incompatible"):
+        LocalDagRunner(spmd_sync=True).run(pipeline)
+
+
+def test_tpp108_cli_spmd_sync_flag(tmp_path):
+    module = tmp_path / "spmd_pipeline.py"
+    module.write_text(textwrap.dedent("""
+        import os
+        from tpu_pipelines.dsl.component import component
+        from tpu_pipelines.dsl.pipeline import Pipeline
+
+        @component(outputs={"examples": "Examples"}, name="Gen")
+        def Gen(ctx):
+            pass
+
+        def create_pipeline():
+            home = os.environ.get("TPP_PIPELINE_HOME", "/tmp/x")
+            return Pipeline(
+                "spmd-fixture",
+                [Gen().with_retry_policy(max_attempts=3)],
+                pipeline_root=os.path.join(home, "root"),
+                metadata_path=os.path.join(home, "md.sqlite"),
+            )
+    """))
+    env = {**os.environ, "PYTHONPATH": REPO,
+           "TPP_PIPELINE_HOME": str(tmp_path)}
+    clean = subprocess.run(
+        [sys.executable, "-m", "tpu_pipelines", "lint",
+         "--pipeline-module", str(module), "--json"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    gated_run = subprocess.run(
+        [sys.executable, "-m", "tpu_pipelines", "lint",
+         "--pipeline-module", str(module), "--spmd-sync", "--json"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert gated_run.returncode == 3, gated_run.stdout + gated_run.stderr
+    report = json.loads(gated_run.stdout)
+    assert "TPP108" in report["rules"]
+
+
 # ----------------------------------------------- TPP2xx seeded-bug fixtures
 
 

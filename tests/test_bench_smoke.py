@@ -160,6 +160,34 @@ def test_bench_smoke_emits_compact_stdout_and_full_report():
     assert sv["healthz"]["healthy"] is True
     assert compact["serving_green"] is True
     assert compact["serving_p99_ms"] == sv["p99_ms"]
+    # Unified fault-tolerance chaos leg (ISSUE 7): the taxi run completes
+    # under the injected schedule with lineage identical to fault-free,
+    # exact merged statistics, a quarantined poison shard in the salvage
+    # demo, and a zero-5xx serving reload under the hammer — all
+    # quantified from the metrics registry and surfaced on the compact
+    # line.
+    chaos = report["robustness"]["taxi_chaos"]
+    assert chaos["green"] is True, chaos
+    assert chaos["lineage_identical"] is True
+    assert chaos["stats_identical"] is True
+    assert chaos["trainer_retries"] == 2
+    assert chaos["retries_total"] >= 2
+    assert chaos["store_retries"] >= 2
+    assert chaos["taxi_worker_deaths"] >= 1
+    assert chaos["shards_quarantined"] >= 1  # the salvage demo's poison
+    assert chaos["salvage"]["ok"] is True
+    assert chaos["reload_5xx"] == 0
+    assert chaos["serving"]["reload_ok"] is True
+    assert chaos["serving"]["request_errors"] == 0
+    assert compact["chaos_green"] is True
+    assert compact["reload_5xx"] == 0
+    assert compact["retries_total"] == chaos["retries_total"]
+    assert compact["shards_quarantined"] == chaos["shards_quarantined"]
+    assert compact["shed_requests"] == chaos["shed_requests"]
+    # And the resume leg still reports alongside it.
+    robust = report["robustness"]["taxi_faults"]
+    assert robust["green"] is True, robust
+    assert compact["robust_green"] is True
     # Cross-run trace-diff self-report: the key is always present and
     # list-typed (first run against a foreign/absent baseline => []).
     td = report["trace_diff"]

@@ -55,12 +55,19 @@ def _stub(name, outs, ins=None, sleep_s=0.0, resource_class="host"):
     return C
 
 
-def _diamond(tmp_path, sleep_s=0.05, subdir="d"):
+def _diamond(tmp_path, sleep_s=0.05, subdir="d", sleep_right_s=None,
+             **pipeline_kw):
+    # sleep_right_s: give the parallel branches DISTINCT durations when a
+    # test compares store dumps by row order — equal sleeps make the
+    # Left/Right publish order a scheduler coin flip on a loaded host.
     Gen = _stub("Gen", {"examples": "Examples"})
     Left = _stub("Left", {"statistics": "ExampleStatistics"},
                  {"examples": "Examples"}, sleep_s=sleep_s)
     Right = _stub("Right", {"schema": "Schema"},
-                  {"examples": "Examples"}, sleep_s=sleep_s)
+                  {"examples": "Examples"},
+                  sleep_s=(
+                      sleep_s if sleep_right_s is None else sleep_right_s
+                  ))
     Join = _stub("Join", {"model": "Model"},
                  {"statistics": "ExampleStatistics", "schema": "Schema"})
     gen = Gen()
@@ -73,6 +80,7 @@ def _diamond(tmp_path, sleep_s=0.05, subdir="d"):
         "diamond", [gen, left, right, join],
         pipeline_root=str(home / "root"),
         metadata_path=str(home / "md.sqlite"),
+        **pipeline_kw,
     )
 
 
@@ -195,23 +203,33 @@ def test_perfetto_export_schema_valid(tmp_path):
 
 
 def test_disabled_mode_zero_files_and_identical_metadata(tmp_path):
-    """TPP_TRACE=0 + no TPP_METRICS_PORT + TPP_LINT unset: no .runs dir,
-    no trace files, no extra files of any kind, no metrics listener — and
-    the metadata trace is byte-identical to a traced run's (tracing,
-    telemetry, and the lint pre-flight never touch the store).  The third
-    leg runs WITH lint="error" (the diamond lints warn-only) to prove an
-    enabled-but-passing gate is also invisible to the store."""
+    """TPP_TRACE=0 + no TPP_METRICS_PORT + TPP_LINT unset + no retry
+    policy/env: no .runs dir, no trace files, no extra files of any kind,
+    no metrics listener, no lock sidecar — and the metadata trace is
+    byte-identical to a traced run's (tracing, telemetry, the lint
+    pre-flight, and the retry/multi-writer layers never touch the store).
+    The third leg runs WITH lint="error" (the diamond lints warn-only) to
+    prove an enabled-but-passing gate is also invisible; the fourth runs
+    WITH a pipeline retry policy (nothing fails, so zero retries) to
+    prove an armed-but-unused policy is too."""
     from test_concurrent_runner import _normalized_store_dump
 
     assert "TPP_METRICS_PORT" not in os.environ
     assert "TPP_LINT" not in os.environ
+    assert "TPP_RETRY_MAX_ATTEMPTS" not in os.environ
     dumps = {}
-    for sub, flag, lint in (
-        ("on", "1", None), ("off", "0", None), ("lint", "0", "error"),
+    for sub, flag, lint, retry in (
+        ("on", "1", None, None),
+        ("off", "0", None, None),
+        ("lint", "0", "error", None),
+        ("retry", "0", None, {"max_attempts": 3, "base_delay_s": 0.01}),
     ):
         os.environ["TPP_TRACE"] = flag
         try:
-            p = _diamond(tmp_path, sleep_s=0.01, subdir=sub)
+            p = _diamond(
+                tmp_path, sleep_s=0.01, subdir=sub, sleep_right_s=0.08,
+                **({"retry_policy": retry} if retry else {}),
+            )
             result = LocalDagRunner(max_parallel_nodes=3).run(
                 p, run_id="fixed", lint=lint
             )
@@ -237,6 +255,7 @@ def test_disabled_mode_zero_files_and_identical_metadata(tmp_path):
             os.environ.pop("TPP_TRACE", None)
     assert dumps["on"] == dumps["off"]
     assert dumps["off"] == dumps["lint"]
+    assert dumps["off"] == dumps["retry"]
 
 
 # ------------------------------------------------------------ shard spans

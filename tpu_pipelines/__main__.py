@@ -334,6 +334,10 @@ def main(argv=None) -> int:
     )
     p_lint.add_argument("--pipeline-module", required=True,
                         help="file defining create_pipeline() -> Pipeline")
+    p_lint.add_argument("--spmd-sync", action="store_true",
+                        help="lint as if running under the multi-host "
+                             "spmd runner (arms TPP108: in-runner retry "
+                             "policies are refused there)")
     p_lint.add_argument("--json", action="store_true",
                         help="machine-readable output (one JSON object)")
     p_lint.add_argument("--fail-on", default="error",
@@ -430,7 +434,9 @@ def cmd_lint(args) -> int:
 
     try:
         pipeline = load_fn(args.pipeline_module, "create_pipeline")()
-        findings = analyze_pipeline(pipeline)
+        findings = analyze_pipeline(
+            pipeline, spmd_sync=getattr(args, "spmd_sync", False)
+        )
     except Exception as e:
         # The module failing to load/compile is a tool error (1), not a
         # lint verdict (3): CI must distinguish "pipeline is broken at

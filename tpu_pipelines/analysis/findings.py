@@ -68,6 +68,12 @@ RULES: Dict[str, Dict[str, str]] = {
         "severity": ERROR,
         "title": "duplicate node id",
     },
+    "TPP108": {
+        "severity": ERROR,
+        "title": "in-runner retry policy on an spmd_sync pipeline: the "
+                 "runner refuses it at runtime (substrate owns multi-host "
+                 "retries)",
+    },
     # ---- TPP2xx: executor/AST code rules (code_rules.py) ----
     "TPP201": {
         "severity": WARN,

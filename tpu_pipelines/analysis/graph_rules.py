@@ -259,6 +259,49 @@ def check_duplicate_node_ids(ir: PipelineIR) -> List[Finding]:
     ]
 
 
+def check_retry_policy_under_spmd(ir: PipelineIR) -> List[Finding]:
+    """TPP108: an in-runner retry policy on an ``spmd_sync`` pipeline.
+
+    The spmd runner refuses in-runner retries at runtime (ValueError in
+    ``LocalDagRunner``): a fast-failing process would wipe the shared
+    output dirs and re-enter the executor while its peers are still
+    inside the previous attempt's collectives.  ``PipelineIR.spmd_sync``
+    is stamped by context-aware callers (``lint --spmd-sync``, the
+    multi-host ``run_node`` pre-flight) — distribution degree lives in
+    runner configs, so the DSL alone cannot author this state.
+    """
+    if not getattr(ir, "spmd_sync", False):
+        return []
+    from tpu_pipelines.robustness import RetryPolicy
+
+    default = RetryPolicy.from_json(
+        getattr(ir, "default_retry_policy", None)
+    )
+    out = []
+    for node in ir.nodes:
+        policy = RetryPolicy.from_json(
+            getattr(node, "retry_policy", None)
+        ) or default
+        if policy is None or policy.max_attempts <= 1 or node.is_resolver:
+            continue
+        out.append(Finding(
+            rule="TPP108", severity=ERROR, node_id=node.id,
+            message=(
+                f"retry policy (max_attempts={policy.max_attempts}) on an "
+                "spmd_sync pipeline: in-runner retries would wipe shared "
+                "output dirs while peer processes are mid-attempt, and the "
+                "runner refuses them at runtime"
+            ),
+            fix=(
+                "drop the in-runner policy for multi-host nodes and rely "
+                "on the substrate retry the cluster runner compiles from "
+                "it (Argo retryStrategy / JobSet failurePolicy "
+                "maxRestarts)"
+            ),
+        ))
+    return out
+
+
 def _walk_props(obj, prefix=""):
     """Yield (path, value) over nested dict/list exec-property trees."""
     if isinstance(obj, dict):
@@ -283,4 +326,5 @@ GRAPH_RULES = (
     check_unresolved_runtime_parameters,
     check_missing_producers,
     check_duplicate_node_ids,
+    check_retry_policy_under_spmd,
 )

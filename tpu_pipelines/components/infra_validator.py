@@ -160,27 +160,34 @@ def InfraValidator(ctx):
 
 def _urlopen_backoff(req, timeout: float = 60, attempts: int = 3,
                      base_delay_s: float = 0.5):
-    """``urlopen`` with bounded exponential backoff on connection-level
-    errors (URLError wrapping ECONNREFUSED/reset, raw ConnectionError).
+    """``urlopen`` under the shared :class:`RetryPolicy` (ISSUE 7: this
+    was a private backoff loop — no jitter, invisible attempts).
 
     A model server that is still warming up refuses connections for a
     moment; without the retry the canary would declare the model
-    NOT_BLESSED over a transient, gating a perfectly good push.  HTTP-level
-    errors (4xx/5xx responses) are NOT retried — the server answered, so
-    its verdict stands.
+    NOT_BLESSED over a transient, gating a perfectly good push.  The
+    shared taxonomy encodes the old contract exactly: connection-level
+    failures (URLError wrapping ECONNREFUSED/reset, raw ConnectionError,
+    timeouts) are transient and retried with full-jitter backoff; an
+    ``HTTPError`` is PERMANENT — the server spoke, its verdict stands.
+    Every retry now lands in ``retry_attempts_total{site=
+    "infra_validator.urlopen"}`` on the process metrics registry.
     """
-    import urllib.error
     import urllib.request
 
-    for attempt in range(attempts):
-        try:
-            return urllib.request.urlopen(req, timeout=timeout)
-        except urllib.error.HTTPError:
-            raise  # the server spoke; its answer is the answer
-        except (urllib.error.URLError, ConnectionError, TimeoutError):
-            if attempt == attempts - 1:
-                raise
-            time.sleep(base_delay_s * (2 ** attempt))
+    from tpu_pipelines.robustness import RetryPolicy, retry_call
+
+    return retry_call(
+        urllib.request.urlopen,
+        req,
+        timeout=timeout,
+        policy=RetryPolicy(
+            max_attempts=attempts,
+            base_delay_s=base_delay_s,
+            max_delay_s=8.0,
+        ),
+        site="infra_validator.urlopen",
+    )
 
 
 def _http_canary(model_uri: str, raw: bool = True):

@@ -12,7 +12,7 @@ for mean/std, reservoir-exact while the split fits the reservoir).
 from __future__ import annotations
 
 from tpu_pipelines.data import examples_io
-from tpu_pipelines.data.shard_plan import ShardPlan, map_shards
+from tpu_pipelines.data.shard_plan import ShardPlan, map_shards_resilient
 from tpu_pipelines.data.statistics import (
     SplitStatsAccumulator,
     accumulate_split_shard,
@@ -38,6 +38,15 @@ _RESERVOIR_SIZE = 1 << 17
         # comes from the artifact's shard layout; a single-file split always
         # takes the sequential path regardless of this value.
         "num_shards": Parameter(type=int, default=None),
+        # Partial-salvage mode (docs/RECOVERY.md): when a shard strikes
+        # out of the resilient pool (poisoned file, worker that dies on
+        # every retry), quarantine it and merge the SURVIVING shards —
+        # merged statistics stay exact over the rows actually read
+        # (SplitStatsAccumulator.merge is order-exact), and the
+        # quarantined shard ids land on the execution + artifact so the
+        # degradation is lineage-visible, never silent.  Off by default:
+        # a struck-out shard fails the node.
+        "salvage_shards": Parameter(type=bool, default=False),
     },
 )
 def StatisticsGen(ctx):
@@ -49,13 +58,15 @@ def StatisticsGen(ctx):
         ctx.exec_properties.get("chunk_rows") or examples_io.DEFAULT_ROW_GROUP
     )
     plan = ShardPlan.resolve(ctx.exec_properties.get("num_shards"))
+    salvage = bool(ctx.exec_properties.get("salvage_shards", False))
     stats = {}
     shard_counts = {}
+    quarantined = {}
     for split in splits:
         n_shards = examples_io.num_split_shards(examples.uri, split)
         shard_counts[split] = n_shards
         if n_shards > 1:
-            accs = map_shards(
+            res = map_shards_resilient(
                 accumulate_split_shard,
                 [
                     (examples.uri, split, i, chunk_rows, _RESERVOIR_SIZE)
@@ -63,6 +74,15 @@ def StatisticsGen(ctx):
                 ],
                 workers=min(plan.num_shards, n_shards),
             )
+            if res.errors and not salvage:
+                res.raise_on_failure()
+            if res.errors:
+                if len(res.errors) == n_shards:
+                    # Nothing survived: "salvage" would fabricate an
+                    # empty-statistics artifact for a split that has rows.
+                    res.raise_on_failure()
+                quarantined[split] = res.failure_summary()
+            accs = [a for a in res.results if a is not None]
             acc = merge_accumulators(accs)
         else:
             acc = SplitStatsAccumulator(split)
@@ -74,9 +94,19 @@ def StatisticsGen(ctx):
     out = ctx.output("statistics")
     save_statistics(out.uri, stats)
     out.properties["split_names"] = splits
-    return {
+    props = {
         "data_shards": shard_counts,
         "shard_workers": plan.num_shards,
         "shard_plan_source": plan.source,
         **{f"num_examples_{s}": stats[s].num_examples for s in splits},
     }
+    if quarantined:
+        # Lineage-visible degradation: which shards were salvaged away,
+        # and why — on the artifact (downstream consumers can refuse
+        # partial stats) and the execution record (audit trail).
+        out.properties["quarantined_shards"] = {
+            split: sorted(errs) for split, errs in quarantined.items()
+        }
+        props["quarantined_shards"] = quarantined
+        props["partial_statistics"] = True
+    return props

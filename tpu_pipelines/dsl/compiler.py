@@ -11,7 +11,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import json
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 from tpu_pipelines.dsl.component import Component, RuntimeParameter
 from tpu_pipelines.dsl.pipeline import Pipeline
@@ -86,6 +86,12 @@ class NodeIR:
     # .with_lint_suppressions()); tpu_pipelines/analysis drops matching
     # findings.  Operational metadata: excluded from the DAG fingerprint.
     lint_suppress: List[str] = dataclasses.field(default_factory=list)
+    # Per-node retry policy in RetryPolicy.to_json() form (None = fall back
+    # to PipelineIR.default_retry_policy, then env TPP_RETRY_*).  Local
+    # runner: classified backoff retries in the launcher loop; cluster
+    # runner: Argo retryStrategy / JobSet restarts.  Operational metadata,
+    # excluded from the DAG fingerprint like deadlines.
+    retry_policy: Optional[Dict[str, Any]] = None
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -106,6 +112,9 @@ class NodeIR:
             "execution_timeout_s": self.execution_timeout_s,
             "is_sink": self.is_sink,
             "lint_suppress": list(self.lint_suppress),
+            "retry_policy": (
+                dict(self.retry_policy) if self.retry_policy else None
+            ),
         }
 
 
@@ -120,6 +129,17 @@ class PipelineIR:
     # Pipeline-wide default node deadline (0 = none); a node's own
     # execution_timeout_s takes precedence.
     default_node_timeout_s: float = 0.0
+    # Pipeline-wide default retry policy (RetryPolicy.to_json() form, None
+    # = none); a node's own retry_policy takes precedence.  Operational —
+    # excluded from fingerprint().
+    default_retry_policy: Optional[Dict[str, Any]] = None
+    # Execution-context flag, set by callers that KNOW this IR will run
+    # under the spmd_sync runner (multi-host run_node, `lint --spmd-sync`).
+    # Not compiled from the DSL (distribution degree lives in the runner
+    # config) and excluded from fingerprint(); the TPP108 analyzer rule
+    # reads it to catch in-runner retry policies that the spmd runner
+    # would refuse at runtime.
+    spmd_sync: bool = False
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -129,6 +149,11 @@ class PipelineIR:
             "metadata_path": self.metadata_path,
             "enable_cache": self.enable_cache,
             "default_node_timeout_s": self.default_node_timeout_s,
+            "default_retry_policy": (
+                dict(self.default_retry_policy)
+                if self.default_retry_policy else None
+            ),
+            "spmd_sync": self.spmd_sync,
             "nodes": [n.to_json() for n in self.nodes],
         }
 
@@ -267,6 +292,11 @@ class Compiler:
                     lint_suppress=sorted(
                         getattr(comp, "lint_suppress", ()) or ()
                     ),
+                    retry_policy=(
+                        comp.retry_policy.to_json()
+                        if getattr(comp, "retry_policy", None) is not None
+                        else None
+                    ),
                 )
             )
         return PipelineIR(
@@ -277,6 +307,11 @@ class Compiler:
             nodes=nodes,
             default_node_timeout_s=float(
                 getattr(pipeline, "node_timeout_s", 0.0) or 0.0
+            ),
+            default_retry_policy=(
+                pipeline.retry_policy.to_json()
+                if getattr(pipeline, "retry_policy", None) is not None
+                else None
             ),
         )
 

@@ -112,6 +112,32 @@ class ExecutorContext:
 ExecutorFn = Callable[[ExecutorContext], Optional[Dict[str, Any]]]
 
 
+def _coerce_retry_policy(value, owner: str):
+    """Normalize a RetryPolicy | dict | None into a RetryPolicy (or None).
+
+    Lives here so the DSL accepts the ergonomic forms while the IR always
+    carries one canonical shape; a bad value fails at authoring time, not
+    minutes into a run.
+    """
+    if value is None:
+        return None
+    from tpu_pipelines.robustness import RetryPolicy
+
+    if isinstance(value, RetryPolicy):
+        return value
+    if isinstance(value, dict):
+        try:
+            return RetryPolicy(**value) if value else None
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                f"{owner}: invalid retry_policy {value!r}: {e}"
+            ) from e
+    raise TypeError(
+        f"{owner}: retry_policy must be a RetryPolicy or dict, got "
+        f"{type(value).__name__}"
+    )
+
+
 class Component:
     """Base class for pipeline nodes.
 
@@ -156,6 +182,15 @@ class Component:
     # (per-instance: .with_lint_suppressions("TPP103")).  Compiled into
     # NodeIR.lint_suppress; see docs/ANALYSIS.md.
     LINT_SUPPRESS: tuple = ()
+    # Per-node retry policy (tpu_pipelines.robustness.RetryPolicy or its
+    # dict form; None = fall back to the pipeline default, then env
+    # TPP_RETRY_*).  Covers the node's executor attempts with classified
+    # (transient-only) retries, exponential backoff + full jitter, and an
+    # optional total budget.  Locally the runner's launcher loop enforces
+    # it; on the cluster it maps to Argo retryStrategy / JobSet restarts.
+    # Like deadlines, it is operational metadata: excluded from the DAG
+    # fingerprint, so tuning retries never blocks resume_from.
+    RETRY_POLICY = None
     # Module-file entry points the Layer-2 analyzer walks in addition to
     # EXECUTOR: names loaded from exec_properties["module_file"] at run
     # time (Trainer: run_fn; Transform: preprocessing_fn).
@@ -168,6 +203,7 @@ class Component:
         self.exec_properties: Dict[str, Any] = {}
         self.execution_timeout_s = float(cls.EXECUTION_TIMEOUT_S or 0.0)
         self.lint_suppress: List[str] = [str(r) for r in cls.LINT_SUPPRESS]
+        self.retry_policy = _coerce_retry_policy(cls.RETRY_POLICY, self.id)
 
         for key, value in kwargs.items():
             # A key may name both an input and a parameter (e.g. Trainer's
@@ -252,6 +288,28 @@ class Component:
         self.execution_timeout_s = float(seconds)
         return self
 
+    def with_retry_policy(self, policy=None, **kwargs: Any) -> "Component":
+        """Per-instance retry policy override (chainable, like
+        ``with_execution_timeout``).
+
+        Pass a :class:`~tpu_pipelines.robustness.RetryPolicy`, its dict
+        form, or bare fields::
+
+            trainer.with_retry_policy(max_attempts=3, base_delay_s=1.0)
+
+        ``None`` with no fields clears the override back to the pipeline/
+        env default.
+        """
+        if policy is not None and kwargs:
+            raise ValueError(
+                f"{self.id}: pass a policy object OR field overrides, "
+                "not both"
+            )
+        self.retry_policy = _coerce_retry_policy(
+            kwargs if kwargs else policy, self.id
+        )
+        return self
+
     def with_lint_suppressions(self, *rules: str) -> "Component":
         """Suppress analyzer rules for THIS node (chainable).
 
@@ -286,6 +344,7 @@ def component(
     execution_timeout_s: float = 0.0,
     is_sink: bool = False,
     lint_module_fns: tuple = (),
+    retry_policy=None,
 ) -> Callable[[ExecutorFn], Type[Component]]:
     """Decorator: build a Component subclass from a bare executor function.
 
@@ -322,6 +381,7 @@ def component(
                 "EXECUTION_TIMEOUT_S": float(execution_timeout_s),
                 "IS_SINK": bool(is_sink),
                 "LINT_MODULE_FNS": tuple(lint_module_fns),
+                "RETRY_POLICY": _coerce_retry_policy(retry_policy, cls_name),
             },
         )
 

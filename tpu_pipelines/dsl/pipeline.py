@@ -23,6 +23,7 @@ class Pipeline:
         metadata_path: str = ":memory:",
         enable_cache: bool = True,
         node_timeout_s: float = 0.0,
+        retry_policy=None,
     ):
         self.name = name
         self.pipeline_root = pipeline_root
@@ -36,6 +37,15 @@ class Pipeline:
                 f"Pipeline {name!r}: node_timeout_s must be >= 0"
             )
         self.node_timeout_s = float(node_timeout_s)
+        # Default per-node retry policy (RetryPolicy | dict | None).  A
+        # component's own RETRY_POLICY / with_retry_policy() overrides it;
+        # env TPP_RETRY_* is the outermost fallback — the same precedence
+        # shape as node_timeout_s (docs/RECOVERY.md).
+        from tpu_pipelines.dsl.component import _coerce_retry_policy
+
+        self.retry_policy = _coerce_retry_policy(
+            retry_policy, f"Pipeline {name!r}"
+        )
         self.components = self._closure_in_topo_order(components)
         ids = [c.id for c in self.components]
         dupes = {i for i in ids if ids.count(i) > 1}

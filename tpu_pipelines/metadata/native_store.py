@@ -159,6 +159,10 @@ class NativeMetadataStore(MetadataStore):
         self._handle = self._lib.tpp_meta_open(_b(db_path))
         if not self._handle:
             raise NativeUnavailable(f"tpp_meta_open failed for {db_path!r}")
+        # Second line behind the cross-process flock writer lock (base
+        # class): SQLite's own busy handler waits out a reader holding
+        # the file mid-checkpoint instead of failing the write.
+        self._lib.tpp_meta_exec(self._handle, b"PRAGMA busy_timeout=30000")
 
     # ------------------------------------------------------------ plumbing
 
@@ -180,6 +184,10 @@ class NativeMetadataStore(MetadataStore):
     def _commit(self) -> None:
         pass  # autocommit per statement outside explicit transactions
 
+    # Transaction hooks consumed by the base class's publish_execution —
+    # the retrying multi-writer composite (cross-process flock, transient
+    # SQLITE_BUSY backoff, per-attempt id rollback) is inherited unchanged;
+    # only BEGIN/COMMIT/ROLLBACK route through the C++ engine here.
     def _tx_begin(self) -> None:
         if self._lib.tpp_meta_exec(self._handle, b"BEGIN") != 0:
             self._err("BEGIN")
@@ -191,20 +199,13 @@ class NativeMetadataStore(MetadataStore):
     def _tx_rollback(self) -> None:
         self._lib.tpp_meta_exec(self._handle, b"ROLLBACK")
 
-    def publish_execution(self, execution, input_artifacts, output_artifacts,
-                          contexts=()):
-        # Open an explicit transaction; super() ends it via _tx_commit /
-        # _tx_rollback (the shared composite logic).
-        with self._lock:
-            self._tx_begin()
-            return super().publish_execution(
-                execution, input_artifacts, output_artifacts, contexts
-            )
-
     def close(self) -> None:
         if getattr(self, "_handle", None):
             self._lib.tpp_meta_close(self._handle)
             self._handle = None
+        closer = getattr(self._plock, "close", None)
+        if closer:
+            closer()
 
     # ----------------------------------------------------------- artifacts
 
@@ -218,7 +219,7 @@ class NativeMetadataStore(MetadataStore):
         return art
 
     def put_artifact(self, artifact: Artifact) -> int:
-        with self._lock:
+        with self._lock, self._plock:
             rid = self._lib.tpp_meta_put_artifact(
                 self._handle, artifact.id, _b(artifact.type_name),
                 _b(artifact.uri), _b(artifact.state.value),
@@ -265,7 +266,7 @@ class NativeMetadataStore(MetadataStore):
         import time
 
         execution.update_time = time.time()
-        with self._lock:
+        with self._lock, self._plock:
             rid = self._lib.tpp_meta_put_execution(
                 self._handle, execution.id, _b(execution.type_name),
                 _b(execution.node_id), _b(execution.state.value),
@@ -292,7 +293,7 @@ class NativeMetadataStore(MetadataStore):
     # -------------------------------------------------------------- events
 
     def put_events(self, events: Iterable[Event]) -> None:
-        with self._lock:
+        with self._lock, self._plock:
             for e in events:
                 if self._lib.tpp_meta_put_event(
                     self._handle, e.artifact_id, e.execution_id,
@@ -318,7 +319,7 @@ class NativeMetadataStore(MetadataStore):
     # ------------------------------------------------------------ contexts
 
     def put_context(self, context: Context) -> int:
-        with self._lock:
+        with self._lock, self._plock:
             rid = self._lib.tpp_meta_put_context(
                 self._handle, _b(context.type_name), _b(context.name),
                 _b(json.dumps(context.properties, sort_keys=True, default=str)),
@@ -341,14 +342,14 @@ class NativeMetadataStore(MetadataStore):
         return ctx
 
     def associate(self, context_id: int, execution_id: int) -> None:
-        with self._lock:
+        with self._lock, self._plock:
             if self._lib.tpp_meta_link(
                 self._handle, b"associations", context_id, execution_id
             ) != 0:
                 self._err("associate")
 
     def attribute(self, context_id: int, artifact_id: int) -> None:
-        with self._lock:
+        with self._lock, self._plock:
             if self._lib.tpp_meta_link(
                 self._handle, b"attributions", context_id, artifact_id
             ) != 0:

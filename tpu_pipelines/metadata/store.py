@@ -2,17 +2,39 @@
 
 TPU-native equivalent of ml-metadata's ``MetadataStore`` (SURVEY.md §2b): same
 data model (artifacts, executions, contexts, events), embedded SQLite instead
-of a C++ gRPC service.  The store is the single writer for pipeline state; the
-orchestrator serializes access per run, so no cross-process locking beyond
-SQLite's own is needed.
+of a C++ gRPC service.
 
-Concurrency discipline: one connection per store instance; WAL mode for
-file-backed stores so concurrent reader processes (lineage CLI, UI) never
-block the writer.
+Multi-writer discipline (ISSUE 7, docs/RECOVERY.md): the store is
+crash-consistent and multi-process-safe, so concurrent runners and shard
+children can publish into one store root without corruption:
+
+  * **Crash atomicity** — WAL journaling + one transaction per composite
+    publish: a crash at any instant leaves committed rows only, never a
+    COMPLETE execution missing its output events.
+  * **Cross-process writer lock** — every write (and the whole publish
+    transaction) holds an ``fcntl.flock`` on the database file itself
+    (``robustness.FileLock``; no sidecar file, so the disabled-mode
+    zero-footprint contract holds), serializing N process-level writers
+    instead of letting them race into ``SQLITE_BUSY`` storms.  The lock
+    rides the kernel, so a dead writer releases it instantly.
+  * **Contention retry** — the publish transaction retries
+    transient failures (SQLITE_BUSY/locked, injected store-contention
+    faults) under a jittered backoff policy, counted in
+    ``retry_attempts_total{site="metadata.publish"}``; per-attempt id
+    rollback keeps the retry idempotent.
+  * **Torn-write detection on load** — opening a file-backed store runs
+    ``PRAGMA quick_check`` (disable with ``TPP_STORE_VERIFY=0``) and
+    surfaces corruption as a structured ``StoreUnavailableError`` instead
+    of a downstream lineage walk reading garbage — the store-level mirror
+    of the RunTrace torn-tail repair.
+
+Readers never block writers: WAL snapshots serve the lineage CLI/UI while
+a publish is in flight.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import sqlite3
@@ -114,17 +136,75 @@ class MetadataStore:
         if db_path != ":memory:":
             parent = os.path.dirname(os.path.abspath(db_path))
             os.makedirs(parent, exist_ok=True)
+        # Cross-process writer lock ON the database file (no sidecar —
+        # the disabled-mode contract is "exactly md.sqlite + payloads").
+        # :memory: stores are process-private, so a null context suffices.
+        if db_path != ":memory:":
+            from tpu_pipelines.robustness import FileLock
+
+            self._plock = FileLock(db_path)
+        else:
+            self._plock = contextlib.nullcontext()
         self._open_backend(db_path)
+        self._verify_on_load(db_path)
 
     def _open_backend(self, db_path: str) -> None:
-        """Open the storage engine; the native backend overrides only this."""
-        self._conn = sqlite3.connect(db_path, check_same_thread=False)
-        with self._lock:
-            if db_path != ":memory:":
-                self._conn.execute("PRAGMA journal_mode=WAL")
-            self._conn.execute("PRAGMA foreign_keys=ON")
-            self._conn.executescript(_SCHEMA)
-            self._conn.commit()
+        """Open the storage engine; the native backend overrides only this.
+
+        ``timeout=30`` arms SQLite's own busy handler as the second line
+        behind the flock writer lock (a reader mid-checkpoint can still
+        hold the file briefly).
+        """
+        try:
+            self._conn = sqlite3.connect(
+                db_path, check_same_thread=False, timeout=30.0
+            )
+            with self._lock, self._plock:
+                if db_path != ":memory:":
+                    self._conn.execute("PRAGMA journal_mode=WAL")
+                self._conn.execute("PRAGMA foreign_keys=ON")
+                self._conn.executescript(_SCHEMA)
+                self._conn.commit()
+        except sqlite3.DatabaseError as e:
+            # "file is not a database" and friends: a torn/garbage file is
+            # a structured store failure, not a bare sqlite3 crash.
+            raise StoreUnavailableError(
+                f"metadata store at {db_path!r} is unreadable: {e}"
+            ) from e
+
+    def _verify_on_load(self, db_path: str) -> None:
+        """Torn-write detection on open (``TPP_STORE_VERIFY=0`` skips):
+        a file-backed store that fails ``PRAGMA quick_check`` surfaces as
+        StoreUnavailableError NOW, instead of as garbage lineage later —
+        mirroring the trace log's torn-tail repair at the store layer."""
+        if db_path == ":memory:":
+            return
+        if os.environ.get("TPP_STORE_VERIFY", "1").strip() == "0":
+            return
+        try:
+            rows = self._quick_check()
+        except sqlite3.DatabaseError as e:
+            raise StoreUnavailableError(
+                f"metadata store at {db_path!r} failed integrity "
+                f"verification: {e}"
+            ) from e
+        if rows and rows != ["ok"]:
+            raise StoreUnavailableError(
+                f"metadata store at {db_path!r} is corrupt (torn write?): "
+                + "; ".join(rows[:5])
+            )
+
+    def _quick_check(self) -> List[str]:
+        # A throwaway stdlib connection, NOT the backend handle: both
+        # backends share the on-disk format, so this one check covers the
+        # native (C++) engine too.
+        conn = sqlite3.connect(self.db_path)
+        try:
+            return [
+                str(r[0]) for r in conn.execute("PRAGMA quick_check")
+            ]
+        finally:
+            conn.close()
 
     def _commit(self) -> None:
         """Commit unless inside an explicit multi-write transaction."""
@@ -133,6 +213,10 @@ class MetadataStore:
 
     # Transaction hooks — overridden by alternative backends
     # (metadata/native_store.py) so publish_execution stays shared.
+    def _tx_begin(self) -> None:
+        """Open the publish transaction (python sqlite: implicit — the
+        first write BEGINs; the native engine needs an explicit BEGIN)."""
+
     def _tx_commit(self) -> None:
         self._conn.commit()
 
@@ -141,11 +225,14 @@ class MetadataStore:
 
     def close(self) -> None:
         self._conn.close()
+        closer = getattr(self._plock, "close", None)
+        if closer:
+            closer()
 
     # ------------------------------------------------------------- artifacts
 
     def put_artifact(self, artifact: Artifact) -> int:
-        with self._lock:
+        with self._lock, self._plock:
             if artifact.id:
                 self._conn.execute(
                     "UPDATE artifacts SET type_name=?, uri=?, state=?, "
@@ -194,7 +281,7 @@ class MetadataStore:
         execution.update_time = time.time()
         with _obs.span(
             "put_execution", cat="metadata", node=execution.node_id
-        ), self._lock:
+        ), self._lock, self._plock:
             if execution.id:
                 self._conn.execute(
                     "UPDATE executions SET type_name=?, node_id=?, state=?, "
@@ -240,7 +327,7 @@ class MetadataStore:
     # ---------------------------------------------------------------- events
 
     def put_events(self, events: Iterable[Event]) -> None:
-        with self._lock:
+        with self._lock, self._plock:
             self._conn.executemany(
                 "INSERT INTO events (artifact_id, execution_id, type, path, idx, ts) "
                 "VALUES (?,?,?,?,?,?)",
@@ -273,7 +360,7 @@ class MetadataStore:
 
     def put_context(self, context: Context) -> int:
         """Insert or fetch-by-unique-name; returns the context id."""
-        with self._lock:
+        with self._lock, self._plock:
             row = self._conn.execute(
                 "SELECT id FROM contexts WHERE type_name=? AND name=?",
                 (context.type_name, context.name),
@@ -331,7 +418,7 @@ class MetadataStore:
         return ctx
 
     def associate(self, context_id: int, execution_id: int) -> None:
-        with self._lock:
+        with self._lock, self._plock:
             self._conn.execute(
                 "INSERT OR IGNORE INTO associations (context_id, execution_id) "
                 "VALUES (?,?)",
@@ -340,7 +427,7 @@ class MetadataStore:
             self._commit()
 
     def attribute(self, context_id: int, artifact_id: int) -> None:
-        with self._lock:
+        with self._lock, self._plock:
             self._conn.execute(
                 "INSERT OR IGNORE INTO attributions (context_id, artifact_id) "
                 "VALUES (?,?)",
@@ -368,6 +455,22 @@ class MetadataStore:
 
     # ---------------------------------------------------- composite publish
 
+    # Contention policy for the composite publish: SQLITE_BUSY under N
+    # concurrent process writers clears in milliseconds once the holder
+    # commits, so short jittered waits; ~6s worst-case total budget.
+    PUBLISH_RETRY_ATTEMPTS = 5
+    PUBLISH_RETRY_BASE_S = 0.05
+    PUBLISH_RETRY_MAX_S = 2.0
+
+    @staticmethod
+    def _is_transient_store_error(exc: BaseException) -> bool:
+        if isinstance(exc, sqlite3.OperationalError):
+            msg = str(exc).lower()
+            return "locked" in msg or "busy" in msg
+        from tpu_pipelines.robustness import is_transient
+
+        return is_transient(exc)
+
     def publish_execution(
         self,
         execution: Execution,
@@ -379,25 +482,71 @@ class MetadataStore:
 
         Output artifacts are persisted (assigned ids) and marked LIVE when the
         execution completed, ABANDONED when it failed.  The whole publish is a
-        single SQLite transaction: a crash mid-publish leaves no COMPLETE
-        execution without its output events (which would poison the cache).
+        single SQLite transaction under the cross-process writer lock: a
+        crash mid-publish leaves no COMPLETE execution without its output
+        events (which would poison the cache), and concurrent process
+        writers serialize instead of corrupting each other.  Transient
+        failures (SQLITE_BUSY past the flock, injected store-contention
+        faults) retry with jittered backoff; ids assigned by a rolled-back
+        attempt are reset first so the retry re-inserts instead of
+        UPDATE-ing rows the rollback erased.
         """
+        from tpu_pipelines.robustness import RetryPolicy, record_retry
+        from tpu_pipelines.testing import faults as _faults
+
+        policy = RetryPolicy(
+            max_attempts=self.PUBLISH_RETRY_ATTEMPTS,
+            base_delay_s=self.PUBLISH_RETRY_BASE_S,
+            max_delay_s=self.PUBLISH_RETRY_MAX_S,
+        )
         with _obs.span(
             "publish_execution", cat="metadata", node=execution.node_id,
             args={"state": execution.state.value},
         ), self._lock:
-            self._in_tx = True
-            try:
-                self._publish_locked(
-                    execution, input_artifacts, output_artifacts, contexts
-                )
-                self._tx_commit()
-            except BaseException:
-                self._tx_rollback()
-                raise
-            finally:
-                self._in_tx = False
-            return execution
+            saved_ex_id = execution.id
+            saved_art_ids = [
+                (a, a.id)
+                for arts in output_artifacts.values()
+                for a in arts
+            ]
+            saved_ctx_ids = [(c, c.id) for c in contexts]
+            failures = 0
+            while True:
+                try:
+                    with self._plock:
+                        # Fault hook: STORE_CONTENTION (testing/faults.py)
+                        # — transient unavailability, N times.
+                        _faults.store_op("publish_execution")
+                        self._in_tx = True
+                        try:
+                            self._tx_begin()
+                            self._publish_locked(
+                                execution, input_artifacts,
+                                output_artifacts, contexts,
+                            )
+                            self._tx_commit()
+                        except BaseException:
+                            self._tx_rollback()
+                            raise
+                        finally:
+                            self._in_tx = False
+                    return execution
+                except Exception as exc:
+                    failures += 1
+                    if (
+                        failures >= policy.max_attempts
+                        or not self._is_transient_store_error(exc)
+                    ):
+                        raise
+                    # The rolled-back attempt may have assigned row ids;
+                    # reset them so the retry inserts fresh rows.
+                    execution.id = saved_ex_id
+                    for art, aid in saved_art_ids:
+                        art.id = aid
+                    for ctx, cid in saved_ctx_ids:
+                        ctx.id = cid
+                    record_retry("metadata.publish")
+                    time.sleep(policy.backoff_s(failures))
 
     def _publish_locked(
         self,
@@ -450,7 +599,8 @@ class MetadataStore:
         accessors, so the native backend inherits it unchanged.
         """
         fenced: List[Execution] = []
-        with _obs.span("sweep_stale_executions", cat="metadata"), self._lock:
+        with _obs.span("sweep_stale_executions", cat="metadata"), \
+                self._lock, self._plock:
             for ex in self.get_executions_by_context(run_context_id):
                 if ex.state != ExecutionState.RUNNING:
                     continue

@@ -183,6 +183,37 @@ class TPUJobRunner:
         )
 
     @staticmethod
+    def _node_retry_strategy(ir: PipelineIR, node) -> Dict[str, Any]:
+        """Argo ``retryStrategy`` for a node template — the cluster mirror
+        of the local runner's classified retry loop (docs/RECOVERY.md).
+
+        Precedence matches the deadline mapping: component retry policy >
+        pipeline default; the env fallback (``TPP_RETRY_*``) is
+        deliberately NOT read at compile time (the operator laptop's
+        environment is not the cluster's).  With no policy anywhere the
+        historical default stays: ``limit: 2`` immediate retries.  With a
+        policy, ``limit``/``backoff`` carry its attempts and exponential
+        schedule (Argo adds its own jitter server-side).
+        """
+        from tpu_pipelines.robustness import RetryPolicy
+
+        policy = RetryPolicy.from_json(
+            getattr(node, "retry_policy", None)
+        ) or RetryPolicy.from_json(
+            getattr(ir, "default_retry_policy", None)
+        )
+        if policy is None:
+            return {"limit": 2}
+        strategy: Dict[str, Any] = {"limit": policy.retries}
+        if policy.base_delay_s > 0:
+            strategy["backoff"] = {
+                "duration": f"{policy.base_delay_s:g}s",
+                "factor": 2,
+                "maxDuration": f"{policy.max_delay_s:g}s",
+            }
+        return strategy
+
+    @staticmethod
     def _node_deadline_s(ir: PipelineIR, node) -> int:
         """Effective execution deadline (whole seconds; 0 = none) — the
         cluster mirror of the local watchdog's precedence: component
@@ -336,10 +367,11 @@ class TPUJobRunner:
             # "timeouts consume the retry budget" semantics as the local
             # runner (docs/RECOVERY.md precedence table).
             deadline_s = self._node_deadline_s(ir, node)
+            retry_strategy = self._node_retry_strategy(ir, node)
             for i in range(shards):
                 trial_tpl: Dict[str, Any] = {
                     "name": k8s_name(f"{node.id}-trial-{i}"),
-                    "retryStrategy": {"limit": 2},
+                    "retryStrategy": dict(retry_strategy),
                     "container": {
                         "image": cfg.image,
                         "command": self._tuner_trial_command(
@@ -358,7 +390,7 @@ class TPUJobRunner:
                 templates.append(trial_tpl)
             tpl: Dict[str, Any] = {
                 "name": k8s_name(node.id),
-                "retryStrategy": {"limit": 2},
+                "retryStrategy": dict(retry_strategy),
             }
             if deadline_s:
                 tpl["activeDeadlineSeconds"] = deadline_s
@@ -524,6 +556,27 @@ class TPUJobRunner:
             # step dies even when submitted standalone (outside the Argo
             # template whose activeDeadlineSeconds mirrors it).
             job_spec["activeDeadlineSeconds"] = deadline_s
+        spec: Dict[str, Any] = {
+            "replicatedJobs": [{
+                "name": "workers",
+                "replicas": 1,
+                "template": {"spec": job_spec},
+            }],
+        }
+        from tpu_pipelines.robustness import RetryPolicy
+
+        policy = RetryPolicy.from_json(
+            getattr(ir.node(node_id), "retry_policy", None)
+        ) or RetryPolicy.from_json(getattr(ir, "default_retry_policy", None))
+        if policy is not None and policy.retries > 0:
+            # The JobSet-level restart (every worker together) is the only
+            # correct retry unit for a collective step: per-pod backoff
+            # (Job backoffLimit, pinned 0 above) would restart one worker
+            # into its peers' half-dead collectives.  This is why the
+            # local runner refuses in-runner retries under spmd_sync
+            # (and lint rule TPP108 flags them at compile time): the
+            # substrate, not the runner, owns multi-host retry.
+            spec["failurePolicy"] = {"maxRestarts": policy.retries}
         return {
             "apiVersion": "jobset.x-k8s.io/v1alpha2",
             "kind": "JobSet",
@@ -535,13 +588,7 @@ class TPUJobRunner:
                     "tpu-pipelines/node": k8s_name(node_id),
                 },
             },
-            "spec": {
-                "replicatedJobs": [{
-                    "name": "workers",
-                    "replicas": 1,
-                    "template": {"spec": job_spec},
-                }],
-            },
+            "spec": spec,
         }
 
     # -------------------------------------------------------- serving

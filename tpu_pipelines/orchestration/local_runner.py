@@ -84,6 +84,12 @@ from tpu_pipelines.metadata.types import (
     ExecutionState,
 )
 from tpu_pipelines.observability import trace as _trace
+from tpu_pipelines.robustness import (
+    TRANSIENT,
+    RetryPolicy,
+    classify_error,
+    record_retry,
+)
 from tpu_pipelines.testing import faults as _faults
 from tpu_pipelines.utils.fingerprint import execution_cache_key, fingerprint_dir
 from tpu_pipelines.utils.span import has_span_pattern, resolve_span_pattern
@@ -324,6 +330,10 @@ class _LaunchPlan:
     # worker under the publish lock (the scheduler must not fence
     # afterwards) — together they make exactly one publish win.
     deadline_s: float = 0.0
+    # Effective executor retry policy (node > pipeline > env > legacy
+    # max_retries), resolved in the driver phase so the worker-thread
+    # launcher loop never reads config.
+    retry_policy: Optional[RetryPolicy] = None
     cancel: threading.Event = dataclasses.field(
         default_factory=threading.Event
     )
@@ -338,10 +348,15 @@ class _LaunchPlan:
 class LocalDagRunner:
     """In-process topological pipeline runner.
 
-    ``max_retries`` applies per node (transient-failure tolerance — the
-    substrate-level retry the reference delegates to Argo/TFJob, SURVEY.md §5
-    failure detection).  Idempotence contract: executors write only under
-    their output artifact uris and tmp dir, so a retry starts clean.
+    Per-node retries follow the shared :class:`RetryPolicy` precedence
+    (docs/RECOVERY.md): ``@component(retry_policy=...)`` >
+    ``Pipeline(retry_policy=...)`` > env ``TPP_RETRY_*`` > the legacy
+    ``max_retries`` constructor knob (mapped to ``RetryPolicy(
+    max_attempts=max_retries+1, base_delay_s=0)`` — immediate retries, as
+    before).  Only failures the shared taxonomy classifies TRANSIENT are
+    retried; permanent failures (bad config, poisoned input) fail the node
+    immediately.  Idempotence contract: executors write only under their
+    output artifact uris and tmp dir, so a retry starts clean.
 
     ``max_parallel_nodes`` bounds the concurrent scheduler's worker pool:
     None = env ``TPP_MAX_PARALLEL_NODES`` if set, else the DAG's root count.
@@ -415,6 +430,33 @@ class LocalDagRunner:
         longer matches the one recorded for that run.
         """
         ir = Compiler().compile(pipeline)
+        if self.spmd_sync:
+            # Same hazard the constructor's max_retries check guards (and
+            # the TPP108 lint rule catches at compile time): an in-runner
+            # retry would wipe the shared output dirs while peer processes
+            # are still inside the previous attempt's collectives.  Only
+            # IR-carried policies are checked — the env rung is the
+            # operator's fleet default for LOCAL runs, and cluster pods
+            # (which strip IR policies in run_node) must not refuse over
+            # an inherited environment.
+            ir.spmd_sync = True
+            retrying = sorted(
+                n.id for n in ir.nodes
+                if (
+                    p := RetryPolicy.from_json(
+                        getattr(n, "retry_policy", None)
+                    ) or RetryPolicy.from_json(
+                        getattr(ir, "default_retry_policy", None)
+                    )
+                ) is not None and p.max_attempts > 1 and not n.is_resolver
+            )
+            if retrying:
+                raise ValueError(
+                    f"spmd_sync is incompatible with in-runner retry "
+                    f"policies (configured on {retrying}); use "
+                    "substrate-level retries (Argo retryStrategy / JobSet "
+                    "restarts) instead"
+                )
         lint_level = None
         if not self.spmd_sync:
             # Under spmd_sync every process would lint (and potentially
@@ -639,6 +681,44 @@ class LocalDagRunner:
             except ValueError:
                 log.warning("ignoring non-numeric TPP_NODE_TIMEOUT_S=%r", env)
         return 0.0
+
+    def _node_retry_policy(
+        self, node: NodeIR, ir: PipelineIR
+    ) -> Optional[RetryPolicy]:
+        """Effective executor retry policy for a node (None = single
+        attempt).
+
+        Precedence (docs/RECOVERY.md "Retry policies & error taxonomy"):
+        component override (NodeIR.retry_policy) > pipeline default
+        (Pipeline(retry_policy=...)) > env ``TPP_RETRY_*`` > the legacy
+        ``LocalDagRunner(max_retries=N)`` constructor knob, which maps to
+        ``RetryPolicy(max_attempts=N+1, base_delay_s=0)`` — its historical
+        retry-immediately semantics, now with classification (a
+        PermanentError never burns the budget).  Resolver nodes never
+        retry: they answer from the store, and their failures are store
+        failures the scheduler already contains.
+        """
+        if node.is_resolver:
+            return None
+        if self.spmd_sync:
+            # run() refused IR-carried policies already; the env rung is
+            # also ignored here so a fleet-wide TPP_RETRY_* default can
+            # never arm an in-runner retry across SPMD processes.
+            return None
+        policy = RetryPolicy.from_json(getattr(node, "retry_policy", None))
+        if policy is None:
+            policy = RetryPolicy.from_json(
+                getattr(ir, "default_retry_policy", None)
+            )
+        if policy is None:
+            policy = RetryPolicy.from_env()
+        if policy is None and self.max_retries:
+            policy = RetryPolicy(
+                max_attempts=self.max_retries + 1,
+                base_delay_s=0.0,
+                jitter=False,
+            )
+        return policy
 
     # -------------------------------------------------------------- resume
 
@@ -1608,6 +1688,7 @@ class LocalDagRunner:
             external_fps=external_fps, execution=ex, outputs=outputs,
             all_ctx=all_ctx, t0=t0,
             deadline_s=self._node_timeout_s(node, ir),
+            retry_policy=self._node_retry_policy(node, ir),
         )
 
     def _execute_and_publish(
@@ -1641,11 +1722,19 @@ class LocalDagRunner:
         allocated_uris = {
             id(a): a.uri for arts in outputs.values() for a in arts
         }
+        # Classified retry loop (docs/RECOVERY.md): only transient
+        # failures consume the policy's backoff budget; a permanent
+        # verdict (bad config, poisoned input) fails the node on the
+        # spot.  The node deadline (plan.deadline_s, enforced by the
+        # scheduler watchdog) still covers ALL attempts and sleeps.
+        policy = plan.retry_policy or RetryPolicy(
+            max_attempts=1, base_delay_s=0.0, jitter=False
+        )
+        retry_t0 = time.monotonic()
         if executor is None:
             error = f"component {node.id} has no executor"
         else:
-            for attempt in range(self.max_retries + 1):
-                attempts = attempt + 1
+            while True:
                 tmp = tempfile.mkdtemp(prefix=f"tpp-{node.id}-")
                 try:
                     for arts in outputs.values():
@@ -1675,12 +1764,53 @@ class LocalDagRunner:
                     extra_props = dict(ret or {})
                     error = ""
                     break
-                except Exception:
+                except Exception as exc:
                     error = traceback.format_exc()
+                    verdict = classify_error(exc)
                     log.warning(
-                        "node %s attempt %d/%d failed:\n%s",
-                        node.id, attempts, self.max_retries + 1, error,
+                        "node %s attempt %d/%d failed (%s):\n%s",
+                        node.id, attempts, policy.max_attempts, verdict,
+                        error,
                     )
+                    if attempts >= policy.max_attempts:
+                        break
+                    if verdict != TRANSIENT:
+                        log.info(
+                            "node %s: %s failure is permanent; not "
+                            "retrying (%d attempt(s) left unspent)",
+                            node.id, type(exc).__name__,
+                            policy.max_attempts - attempts,
+                        )
+                        break
+                    delay = policy.backoff_s(attempts)
+                    if policy.deadline_s > 0:
+                        remaining = policy.deadline_s - (
+                            time.monotonic() - retry_t0
+                        )
+                        if remaining <= 0:
+                            log.warning(
+                                "node %s: retry budget (%gs) spent after "
+                                "%d attempt(s)", node.id,
+                                policy.deadline_s, attempts,
+                            )
+                            break
+                        delay = min(delay, remaining)
+                    if plan.cancel.is_set():
+                        break  # watchdog expiry / drain: stop retrying
+                    record_retry(f"node:{node.id}")
+                    _trace.instant(
+                        "retry", cat="executor", node=node.id,
+                        args={
+                            "attempt": attempts,
+                            "backoff_s": round(delay, 4),
+                            "error_kind": type(exc).__name__,
+                        },
+                    )
+                    # Backoff waits on the cancel event so a draining run
+                    # (or the deadline watchdog) wakes it immediately.
+                    if delay > 0 and plan.cancel.wait(delay):
+                        break
+                    attempts += 1
                 finally:
                     shutil.rmtree(tmp, ignore_errors=True)
 

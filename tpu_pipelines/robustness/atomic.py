@@ -1,0 +1,184 @@
+"""Crash-consistent file primitives: atomic writes and a cross-process lock.
+
+Two small tools the multi-writer story is built on:
+
+  * :func:`atomic_write_bytes` / :func:`atomic_write_json` — write to a
+    same-directory temp file, ``fsync`` it, then ``os.replace`` over the
+    destination (and fsync the directory).  A reader can never observe a
+    torn payload: it sees the old file or the new one, nothing between.
+    Used for JSON control files that concurrent processes read while a
+    writer updates them (quarantine ledgers, serving version markers).
+  * :class:`FileLock` — an ``fcntl.flock``-based inter-process mutex on an
+    EXISTING path (the metadata SQLite file itself), so it adds **zero
+    file footprint**: no sidecar ``.lock`` appears next to the store,
+    preserving the disabled-mode "exactly md.sqlite + payloads" contract.
+    flock locks attach to the open-file-description, not the process, so
+    a fork child re-acquiring through its inherited object still
+    serializes correctly against the parent once it reopens (the lock is
+    reopened lazily per pid).  Reentrant within a process.
+
+SQLite's WAL already makes each committed transaction crash-atomic; what
+the lock adds is *writer coordination across processes* — N runners or
+shard children publishing into one store serialize their transactions
+instead of racing into ``SQLITE_BUSY`` storms.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from typing import Any, Optional
+
+
+def fsync_dir(path: str) -> None:
+    """Best-effort fsync of a directory (required for rename durability on
+    POSIX; some filesystems refuse O_RDONLY dir fsync — ignore)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes, do_fsync: bool = True) -> None:
+    """Write ``data`` to ``path`` atomically: temp file in the same
+    directory + fsync + rename.  A crash at any instant leaves either the
+    complete old file or the complete new one."""
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(
+        prefix=f".{os.path.basename(path)}.", suffix=".tmp", dir=parent
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            if do_fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+        if do_fsync:
+            fsync_dir(parent)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_json(path: str, obj: Any, do_fsync: bool = True) -> None:
+    atomic_write_bytes(
+        path,
+        (json.dumps(obj, sort_keys=True) + "\n").encode("utf-8"),
+        do_fsync=do_fsync,
+    )
+
+
+def load_json_tolerant(path: str) -> Optional[Any]:
+    """Parse a JSON control file, returning None for missing OR torn
+    content (half-written by a non-atomic legacy writer, or zero-length
+    after a crash) instead of raising — the torn-write-detection read
+    side of :func:`atomic_write_json`."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            raw = f.read()
+    except OSError:
+        return None
+    if not raw.strip():
+        return None
+    try:
+        return json.loads(raw)
+    except ValueError:
+        return None
+
+
+class FileLock:
+    """Cross-process exclusive lock via ``flock`` on an existing file.
+
+    Reentrant per process (an internal RLock + depth counter), safe across
+    ``fork`` (the fd is reopened lazily in the child — flock state rides
+    the open-file-description, so an inherited fd would alias the
+    parent's lock).  On platforms without ``fcntl`` (or when the target
+    cannot be opened) it degrades to the in-process RLock only, which
+    preserves the previous single-process behavior.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._tlock = threading.RLock()
+        self._depth = 0
+        self._fd: Optional[int] = None
+        self._fd_pid: Optional[int] = None
+
+    def _ensure_fd(self) -> Optional[int]:
+        pid = os.getpid()
+        if self._fd is not None and self._fd_pid == pid:
+            return self._fd
+        if self._fd is not None:
+            # Forked child: the inherited fd shares the parent's lock
+            # state; drop it (close in the child does not release the
+            # parent's flock — flock follows the open-file-description,
+            # and the parent still holds its own reference).
+            try:
+                os.close(self._fd)
+            except OSError:
+                pass
+            self._fd = None
+        try:
+            self._fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o644)
+            self._fd_pid = pid
+        except OSError:
+            self._fd = None
+            self._fd_pid = None
+        return self._fd
+
+    def acquire(self) -> None:
+        self._tlock.acquire()
+        self._depth += 1
+        if self._depth > 1:
+            return
+        fd = self._ensure_fd()
+        if fd is None:
+            return  # in-process lock only (unopenable path)
+        try:
+            import fcntl
+
+            fcntl.flock(fd, fcntl.LOCK_EX)
+        except (ImportError, OSError):
+            pass  # platform without flock: in-process lock only
+
+    def release(self) -> None:
+        try:
+            if self._depth == 1 and self._fd is not None:
+                try:
+                    import fcntl
+
+                    fcntl.flock(self._fd, fcntl.LOCK_UN)
+                except (ImportError, OSError):
+                    pass
+        finally:
+            self._depth -= 1
+            self._tlock.release()
+
+    def close(self) -> None:
+        with self._tlock:
+            if self._fd is not None and self._fd_pid == os.getpid():
+                try:
+                    os.close(self._fd)
+                except OSError:
+                    pass
+            self._fd = None
+            self._fd_pid = None
+
+    def __enter__(self) -> "FileLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()

@@ -118,6 +118,25 @@ def main(argv=None) -> int:
         logging.getLogger(__name__).warning(
             "ignoring --max-retries=%d in multi-host mode", args.max_retries
         )
+    if dist is not None:
+        # Same hazard for IR-carried retry policies: the cluster runner
+        # already compiled them into the SUBSTRATE retry (Argo
+        # retryStrategy / JobSet failurePolicy), so the in-runner copy is
+        # stripped here — otherwise the spmd runner would refuse the node
+        # outright (the TPP108 contract).
+        stripped = [
+            c.id for c in pipeline.components
+            if getattr(c, "retry_policy", None) is not None
+        ]
+        if stripped or getattr(pipeline, "retry_policy", None) is not None:
+            logging.getLogger(__name__).warning(
+                "multi-host mode: in-runner retry policies ignored "
+                "(substrate owns retries); stripped from %s",
+                stripped or "pipeline default",
+            )
+            pipeline.retry_policy = None
+            for c in pipeline.components:
+                c.retry_policy = None
     runner = LocalDagRunner(
         max_retries=0 if dist is not None else args.max_retries,
         spmd_sync=dist is not None,

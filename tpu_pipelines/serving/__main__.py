@@ -44,6 +44,11 @@ def main(argv=None) -> int:
     parser.add_argument("--batch-timeout-ms", type=float, default=5.0)
     parser.add_argument("--poll-seconds", type=float, default=30.0,
                         help="version-watch interval; 0 disables hot reload")
+    parser.add_argument("--max-queue-depth", type=int, default=0,
+                        help="admission-control bound: refuse (429 + "
+                             "Retry-After) predict/generate requests once "
+                             "in-flight + queued work reaches this; 0 = "
+                             "env TPP_SERVING_MAX_QUEUE, else unbounded")
     parser.add_argument("--grpc-port", type=int, default=-1,
                         help="also serve gRPC predict on this port "
                              "(0 = ephemeral; -1 = REST only)")
@@ -63,6 +68,7 @@ def main(argv=None) -> int:
                 batching=args.batching,
                 max_batch_size=args.max_batch_size,
                 batch_timeout_s=args.batch_timeout_ms / 1000.0,
+                max_queue_depth=args.max_queue_depth,
             )
             break
         except FileNotFoundError:

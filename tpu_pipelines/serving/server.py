@@ -36,14 +36,29 @@ from tpu_pipelines.observability.metrics import (
     CONTENT_TYPE_LATEST,
     MetricsRegistry,
 )
+from tpu_pipelines.testing import faults as _faults
 from tpu_pipelines.trainer.export import LoadedModel, load_exported_model
 
 log = logging.getLogger("tpu_pipelines.serving")
+
+# Admission-control bound fallback when the constructor leaves it 0
+# (deployment knob for `python -m tpu_pipelines.serving`).
+ENV_MAX_QUEUE = "TPP_SERVING_MAX_QUEUE"
 
 
 class GenerateUnsupported(ValueError):
     """This server/payload cannot serve generate requests (no
     make_generate_step hook, or raw=False with an embedded transform)."""
+
+
+class ServerOverloaded(RuntimeError):
+    """Admission control refused the request: queue depth + in-flight work
+    already exceed the configured bound.  Maps to HTTP 429 + Retry-After
+    (gRPC: RESOURCE_EXHAUSTED) — load is SHED at the door, so every
+    admitted request still meets its latency budget and none is dropped
+    mid-flight (the zero-drop half of the contract)."""
+
+    retry_after_s = 1
 
 
 def latest_version_dir(base_dir: str) -> Optional[str]:
@@ -77,16 +92,36 @@ class ModelServer:
         max_batch_size: int = 64,
         batch_timeout_s: float = 0.005,
         metrics_registry: Optional[MetricsRegistry] = None,
+        max_queue_depth: int = 0,
     ):
         self.model_name = model_name
         self.base_dir = base_dir
         self.raw = raw
         self._lock = threading.Lock()
+        # Serializes reload(): concurrent version swaps would race the
+        # load-outside-lock / swap-under-lock dance.  Never held while
+        # answering requests — predict always reads whichever reference
+        # is current, so a reload drains naturally with zero 5xx.
+        self._reload_lock = threading.Lock()
         self._loaded: Optional[LoadedModel] = None
         self._loaded_version: Optional[str] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._stopped = False
+        # Admission control (load shedding): when > 0, a predict/generate
+        # arriving while (in-flight + batcher queue) >= bound is refused
+        # with 429 + Retry-After instead of queuing into a latency cliff.
+        # 0 falls back to env TPP_SERVING_MAX_QUEUE, else unbounded.
+        if max_queue_depth <= 0:
+            try:
+                max_queue_depth = int(
+                    os.environ.get(ENV_MAX_QUEUE, "0").strip() or "0"
+                )
+            except ValueError:
+                max_queue_depth = 0
+        self.max_queue_depth = max(0, max_queue_depth)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
         # Live telemetry (observability/metrics.py): per-server registry by
         # default so two servers in one process never mix series; callers
         # may inject a shared registry.  In-memory only — the sole exposure
@@ -112,6 +147,16 @@ class ModelServer:
             "serving_model_reloads_total",
             "Successful model version loads (including the initial one).",
         )
+        self._m_shed = self.metrics.counter(
+            "serving_load_shed_total",
+            "Requests refused (429) by admission control, by endpoint.",
+            labels=("endpoint",),
+        )
+        self._m_inflight = self.metrics.gauge(
+            "serving_inflight_requests",
+            "Predict/generate requests currently being served.",
+        )
+        self._m_inflight.set_function(lambda: self._inflight)
         # Micro-batching (serving/batching.py): coalesce concurrent requests
         # into padded fixed-bucket device calls.  The batcher resolves the
         # current model at call time, so hot-swaps apply to queued requests.
@@ -132,32 +177,69 @@ class ModelServer:
     def reload(self) -> str:
         """(Re)load the newest version; returns the version string.
 
-        The (slow) load happens outside the predict lock; in-flight requests
-        keep answering on the old version until the reference swap.
+        Reload-under-load guarantee (docs/RECOVERY.md): the (slow) load
+        happens outside the predict lock, the swap is a single reference
+        assignment under it, and a failed load leaves the prior version
+        serving — so a sustained request hammer sees zero 5xx across a
+        hot reload.  In-flight requests (including ones queued in the
+        micro-batcher, which resolves the model at call time) drain onto
+        whichever reference is current; nothing is cancelled or dropped.
+        Concurrent reload() calls serialize on their own lock, never
+        blocking the request path.
         """
-        vdir = latest_version_dir(self.base_dir)
-        if vdir is None:
-            # flat layout: base_dir IS the payload
-            if os.path.exists(os.path.join(self.base_dir, "model_spec.json")):
-                vdir = self.base_dir
-            else:
-                raise FileNotFoundError(
-                    f"no model versions under {self.base_dir!r}"
-                )
-        version = os.path.basename(vdir.rstrip("/"))
-        if version == self._loaded_version:
+        with self._reload_lock:
+            vdir = latest_version_dir(self.base_dir)
+            if vdir is None:
+                # flat layout: base_dir IS the payload
+                if os.path.exists(
+                    os.path.join(self.base_dir, "model_spec.json")
+                ):
+                    vdir = self.base_dir
+                else:
+                    raise FileNotFoundError(
+                        f"no model versions under {self.base_dir!r}"
+                    )
+            version = os.path.basename(vdir.rstrip("/"))
+            if version == self._loaded_version:
+                return version
+            loaded = load_exported_model(vdir)
+            with self._lock:
+                prior = self._loaded_version
+                self._loaded = loaded
+                self._loaded_version = version
+            if prior is not None:
+                self._m_model_info.labels(self.model_name, prior).set(0)
+            self._m_model_info.labels(self.model_name, version).set(1)
+            self._m_reloads.inc()
+            log.info("loaded %s version %s", self.model_name, version)
             return version
-        loaded = load_exported_model(vdir)
-        with self._lock:
-            prior = self._loaded_version
-            self._loaded = loaded
-            self._loaded_version = version
-        if prior is not None:
-            self._m_model_info.labels(self.model_name, prior).set(0)
-        self._m_model_info.labels(self.model_name, version).set(1)
-        self._m_reloads.inc()
-        log.info("loaded %s version %s", self.model_name, version)
-        return version
+
+    # -------------------------------------------------- admission control
+
+    def _admit(self, endpoint: str) -> None:
+        """Admission check + in-flight accounting (pair with _release).
+
+        The bound covers work already admitted (in-flight) plus work
+        queued in the micro-batcher: past it, this request would only
+        deepen the latency cliff, so it is refused NOW with a 429 the
+        client can back off on — shed load is counted, never dropped
+        silently."""
+        with self._inflight_lock:
+            if self.max_queue_depth > 0:
+                depth = self._inflight
+                if self._batcher is not None:
+                    depth += self._batcher._queue.qsize()
+                if depth >= self.max_queue_depth:
+                    self._m_shed.labels(endpoint).inc()
+                    raise ServerOverloaded(
+                        f"queue depth {depth} >= bound "
+                        f"{self.max_queue_depth}"
+                    )
+            self._inflight += 1
+
+    def _release(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
 
     @property
     def version(self) -> Optional[str]:
@@ -282,11 +364,17 @@ class ModelServer:
                 code: int,
                 obj: Dict[str, Any],
                 endpoint: str = "",
+                retry_after_s: int = 0,
             ) -> None:
                 body = json.dumps(obj).encode("utf-8")
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                if retry_after_s > 0:
+                    # 429/503 contract: the client is told WHEN to come
+                    # back, so shed load decorrelates instead of
+                    # instantly re-stampeding.
+                    self.send_header("Retry-After", str(retry_after_s))
                 self.end_headers()
                 self.wfile.write(body)
                 if endpoint:
@@ -344,16 +432,49 @@ class ModelServer:
                     return
                 endpoint, handler = route
                 t0 = time.perf_counter()
+                admitted = False
                 try:
+                    # Fault hook (RELOAD_DURING_HAMMER): a no-op global
+                    # read unless a test plan is active.
+                    _faults.serving_request(server, endpoint)
+                    server._admit(endpoint)
+                    admitted = True
                     n = int(self.headers.get("Content-Length", "0"))
                     payload = json.loads(self.rfile.read(n) or b"{}")
                     self._reply(200, handler(payload), endpoint=endpoint)
-                except Exception as e:
+                except ServerOverloaded as e:
+                    # Load shed at the door: an explicit, retriable
+                    # verdict — never a dropped connection or a 5xx.
                     self._reply(
-                        400, {"error": f"{type(e).__name__}: {e}"},
+                        429, {"error": f"overloaded: {e}"},
                         endpoint=endpoint,
+                        retry_after_s=ServerOverloaded.retry_after_s,
+                    )
+                except Exception as e:
+                    # Classified verdicts (the zero-5xx-under-reload
+                    # guarantee depends on 5xx meaning SERVER fault, not
+                    # "anything went wrong"): caller mistakes are 4xx,
+                    # not-ready is a retriable 503, everything else is an
+                    # honest 500.
+                    if isinstance(
+                        e, (ValueError, KeyError, TypeError)
+                    ):
+                        code, retry = 400, 0
+                    elif "no model loaded" in str(e):
+                        code, retry = 503, ServerOverloaded.retry_after_s
+                    else:
+                        code, retry = 500, 0
+                        log.exception(
+                            "%s: internal error serving %s",
+                            server.model_name, endpoint,
+                        )
+                    self._reply(
+                        code, {"error": f"{type(e).__name__}: {e}"},
+                        endpoint=endpoint, retry_after_s=retry,
                     )
                 finally:
+                    if admitted:
+                        server._release()
                     server._m_latency.labels(endpoint).observe(
                         time.perf_counter() - t0
                     )

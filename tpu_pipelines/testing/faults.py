@@ -20,6 +20,18 @@ fleet produces, at the exact runner phase where they occur:
                        resume must adopt as-is)
   KILL_ORCHESTRATOR    at node dispatch, in the scheduler thread (pod
                        eviction / OOM / Ctrl-C mid-run)
+  TRANSIENT_EXECUTOR_  inside the executor attempt: an explicitly-
+  ERROR                classified TransientError, ``times`` times, then
+                       clean — the classified-retry-with-backoff bait
+  KILL_SHARD_WORKER    inside a ShardPlan fork child (key ``SHARD_KEY``):
+                       os._exit, the preempted-worker shape the pool's
+                       replacement-worker path must absorb
+  STORE_CONTENTION     inside a store write transaction (key
+                       ``STORE_KEY``): transient StoreUnavailableError,
+                       ``times`` times — multi-writer SQLITE_BUSY shape
+  RELOAD_DURING_HAMMER per serving request (key ``SERVING_KEY``): after
+                       the ``after``-th request, hot-reload the model in
+                       a background thread mid-storm
   ==================== =====================================================
 
 The crash kinds raise :class:`SimulatedCrash` — a ``BaseException`` so no
@@ -52,14 +64,29 @@ HANG = "hang"
 CRASH_BEFORE_PUBLISH = "crash_before_publish"
 CRASH_AFTER_PUBLISH = "crash_after_publish"
 KILL_ORCHESTRATOR = "kill_orchestrator"
+# Robustness-layer kinds (ISSUE 7): the failure modes the unified
+# fault-tolerance layer must absorb rather than surface.
+TRANSIENT_EXECUTOR_ERROR = "transient_executor_error"  # classified-retry bait
+KILL_SHARD_WORKER = "kill_shard_worker"    # SIGKILL-equivalent in a fork child
+STORE_CONTENTION = "store_contention"      # transient StoreUnavailableError
+RELOAD_DURING_HAMMER = "reload_during_hammer"  # hot-swap mid-request-storm
+
+# Sentinel plan keys for faults that are not tied to a pipeline node.
+STORE_KEY = "__store__"
+SHARD_KEY = "__shards__"
+SERVING_KEY = "__serving__"
 
 # kind -> the runner phase whose hook triggers it.
 _KIND_TO_POINT = {
     RAISE: "in_executor",
     HANG: "in_executor",
+    TRANSIENT_EXECUTOR_ERROR: "in_executor",
     CRASH_BEFORE_PUBLISH: "before_publish",
     CRASH_AFTER_PUBLISH: "after_publish",
     KILL_ORCHESTRATOR: "at_dispatch",
+    KILL_SHARD_WORKER: "in_shard",
+    STORE_CONTENTION: "store_op",
+    RELOAD_DURING_HAMMER: "serving_request",
 }
 
 
@@ -89,6 +116,20 @@ class NodeFault:
     # gives up after this long regardless, so a missing/misconfigured
     # watchdog can never wedge a test run forever.
     max_hang_s: float = 60.0
+    # How many times the fault fires before going inert (RAISE /
+    # TRANSIENT_EXECUTOR_ERROR / STORE_CONTENTION: fail N attempts, then
+    # succeed — the shape a classified retry policy must absorb).
+    times: int = 1
+    # KILL_SHARD_WORKER: which shard index of the fanned-out pool dies.
+    shard: int = 0
+    # RELOAD_DURING_HAMMER: fire once the Nth request has arrived (so the
+    # hammer is demonstrably in flight when the swap happens).
+    after: int = 1
+    # KILL_SHARD_WORKER cross-process once-token: fork children inherit a
+    # COPY of the plan's fired-set, so in-memory once-semantics cannot
+    # span the pool — the first child to atomically create this file is
+    # the one that dies.  Auto-assigned at activate() when left empty.
+    once_file: str = ""
 
     def __post_init__(self):
         if self.kind not in _KIND_TO_POINT:
@@ -96,6 +137,8 @@ class NodeFault:
                 f"unknown fault kind {self.kind!r}; "
                 f"expected one of {sorted(_KIND_TO_POINT)}"
             )
+        if self.times < 1:
+            raise ValueError(f"times must be >= 1, got {self.times}")
 
 
 class FaultPlan:
@@ -108,18 +151,23 @@ class FaultPlan:
 
     def __init__(self, faults: Dict[str, NodeFault]):
         self.faults = dict(faults)
-        self._fired: set = set()
+        self._fired: Dict[str, int] = {}
+        self._requests = 0  # serving_request arrivals (RELOAD_DURING_HAMMER)
+        self._pid = None    # set at activate(): detects fork children
         self._lock = threading.Lock()
         self.log: List[Tuple[str, str]] = []
 
     def _take(self, node_id: str, point: str) -> Optional[NodeFault]:
+        """Claim one firing of the fault keyed by ``node_id`` at runner
+        phase ``point``; None once its ``times`` budget is spent."""
         fault = self.faults.get(node_id)
         if fault is None or _KIND_TO_POINT[fault.kind] != point:
             return None
         with self._lock:
-            if node_id in self._fired:
+            fired = self._fired.get(node_id, 0)
+            if fired >= fault.times:
                 return None
-            self._fired.add(node_id)
+            self._fired[node_id] = fired + 1
         return fault
 
     def record(self, node_id: str, event: str) -> None:
@@ -129,13 +177,32 @@ class FaultPlan:
     @contextmanager
     def activate(self):
         """Install this plan for the duration of the block (test-only)."""
+        import os
+        import tempfile
+
         global _ACTIVE
         prev = _ACTIVE
+        self._pid = os.getpid()
+        tokens: List[str] = []
+        for fault in self.faults.values():
+            if fault.kind == KILL_SHARD_WORKER and not fault.once_file:
+                # Reserve a name only — the first shard child to O_EXCL-
+                # create it wins the kill; parent cleans up afterwards.
+                fault.once_file = os.path.join(
+                    tempfile.gettempdir(),
+                    f"tpp-fault-{os.getpid()}-{id(fault)}.token",
+                )
+                tokens.append(fault.once_file)
         _ACTIVE = self
         try:
             yield self
         finally:
             _ACTIVE = prev
+            for token in tokens:
+                try:
+                    os.unlink(token)
+                except OSError:
+                    pass
 
 
 _ACTIVE: Optional[FaultPlan] = None
@@ -165,6 +232,13 @@ def in_executor(
     fault = plan._take(node_id, "in_executor")
     if fault is None:
         return
+    if fault.kind == TRANSIENT_EXECUTOR_ERROR:
+        # Explicitly-classified transient failure: the robustness layer's
+        # RetryPolicy must absorb `times` of these and then succeed.
+        from tpu_pipelines.robustness.errors import TransientError
+
+        plan.record(node_id, "transient_executor_error")
+        raise TransientError(fault.message)
     if fault.kind == RAISE:
         plan.record(node_id, "raise")
         raise InjectedFault(fault.message)
@@ -198,3 +272,89 @@ def after_publish(node_id: str) -> None:
     if plan._take(node_id, "after_publish") is not None:
         plan.record(node_id, "crash_after_publish")
         raise SimulatedCrash(node_id, "after_publish")
+
+
+def in_shard(shard_index: int) -> None:
+    """Inside a ShardPlan pool worker, before the real per-shard fn.
+
+    KILL_SHARD_WORKER (plan key ``SHARD_KEY``): the matching shard's
+    worker dies with ``os._exit`` — a SIGKILL-equivalent the pool
+    observes as BrokenProcessPool, forcing the replacement-worker path.
+    Cross-process once-semantics ride the fault's ``once_file`` token
+    (fork children inherit plan COPIES, so in-memory state cannot span
+    the pool).  In a same-process fallback pool (threads/sequential) the
+    fault degrades to a TransientError raise: killing the interpreter
+    would take the whole run (and the test) with it.
+    """
+    import os
+
+    plan = _ACTIVE
+    if plan is None:
+        return
+    fault = plan.faults.get(SHARD_KEY)
+    if fault is None or fault.kind != KILL_SHARD_WORKER:
+        return
+    if shard_index != fault.shard or not fault.once_file:
+        return
+    try:
+        fd = os.open(fault.once_file, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        os.close(fd)
+    except OSError:
+        return  # another worker (or a prior attempt) already fired
+    if plan._pid is not None and os.getpid() != plan._pid:
+        # Fork child: die the way a preempted/OOM-killed worker does.
+        os._exit(3)
+    from tpu_pipelines.robustness.errors import TransientError
+
+    plan.record(SHARD_KEY, "kill_shard_worker_inline")
+    raise TransientError(
+        f"{fault.message} (same-process pool: raised instead of killed)"
+    )
+
+
+def store_op(op: str) -> None:
+    """Inside a MetadataStore write transaction, before the commit.
+
+    STORE_CONTENTION (plan key ``STORE_KEY``): raises a transient
+    ``StoreUnavailableError`` ``times`` times — the shape a contended
+    multi-writer store produces (SQLITE_BUSY under N concurrent
+    publishers) — which the store-level publish retry must absorb.
+    """
+    plan = _ACTIVE
+    if plan is None:
+        return
+    fault = plan._take(STORE_KEY, "store_op")
+    if fault is None:
+        return
+    plan.record(STORE_KEY, f"store_contention:{op}")
+    from tpu_pipelines.metadata.store import StoreUnavailableError
+
+    raise StoreUnavailableError(fault.message)
+
+
+def serving_request(server, endpoint: str) -> None:
+    """Per request on the ModelServer's hot endpoints.
+
+    RELOAD_DURING_HAMMER (plan key ``SERVING_KEY``): once the ``after``-th
+    request has arrived — i.e. the hammer is demonstrably in flight — a
+    background thread calls ``server.reload()``, so the zero-5xx
+    reload-under-load guarantee is exercised mid-storm rather than
+    between requests.
+    """
+    plan = _ACTIVE
+    if plan is None:
+        return
+    fault = plan.faults.get(SERVING_KEY)
+    if fault is None or fault.kind != RELOAD_DURING_HAMMER:
+        return
+    with plan._lock:
+        plan._requests += 1
+        n = plan._requests
+    if n < max(1, fault.after):
+        return
+    if plan._take(SERVING_KEY, "serving_request") is None:
+        return
+    plan.record(SERVING_KEY, f"reload_during_hammer:{endpoint}")
+    threading.Thread(
+        target=server.reload, name="tpp-fault-reload", daemon=True
+    ).start()
