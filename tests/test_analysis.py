@@ -463,6 +463,55 @@ def test_tpp206_unloadable_module_entry(tmp_path):
     assert len(f206b) == 1 and "run_fn" in f206b[0].message
 
 
+def test_tpp207_window_host_traffic(tmp_path):
+    """Per-step device_put/host-read inside a loop body fires ONLY when
+    window_steps>1 is statically configured; the windowed config with no
+    in-loop host traffic, and a per-step config with it, both stay silent."""
+    from tpu_pipelines.utils.module_loader import load_fn
+
+    mod = tmp_path / "windowed.py"
+    mod.write_text(textwrap.dedent('''
+        def windowed_per_step(batches):
+            import jax
+            from tpu_pipelines.trainer import TrainLoopConfig
+
+            cfg = TrainLoopConfig(train_steps=8, window_steps=8)
+            for b in batches:
+                db = jax.device_put(b)
+                jax.block_until_ready(db)
+            return cfg
+
+
+        def per_step_loop(batches):
+            import jax
+            from tpu_pipelines.trainer import TrainLoopConfig
+
+            cfg = TrainLoopConfig(train_steps=8, window_steps=1)
+            for b in batches:
+                jax.device_put(b)
+            return cfg
+
+
+        def windowed_clean(batches):
+            import jax
+            from tpu_pipelines.trainer import TrainLoopConfig
+
+            cfg = TrainLoopConfig(train_steps=8, window_steps=8)
+            staged = jax.device_put(batches)
+            return cfg, staged
+    '''))
+    findings = check_callable(
+        load_fn(str(mod), "windowed_per_step"), "Trainer"
+    )
+    rules = [f.rule for f in findings]
+    assert rules == ["TPP207", "TPP207"], findings
+    assert all(f.severity == "warn" for f in findings)
+    assert "device_put" in findings[0].message
+    assert "window_steps" in findings[0].message
+    assert check_callable(load_fn(str(mod), "per_step_loop"), "T") == []
+    assert check_callable(load_fn(str(mod), "windowed_clean"), "T") == []
+
+
 # ------------------------------------------------------------------- gates
 
 
@@ -801,6 +850,24 @@ def ModGen(ctx):
 
 def create_pipeline():
     gen = ModGen(module_file="{root}/does_not_exist.py")
+    return _pipe([gen, Sink(examples=gen.outputs["examples"])])
+''',
+    "TPP207": '''
+@component(outputs={{"examples": "Examples"}}, name="WindowGen")
+def WindowGen(ctx):
+    import jax
+    from tpu_pipelines.trainer import TrainLoopConfig
+
+    config = TrainLoopConfig(train_steps=10, window_steps=8)
+    step = 0
+    while step < 10:
+        jax.device_put({{"x": step}})
+        step += 1
+    return config
+
+
+def create_pipeline():
+    gen = WindowGen()
     return _pipe([gen, Sink(examples=gen.outputs["examples"])])
 ''',
 }

@@ -72,8 +72,8 @@ def test_bench_smoke_emits_compact_stdout_and_full_report():
     assert report["smoke"] is True
     assert report["metric"] == compact["metric"]
     assert report["value"] == compact["value"]
-    for key in ("bert", "taxi", "taxi_device", "mnist", "resnet",
-                "pipeline_e2e", "flash_probe", "t5_decode"):
+    for key in ("bert", "taxi", "taxi_device", "taxi_window", "mnist",
+                "resnet", "pipeline_e2e", "flash_probe", "t5_decode"):
         assert report.get(key) is not None or key in report["errors"], (
             key, report.get("errors")
         )
@@ -202,6 +202,26 @@ def test_bench_smoke_emits_compact_stdout_and_full_report():
     ref = report["a100_reference"]
     assert ref["ex_per_sec"] > 0
     assert "source" in ref and "provenance" in ref
+    # Host-loop-tax window sweep (ISSUE 8): the windowed train_loop leg
+    # records throughput per window_steps, publishes taxi_device as the
+    # ceiling, and the compact line carries the speedup key.  (The >=5x
+    # windowed speedup is a real-chip claim — µs-scale steps against a
+    # tunnel; a CPU smoke box only shows the keys and sane ratios.)
+    tw = report["taxi_window"]
+    assert set(tw["window_sweep"]) == {
+        str(w) for w in tw["window_steps_swept"]
+    }
+    assert all(v > 0 for v in tw["window_sweep"].values()), tw
+    assert tw["window_speedup"] is not None and tw["window_speedup"] > 0
+    assert tw["best_window_steps"] in tw["window_steps_swept"]
+    assert tw["taxi_device_ceiling"] > 0
+    assert tw["gap_to_device_ceiling"] > 0
+    assert compact["window_speedup"] == tw["window_speedup"]
+    assert compact["gap_to_ceiling"] == tw["gap_to_device_ceiling"]
+    # The BERT leg carries its windowed datapoint at the bench log window.
+    bw = report["bert"]["window_sweep"]
+    assert set(bw) == {"1", str(report["bert"]["window_steps_log_every"])}
+    assert all(v > 0 for v in bw.values()), bw
     # Static-analyzer health (ISSUE 6): all six examples lint clean and
     # the compact line carries the analyzer verdict.
     lint = report["lint"]

@@ -327,6 +327,71 @@ def _captured_state(fn: Callable):
         yield "default", name, value
 
 
+# Call tails that move data across the host/device boundary once per loop
+# iteration — exactly the traffic the windowed train loop exists to remove.
+_HOST_TRAFFIC_TAILS = {"device_put", "device_get", "block_until_ready"}
+_HOST_READ_DOTTED = {"np.asarray", "numpy.asarray", "jax.device_get"}
+
+
+def _window_steps_configured(tree: ast.AST) -> bool:
+    """True when any TrainLoopConfig(...) call in the source pins
+    ``window_steps`` to a static int > 1 (the statically-decidable case;
+    dynamic values stay silent — heuristics err toward silence)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not _dotted(node.func).endswith("TrainLoopConfig"):
+            continue
+        for kw in node.keywords:
+            if (
+                kw.arg == "window_steps"
+                and isinstance(kw.value, ast.Constant)
+                and isinstance(kw.value.value, int)
+                and kw.value.value > 1
+            ):
+                return True
+    return False
+
+
+def _check_window_host_traffic(
+    src: _Source, node_id: str, fn_label: str
+) -> List[Finding]:
+    """TPP207: a hand-rolled per-step loop defeats the configured window.
+
+    With ``window_steps > 1`` the framework loop dispatches the whole
+    window as one compiled scan; a ``device_put`` / host read /
+    ``block_until_ready`` inside a Python ``for``/``while`` body in the
+    same source re-introduces the per-iteration host round-trip the
+    window was configured to remove."""
+    if not _window_steps_configured(src.tree):
+        return []
+    out: List[Finding] = []
+    for loop in ast.walk(src.tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            tail = dotted.rsplit(".", 1)[-1] if dotted else ""
+            if not (tail in _HOST_TRAFFIC_TAILS or dotted in _HOST_READ_DOTTED):
+                continue
+            f = _finding(
+                src, node, "TPP207", WARN, node_id,
+                f"{fn_label}: per-step {dotted}() inside a "
+                f"`{type(loop).__name__.lower()}` loop body while "
+                "TrainLoopConfig(window_steps>1) is configured — each "
+                "iteration pays the host round-trip the window was meant "
+                "to amortize",
+                "feed batches through the framework train_loop (its "
+                "windowed infeed stages the whole window on device), or "
+                "set window_steps=1 if per-step host access is intended",
+            )
+            if f:
+                out.append(f)
+    return out
+
+
 def _check_closure_staleness(
     src: _Source, node_id: str, fn_label: str, fn: Callable
 ) -> List[Finding]:
@@ -375,6 +440,7 @@ def check_callable(
         return out
     out.extend(_check_jit_hazards(src, node_id, label))
     out.extend(_check_map_shards_payload(src, node_id, label, fn))
+    out.extend(_check_window_host_traffic(src, node_id, label))
     return out
 
 

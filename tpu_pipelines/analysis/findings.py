@@ -104,6 +104,13 @@ RULES: Dict[str, Dict[str, str]] = {
         "severity": ERROR,
         "title": "module-file entry point cannot be loaded",
     },
+    "TPP207": {
+        "severity": WARN,
+        "title": "per-step host traffic (device_put / device read / "
+                 "block_until_ready) inside a training loop body while "
+                 "TrainLoopConfig(window_steps>1) is configured — the "
+                 "windowed loop's host-tax win is forfeited",
+    },
 }
 
 GRAPH_RULE_PREFIX = "TPP1"

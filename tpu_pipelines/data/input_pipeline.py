@@ -319,6 +319,55 @@ def _prefetched(source: Iterator[Batch], depth: int) -> Iterator[Batch]:
         stop.set()
 
 
+def windowed_infeed(
+    batches: Iterator[Batch],
+    window_lengths: Iterator[int],
+    stage: Callable[[Batch], Any],
+    prefetch: int = 2,
+) -> Iterator[Any]:
+    """Double-buffered multi-step infeed: stack per-step host batches into
+    windows (leading axis = step-in-window) and stage each window on device
+    ahead of the consumer.
+
+    ``window_lengths`` is the schedule (the train loop shrinks windows to
+    land on eval/checkpoint boundaries); ``stage`` is the device_put of one
+    stacked window (async, so the H2D copy of window k+1 overlaps the scan
+    running window k — the window-granular analogue of ``sharded_batches``'
+    per-batch double buffering).  The host-side ``np.stack`` work rides the
+    existing ``_prefetched`` background thread; staging happens on the
+    consumer thread, one window ahead.  A source that exhausts mid-window
+    yields the partial stack, then ends.
+
+    Yields ``(window_len, staged_window)``.
+    """
+    def stacks() -> Iterator[Batch]:
+        it = iter(batches)
+        for want in window_lengths:
+            buf = []
+            for _ in range(want):
+                nxt = next(it, None)
+                if nxt is None:
+                    break
+                buf.append(nxt)
+            if not buf:
+                return
+            yield {k: np.stack([b[k] for b in buf]) for k in buf[0]}
+            if len(buf) < want:
+                return
+
+    src = _prefetched(stacks(), prefetch) if prefetch > 0 else stacks()
+    from collections import deque
+
+    pending: "deque" = deque()
+    for stacked in src:
+        n = len(next(iter(stacked.values())))
+        pending.append((n, stage(stacked)))
+        if len(pending) > 1:
+            yield pending.popleft()
+    while pending:
+        yield pending.popleft()
+
+
 def sharded_batches(
     iterator: BatchIterator, mesh: Any
 ) -> Iterator[Any]:

@@ -142,3 +142,7 @@ class TrainResult:
     # analysis is unavailable).  None when collection was off or failed.
     cost_analysis_flops_per_step: Optional[float] = None
     cost_analysis_source: str = ""
+    # Effective device-resident multi-step window the loop ran with
+    # (TrainLoopConfig.window_steps / TPP_WINDOW_STEPS, default log_every);
+    # 1 = the per-step host loop.
+    window_steps: int = 1

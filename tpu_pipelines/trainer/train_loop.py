@@ -15,8 +15,10 @@ per-parameter sharding rules for model parallelism.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import logging
 import os
+import threading
 import time
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
@@ -72,6 +74,21 @@ class TrainLoopConfig:
     checkpoint_every: int = 0      # 0 = no mid-training checkpoints
     keep_checkpoints: int = 3
     log_every: int = 100
+    # Device-resident multi-step window: dispatch this many optimizer steps
+    # as ONE compiled ``lax.scan`` over a device-staged batch stack (leading
+    # axis = step-in-window), with a single device->host metric fetch per
+    # window — the per-step host round-trip (device_put + dispatch + drain)
+    # is the ~100x gap between the real train_loop path and the
+    # device-resident fori_loop ceiling on µs-scale steps (BENCH_R5).
+    # None = read env TPP_WINDOW_STEPS, else default to ``log_every``
+    # (window cadence == metric cadence); <=1 = the per-step loop,
+    # bit-for-bit in metric semantics.  Windows shrink to land exactly on
+    # eval/checkpoint/train_steps boundaries; per-step metric values are
+    # reconstructed host-side from the windowed accumulator, so log_every
+    # emission and the NaN/stall/loss-spike watchdogs keep their per-step
+    # semantics, sampled at window boundaries.  Forced to 1 while
+    # profile_dir is set (profiling needs per-step dispatch granularity).
+    window_steps: Optional[int] = None
     seed: int = 0
     mesh_config: Optional[MeshConfig] = None
     # Optional pytree-of-PartitionSpec matching params, for model parallelism;
@@ -549,92 +566,221 @@ def train_loop(
     examples_after_t0 = 0
     input_wait_s = 0.0     # host-side time not overlapped with device work
     profiling = False
+    device_batch = None
     batch = first_batch
     step = start_step
+    eff_window = _effective_window_steps(config)
     window_anchor = (step, time.perf_counter())  # telemetry window start
-    while step < config.train_steps:
-        if config.profile_dir and not profiling and step - start_step == config.profile_from:
-            jax.profiler.start_trace(config.profile_dir)
-            profiling = True
-        tracker.step_start(step)
-        t_in = time.perf_counter()
-        device_batch = put_batch(batch)
-        if t_start is not None:  # only measure the post-compile window
-            input_wait_s += time.perf_counter() - t_in
-        state, metrics = train_step(state, device_batch)
-        step += 1
-        monitor.heartbeat(step)  # liveness only; loss rides log cadence
-        if profiling and step - start_step >= config.profile_to:
-            jax.block_until_ready(metrics["loss"])
-            jax.profiler.stop_trace()
-            profiling = False
-        if t_start is None:
-            # Start timing after step 1 retires (excludes compile time).  A
-            # device-to-host READ, not block_until_ready: on some platforms
-            # (e.g. tunneled experimental backends) block_until_ready returns
-            # before execution finishes, which would start the clock early —
-            # a transfer of the step's output cannot lie.
-            np.asarray(metrics["loss"])
-            t_start = time.perf_counter()
-            anchors.append((step, t_start))
-        else:
-            examples_after_t0 += config.batch_size
-            if (
-                config.anchor_every
-                and (step - anchors[0][0]) % config.anchor_every == 0
-            ):
-                # Device-to-host read of THIS step's output: the step chain
-                # is a data dependency, so the transfer proves every step up
-                # to here executed on device before the clock is read.
-                np.asarray(metrics["loss"])
-                anchors.append((step, time.perf_counter()))
-        if config.log_every and step % config.log_every == 0:
-            host_metrics = {
-                k: float(v) for k, v in metrics.items()
+
+    def emit_eval(at_step: int) -> None:
+        ev = _run_eval(eval_step, state, eval_iter_fn, config, put_batch,
+                       has_model_state)
+        if metrics_cb:
+            metrics_cb(at_step, {f"eval_{k}": v for k, v in ev.items()})
+        tb_write("eval", at_step, {f"eval_{k}": v for k, v in ev.items()})
+        log.info("step %d eval: %s", at_step, ev)
+
+    if eff_window > 1:
+        # ---- device-resident multi-step window (the host-loop-tax fix).
+        # The log_every window runs as ONE compiled lax.scan over a batch
+        # stack staged on device by the double-buffered infeed; the only
+        # per-window host traffic is the fetch of the scan's stacked
+        # metrics — a copy-out, never a sync on the (donated) hot state.
+        from tpu_pipelines.data.input_pipeline import windowed_infeed
+
+        win_shard = {
+            k: NamedSharding(mesh, P(None, *s.spec))
+            for k, s in batch_shard.items()
+        }
+        train_window = jax.jit(
+            lambda st, bats: jax.lax.scan(step_fn, st, bats),
+            in_shardings=(state_shard, win_shard),
+            out_shardings=(state_shard, None),
+            donate_argnums=(0,) if config.donate_state else (),
+        )
+
+        def stage_window(stacked):
+            return {
+                k: jax.device_put(v, win_shard[k])
+                for k, v in stacked.items()
             }
-            metrics_hist.append((step, host_metrics))
-            if metrics_cb:
-                metrics_cb(step, host_metrics)
-            tb_write("train", step, host_metrics)
-            log.info("step %d: %s", step, host_metrics)
-            # Telemetry window: the host loss just materialized above, so
-            # the NaN/spike checks are free here; gauges cover the span
-            # since the previous log point.
-            now = time.perf_counter()
-            _publish_window(
-                step, step - window_anchor[0], now - window_anchor[1],
-                host_metrics.get("loss"),
-            )
-            window_anchor = (step, now)
-        if mngr is not None and checkpoint_every:
-            mngr.save(step, args=_ocp_save_args(state))
-        if (
-            eval_step is not None
-            and config.eval_every
-            and step % config.eval_every == 0
-        ):
-            ev = _run_eval(eval_step, state, eval_iter_fn, config, put_batch,
-                           has_model_state)
-            if metrics_cb:
-                metrics_cb(step, {f"eval_{k}": v for k, v in ev.items()})
-            tb_write("eval", step, {f"eval_{k}": v for k, v in ev.items()})
-            log.info("step %d eval: %s", step, ev)
-        if step >= config.train_steps:
-            break
-        try:
+
+        def window_lengths(start: int):
+            # Windows shrink to land exactly on eval/checkpoint/train_steps
+            # boundaries, so boundary consumers still see the state at the
+            # exact step they expect.  Scan length is shape-static (each
+            # distinct length is one compile); the schedule keeps distinct
+            # lengths to O(1): the window itself plus boundary remainders.
+            s = start
+            while s < config.train_steps:
+                stop = s + eff_window
+                for every in (
+                    config.eval_every if eval_step is not None else 0,
+                    checkpoint_every if mngr is not None else 0,
+                ):
+                    if every:
+                        stop = min(stop, ((s // every) + 1) * every)
+                stop = min(stop, config.train_steps)
+                yield stop - s
+                s = stop
+
+        saver = _AsyncCheckpointSaver(mngr) if mngr is not None else None
+        infeed = windowed_infeed(
+            itertools.chain([first_batch], train_it),
+            window_lengths(step),
+            stage_window,
+        )
+        while step < config.train_steps:
             t_in = time.perf_counter()
             tracker.data_loading_start()
             try:
-                batch = next(train_it)
+                item = next(infeed, None)
             finally:
-                # On StopIteration too — an open-ended data-loading interval
-                # would misattribute everything through job_end as badput.
                 tracker.data_loading_end()
+            if item is None:
+                log.info("train iterator exhausted at step %d", step)
+                break
             if t_start is not None:
                 input_wait_s += time.perf_counter() - t_in
-        except StopIteration:
-            log.info("train iterator exhausted at step %d", step)
-            break
+            w, dev_window = item
+            tracker.step_start(step)
+            state, mstack = train_window(state, dev_window)
+            step += w
+            # ONE device-to-host fetch per window: the stacked metrics are
+            # a data dependency of every step in the window, so the
+            # transfer proves the whole window executed before the clock
+            # is read — the same cannot-lie anchoring as the per-step
+            # path, at window granularity.
+            host_stack = jax.device_get(mstack)
+            now = time.perf_counter()
+            if t_start is None:
+                t_start = now  # the first window absorbs compile
+            else:
+                examples_after_t0 += w * config.batch_size
+            anchors.append((step, now))
+            # Per-step values reconstructed from the windowed accumulator:
+            # the watchdog sees every step's loss (a mid-window NaN fires
+            # at the boundary) and log_every keeps its exact cadence.
+            for i in range(w):
+                s_i = step - w + 1 + i
+                monitor.heartbeat(s_i, loss=float(host_stack["loss"][i]))
+                if config.log_every and s_i % config.log_every == 0:
+                    host_metrics = {
+                        k: float(v[i]) for k, v in host_stack.items()
+                    }
+                    metrics_hist.append((s_i, host_metrics))
+                    if metrics_cb:
+                        metrics_cb(s_i, host_metrics)
+                    tb_write("train", s_i, host_metrics)
+                    log.info("step %d: %s", s_i, host_metrics)
+            metrics = {k: v[-1] for k, v in host_stack.items()}
+            _publish_window(
+                step, step - window_anchor[0], now - window_anchor[1],
+                float(host_stack["loss"][-1]),
+            )
+            window_anchor = (step, now)
+            if (
+                saver is not None and checkpoint_every
+                and step % checkpoint_every == 0
+            ):
+                saver.save(step, state)
+            if (
+                eval_step is not None
+                and config.eval_every
+                and step % config.eval_every == 0
+            ):
+                emit_eval(step)
+        if saver is not None:
+            # Completion fence at loop exit: the in-flight save must be
+            # durable before the final synchronous save/export below.
+            saver.fence()
+    else:
+        while step < config.train_steps:
+            if config.profile_dir and not profiling and step - start_step == config.profile_from:
+                jax.profiler.start_trace(config.profile_dir)
+                profiling = True
+            tracker.step_start(step)
+            t_in = time.perf_counter()
+            device_batch = put_batch(batch)
+            if t_start is not None:  # only measure the post-compile window
+                input_wait_s += time.perf_counter() - t_in
+            state, metrics = train_step(state, device_batch)
+            step += 1
+            monitor.heartbeat(step)  # liveness only; loss rides log cadence
+            if profiling and step - start_step >= config.profile_to:
+                # Device-to-host read (not block_until_ready — see t_start
+                # note) so the trace captures the step's full execution.
+                np.asarray(metrics["loss"])
+                jax.profiler.stop_trace()
+                profiling = False
+            if t_start is None:
+                # Start timing after step 1 retires (excludes compile time).  A
+                # device-to-host READ, not block_until_ready: on some platforms
+                # (e.g. tunneled experimental backends) block_until_ready returns
+                # before execution finishes, which would start the clock early —
+                # a transfer of the step's output cannot lie.
+                np.asarray(metrics["loss"])
+                t_start = time.perf_counter()
+                anchors.append((step, t_start))
+            else:
+                examples_after_t0 += config.batch_size
+                if (
+                    config.anchor_every
+                    and (step - anchors[0][0]) % config.anchor_every == 0
+                ):
+                    # Device-to-host read of THIS step's output: the step chain
+                    # is a data dependency, so the transfer proves every step up
+                    # to here executed on device before the clock is read.
+                    np.asarray(metrics["loss"])
+                    anchors.append((step, time.perf_counter()))
+            if config.log_every and step % config.log_every == 0:
+                host_metrics = {
+                    k: float(v) for k, v in metrics.items()
+                }
+                metrics_hist.append((step, host_metrics))
+                if metrics_cb:
+                    metrics_cb(step, host_metrics)
+                tb_write("train", step, host_metrics)
+                log.info("step %d: %s", step, host_metrics)
+                # Telemetry window: the host loss just materialized above, so
+                # the NaN/spike checks are free here; gauges cover the span
+                # since the previous log point.
+                now = time.perf_counter()
+                _publish_window(
+                    step, step - window_anchor[0], now - window_anchor[1],
+                    host_metrics.get("loss"),
+                )
+                window_anchor = (step, now)
+            if (
+                mngr is not None and checkpoint_every
+                and step % checkpoint_every == 0
+            ):
+                # Gated on the cadence here, not just inside orbax: building
+                # save args and consulting the manager every step is pure
+                # per-step host overhead on the hot path.
+                mngr.save(step, args=_ocp_save_args(state))
+            if (
+                eval_step is not None
+                and config.eval_every
+                and step % config.eval_every == 0
+            ):
+                emit_eval(step)
+            if step >= config.train_steps:
+                break
+            try:
+                t_in = time.perf_counter()
+                tracker.data_loading_start()
+                try:
+                    batch = next(train_it)
+                finally:
+                    # On StopIteration too — an open-ended data-loading interval
+                    # would misattribute everything through job_end as badput.
+                    tracker.data_loading_end()
+                if t_start is not None:
+                    input_wait_s += time.perf_counter() - t_in
+            except StopIteration:
+                log.info("train iterator exhausted at step %d", step)
+                break
 
     if profiling:
         jax.profiler.stop_trace()
@@ -704,6 +850,10 @@ def train_loop(
         # the former).  Both count every op, so a figure BELOW an analytic
         # 6NT-style numerator falsifies that numerator.
         try:
+            if device_batch is None:
+                # Windowed path: no per-step batch is alive; the analysis
+                # only needs shapes/shardings, so re-stage the first batch.
+                device_batch = put_batch(first_batch)
             lowered = train_step.lower(state, device_batch)
             ca = None
             try:
@@ -764,6 +914,7 @@ def train_loop(
         badput=gsum.get("badput", {}),
         cost_analysis_flops_per_step=cost_flops,
         cost_analysis_source=cost_source,
+        window_steps=eff_window,
     )
     final = (
         (state.params, state.model_state) if has_model_state
@@ -772,14 +923,93 @@ def train_loop(
     return final, result
 
 
+ENV_WINDOW_STEPS = "TPP_WINDOW_STEPS"
+
+
+def _effective_window_steps(config: TrainLoopConfig) -> int:
+    """Resolve the multi-step window length: explicit config >
+    TPP_WINDOW_STEPS env > log_every; floor 1.  Profiling forces 1 —
+    a trace of one scan dispatch has no per-step spans to look at."""
+    w = config.window_steps
+    if w is None:
+        raw = os.environ.get(ENV_WINDOW_STEPS, "").strip()
+        if raw:
+            try:
+                w = int(raw)
+            except ValueError:
+                log.warning("ignoring non-integer %s=%r", ENV_WINDOW_STEPS, raw)
+    if w is None:
+        w = config.log_every
+    w = max(1, int(w or 0))
+    if w > 1 and config.profile_dir:
+        log.info(
+            "window_steps=%d forced to 1: profile_dir is set and the "
+            "profiler needs per-step dispatch granularity", w,
+        )
+        return 1
+    return w
+
+
+def _saveable(state):
+    out = {"step": state.step, "params": state.params,
+           "opt_state": state.opt_state}
+    if state.model_state is not None:
+        out["model_state"] = state.model_state
+    return out
+
+
 def _ocp_save_args(state):
     import orbax.checkpoint as ocp
 
-    saveable = {"step": state.step, "params": state.params,
-                "opt_state": state.opt_state}
-    if state.model_state is not None:
-        saveable["model_state"] = state.model_state
-    return ocp.args.StandardSave(saveable)
+    return ocp.args.StandardSave(_saveable(state))
+
+
+class _AsyncCheckpointSaver:
+    """Checkpoint writes off the windowed loop's critical path.
+
+    ``save()`` first snapshots the saveable state with an on-device copy —
+    the hot state's buffers are donated into the next dispatched window,
+    so a background reader must not touch them — then a daemon thread
+    fetches the copy and runs the orbax save to completion.  ``fence()``
+    (run before every subsequent save and at loop exit) joins the thread
+    and re-raises any save error, so a kill between windows loses at most
+    the one in-flight save, never a finished one (orbax step dirs are
+    atomic), and the final checkpoint is always durable before
+    ``train_loop`` returns."""
+
+    def __init__(self, mngr):
+        self._mngr = mngr
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def save(self, step: int, state: "TrainState") -> None:
+        self.fence()
+        snap = jax.tree_util.tree_map(
+            lambda x: jnp.array(x) if isinstance(x, jax.Array) else x,
+            _saveable(state),
+        )
+
+        def run() -> None:
+            import orbax.checkpoint as ocp
+
+            try:
+                self._mngr.save(step, args=ocp.args.StandardSave(snap))
+                self._mngr.wait_until_finished()
+            except BaseException as e:  # noqa: BLE001 — re-raised at fence
+                self._error = e
+
+        self._thread = threading.Thread(
+            target=run, name="tpp-async-ckpt", daemon=True
+        )
+        self._thread.start()
+
+    def fence(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
 
 
 def _run_eval(eval_step, state, eval_iter_fn, config, put_batch,
