@@ -50,9 +50,11 @@ Also reported:
     visible rather than silent.
   - ``taxi``: the cheap secondary workload, with its ratio vs the committed
     round-1 self baseline (BENCH_SELF_BASELINE.json).
-  - ``flash_probe``: flash vs dense attention fwd+bwd at long sequence —
-    step time and XLA temp-memory, the on-hardware evidence for the Pallas
-    kernels' O(block^2) memory claim.
+  - ``flash_probe``: flash vs dense attention fwd+bwd across a seq-length
+    sweep — tuned-vs-default-vs-dense step times, XLA temp-memory (the
+    O(block^2) claim), the measured flash/dense crossover persisted into
+    the autotune table (ops/autotune.py), and the empty-cache cache-only
+    cold-run proof.
 
 Env: BENCH_SMOKE=1 shrinks the model/steps for a CPU smoke test of the
 bench code path itself (numbers meaningless).
@@ -2037,29 +2039,48 @@ def bench_data_plane(smoke: bool) -> dict:
 
 
 def bench_flash_probe(smoke: bool) -> dict:
-    """Flash vs dense attention, fwd+bwd, at long sequence on this chip.
+    """Flash vs dense attention across a seq-length sweep (ISSUE 9).
 
-    Evidence for the Pallas kernels' memory/time claims
-    (ops/flash_attention.py): times a grad step of sum(attn(q,k,v)) for both
-    implementations at seq 2048 (BERT-base head geometry) and reads XLA's
-    compiled memory analysis — dense must allocate O(L^2) score temporaries,
-    flash O(block^2) VMEM scratch only.
+    Evidence for the autotuner (ops/autotune.py): at every swept sequence
+    length this times a grad step of sum(attn(q,k,v)) for the DEFAULT
+    flash blocks (128/128), every tuned candidate block config, and dense
+    — with an expected-temp-bytes precheck that skips dense cleanly where
+    its O(L^2) temporaries cannot fit (``dense_skipped_oom_precheck``,
+    instead of leaning on a backend compile error as r5 did).  The leg
+    records the measured flash-vs-dense crossover, persists winners +
+    crossover into the autotune cache (real user cache on chip; a throw-
+    away dir in smoke), and first proves an EMPTY-cache cache-only run
+    completes on defaults without sweeping — the jit-trace-time contract.
     """
+    import shutil
+    import tempfile
+
     import jax
     import jax.numpy as jnp
 
+    from tpu_pipelines.models.transformer import (
+        choose_attn_impl,
+        dense_attn_expected_temp_bytes,
+        dense_attn_fits,
+    )
+    from tpu_pipelines.ops import autotune
     from tpu_pipelines.ops.flash_attention import flash_attention
     from tpu_pipelines.parallel.ring_attention import dense_attention
 
     if smoke:
-        b, h, d, l, iters = 1, 2, 32, 256, 2
+        b, h, d, iters = 1, 2, 32, 2
+        seqs, workhorse = (128, 256), 256
     else:
-        b, h, d, l, iters = 8, 12, 64, 2048, 10
+        b, h, d, iters = 8, 12, 64, 10
+        seqs, workhorse = (512, 2048, 8192), 2048
 
-    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
-    q = jax.random.normal(kq, (b, l, h, d), jnp.bfloat16)
-    k = jax.random.normal(kk, (b, l, h, d), jnp.bfloat16)
-    v = jax.random.normal(kv, (b, l, h, d), jnp.bfloat16)
+    def qkv(l, seed=0):
+        kq, kk, kv = jax.random.split(jax.random.key(seed), 3)
+        return (
+            jax.random.normal(kq, (b, l, h, d), jnp.bfloat16),
+            jax.random.normal(kk, (b, l, h, d), jnp.bfloat16),
+            jax.random.normal(kv, (b, l, h, d), jnp.bfloat16),
+        )
 
     def measure(attn_fn, mq, mk, mv, n_iters):
         def loss(q, k, v):
@@ -2091,77 +2112,190 @@ def bench_flash_probe(smoke: bool) -> dict:
         ms = (time.perf_counter() - t0) / n_iters * 1e3
         return {"ms_per_step": round(ms, 3), **mem}
 
-    def flash_fn(q, k, v):
-        return flash_attention(q, k, v, block_q=256, block_k=256)
+    def flash_fn(bq, bk):
+        # Explicit blocks: the measurement must bypass the table so every
+        # candidate is timed as requested (clamped to valid tilings).
+        return lambda q, k, v: flash_attention(
+            q, k, v, block_q=bq, block_k=bk, bwd_block_q=bq, bwd_block_k=bk
+        )
 
-    flash = measure(flash_fn, q, k, v, iters)
-    dense = measure(dense_attention, q, k, v, iters)
-    # What attn_impl="auto" decides at this geometry per sequence length —
-    # the r4 verdict's check that auto tracks best-of(dense, flash): dense
-    # is measured faster everywhere it fits (this probe), so auto must say
-    # "dense" through 2048 and only go flash where dense cannot compile.
-    from tpu_pipelines.models.transformer import dense_attn_fits
-
-    out = {
-        "shape": {"batch": b, "heads": h, "head_dim": d, "seq_len": l},
-        "flash": flash,
-        "dense": dense,
-        "auto_choice": {
-            str(seq): "dense" if dense_attn_fits(b, h, seq, seq, 2)
-            else "flash"
-            for seq in (128, 512, l, 4 * l)
-        },
+    tmp_cache = tempfile.mkdtemp(prefix="tpp-autotune-bench-") if smoke else None
+    saved_env = {
+        k: os.environ.get(k) for k in ("TPP_AUTOTUNE", "TPP_AUTOTUNE_CACHE")
     }
-    if flash.get("ms_per_step") and dense.get("ms_per_step"):
-        out["dense_over_flash_time"] = round(
-            dense["ms_per_step"] / flash["ms_per_step"], 3
-        )
-    if flash.get("temp_size_in_bytes") and dense.get("temp_size_in_bytes"):
-        out["dense_over_flash_temp_mem"] = round(
-            dense["temp_size_in_bytes"] / flash["temp_size_in_bytes"], 3
-        )
+    try:
+        if tmp_cache:
+            os.environ["TPP_AUTOTUNE_CACHE"] = tmp_cache
+        os.environ["TPP_AUTOTUNE"] = "cache-only"
+        autotune.clear_memo()
 
-    if not smoke:
-        # Max-achievable-seq evidence: at 4x the sequence, flash still RUNS
-        # (O(block^2) live memory) while dense's O(L^2) temp demand is read
-        # from a compile-only memory analysis — no allocation attempted.
-        # Both halves are individually guarded so a failure here can never
-        # discard the seq-2048 measurements above.
-        l4 = l * 4
-        kq4, kk4, kv4 = jax.random.split(jax.random.key(1), 3)
-        q4 = jax.random.normal(kq4, (b, l4, h, d), jnp.bfloat16)
-        k4 = jax.random.normal(kk4, (b, l4, h, d), jnp.bfloat16)
-        v4 = jax.random.normal(kv4, (b, l4, h, d), jnp.bfloat16)
+        from tpu_pipelines.observability.metrics import default_registry
 
-        long_seq: dict = {
-            "seq_len": l4,
-            # What dense WOULD need, scaled from its measured seq-2048 temp
-            # (score/softmax temps grow with L^2): the analytic context for
-            # whatever the on-chip compile below reports.
-            "dense_temp_bytes_expected_l2_scaling": (
-                dense["temp_size_in_bytes"] * 16
-                if dense.get("temp_size_in_bytes") else None
+        reg = default_registry()
+
+        def counter(name):
+            m = reg.get(name)
+            total = 0.0
+            if m is not None:
+                for key, val in m._snapshot_series().items():  # noqa: SLF001
+                    total += float(val)
+            return total
+
+        # --- cold cache-only run: empty user cache, default-block flash
+        # through the TABLE-CONSULTING path (no explicit blocks) must
+        # complete without sweeping — what jit tracing relies on.
+        lw = workhorse
+        qw, kw, vw = qkv(lw)
+        hits0, miss0, sweeps0 = (
+            counter("autotune_cache_hits_total"),
+            counter("autotune_cache_misses_total"),
+            counter("autotune_sweeps_total"),
+        )
+        cold = measure(
+            lambda q, k, v: flash_attention(q, k, v), qw, kw, vw, max(2, iters // 2)
+        )
+        autotune_info = {
+            "mode_cold": "cache-only",
+            "cold_cache_completed": bool(cold.get("ms_per_step")),
+            "sweeps_during_cold_run": int(
+                counter("autotune_sweeps_total") - sweeps0
             ),
+            "cache_dir": autotune.cache_dir(),
         }
-        try:
-            long_seq["flash_ms_per_step"] = measure(
-                flash_fn, q4, k4, v4, 4
-            )["ms_per_step"]
-        except Exception as e:  # noqa: BLE001
-            long_seq["flash_error"] = _clean_err(str(e))
-        try:
-            def loss4(q, k, v):
-                return dense_attention(q, k, v).astype(jnp.float32).sum()
 
-            dense4 = jax.jit(jax.grad(loss4, argnums=(0, 1, 2)))
-            ma = dense4.lower(q4, k4, v4).compile().memory_analysis()
-            long_seq["dense_temp_bytes_compile_only"] = int(
-                getattr(ma, "temp_size_in_bytes", 0)
+        # --- seq-length sweep: default vs tuned candidates vs dense
+        # (candidates pass explicit blocks, which bypass the table — the
+        # hit/miss deltas below therefore count the TABLE-consulting cold
+        # run plus any tuned-path retraces).
+        sweep: dict = {}
+        crossover = None
+        device_kind = autotune.current_device_kind()
+        for l in seqs:
+            ql, kl, vl = qkv(l, seed=l)
+            n_iters = iters if l <= workhorse else max(2, iters // 2)
+            if smoke:
+                cand_blocks = [c for c in (64, 128) if c <= l]
+            else:
+                cand_blocks = autotune.valid_blocks(l, 2)[:4]
+            row: dict = {"candidates": []}
+            default_bq = autotune.clamp_block(l, autotune.DEFAULT_BLOCK_Q, 2)
+            times = {}
+            for c in sorted(set(cand_blocks) | {default_bq}):
+                entry = {"block_q": c, "block_k": c}
+                try:
+                    m = measure(flash_fn(c, c), ql, kl, vl, n_iters)
+                    entry.update(m)
+                    times[c] = m["ms_per_step"]
+                except Exception as e:  # noqa: BLE001
+                    entry["error"] = _clean_err(str(e))
+                row["candidates"].append(entry)
+            if times:
+                best = min(times, key=times.get)
+                row["default_blocks"] = default_bq
+                row["default_ms"] = times.get(default_bq)
+                row["tuned_blocks"] = [best, best]
+                row["tuned_ms"] = times[best]
+                # Structural: the default config is IN the candidate grid,
+                # so the winner can never be slower than it.
+                row["tuned_not_worse"] = (
+                    row["default_ms"] is None
+                    or row["tuned_ms"] <= row["default_ms"]
+                )
+                flash_ms = row["tuned_ms"]
+                for op in ("flash_fwd", "flash_bwd"):
+                    autotune.record_entry(
+                        autotune.make_key(
+                            op, b, h, l, d, "bfloat16", False, device_kind
+                        ),
+                        best, best, times[best],
+                        swept=row["candidates"], source="bench_step_sweep",
+                    )
+            else:
+                flash_ms = None
+            # Dense: expected-temp-bytes precheck instead of compiling into
+            # a backend OOM/HTTP-500 (the r5 long_seq failure mode).
+            row["dense_expected_temp_bytes"] = dense_attn_expected_temp_bytes(
+                b, h, l, l, 2
             )
-        except Exception as e:  # compile itself may refuse the program
-            long_seq["dense_compile_error"] = _clean_err(str(e))
-        out["long_seq"] = long_seq
-    return out
+            if not dense_attn_fits(b, h, l, l, 2):
+                row["dense_skipped_oom_precheck"] = True
+                if flash_ms is not None and crossover is None:
+                    crossover = l  # flash is the only implementation that runs
+            else:
+                row["dense_skipped_oom_precheck"] = False
+                try:
+                    row["dense"] = measure(dense_attention, ql, kl, vl, n_iters)
+                    if (
+                        flash_ms is not None and crossover is None
+                        and flash_ms <= row["dense"]["ms_per_step"]
+                    ):
+                        crossover = l
+                except Exception as e:  # noqa: BLE001
+                    row["dense_error"] = _clean_err(str(e))
+            sweep[str(l)] = row
+        autotune_info.update(
+            cache_hits=int(counter("autotune_cache_hits_total") - hits0),
+            cache_misses=int(counter("autotune_cache_misses_total") - miss0),
+            sweeps=int(counter("autotune_sweeps_total") - sweeps0),
+        )
+
+        # Persist the measured crossover (None = dense won everywhere it
+        # fits at every swept length — recorded explicitly so `auto` can
+        # tell measured-no-crossover from never-measured).
+        autotune.record_crossover(
+            device_kind, crossover,
+            geometry={"batch": b, "heads": h, "head_dim": d,
+                      "dtype": "bfloat16", "seqs": list(seqs)},
+            source="bench_flash_probe",
+        )
+        autotune.clear_memo()
+
+        # What attn_impl="auto" now decides per swept length: dense below
+        # the measured crossover, flash at/above it, flash where dense's
+        # temporaries cannot fit (the OOM guard).
+        auto_choice = {
+            str(l): choose_attn_impl(b, h, l, l, 2) for l in seqs
+        }
+
+        wh = sweep[str(workhorse)]
+        out = {
+            "shape": {"batch": b, "heads": h, "head_dim": d,
+                      "seq_len": workhorse},
+            "seqs_swept": list(seqs),
+            "autotune": autotune_info,
+            "sweep": sweep,
+            "flash": next(
+                (c for c in wh["candidates"]
+                 if c["block_q"] == wh.get("default_blocks")), {}
+            ),
+            "dense": wh.get("dense", {}),
+            "auto_choice": auto_choice,
+            "crossover_seq_len": crossover,
+            "device_kind": device_kind,
+        }
+        if wh.get("tuned_ms") and wh.get("default_ms"):
+            out["flash_tuned_speedup"] = round(
+                wh["default_ms"] / wh["tuned_ms"], 3
+            )
+        flash_m, dense_m = out["flash"], out["dense"]
+        if flash_m.get("ms_per_step") and dense_m.get("ms_per_step"):
+            out["dense_over_flash_time"] = round(
+                dense_m["ms_per_step"] / flash_m["ms_per_step"], 3
+            )
+        if flash_m.get("temp_size_in_bytes") and dense_m.get("temp_size_in_bytes"):
+            out["dense_over_flash_temp_mem"] = round(
+                dense_m["temp_size_in_bytes"] / flash_m["temp_size_in_bytes"], 3
+            )
+        return out
+    finally:
+        for key, val in saved_env.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        autotune.clear_memo()
+        if tmp_cache:
+            shutil.rmtree(tmp_cache, ignore_errors=True)
 
 
 _ANSI = None
@@ -2321,6 +2455,12 @@ def _compact(report: dict) -> dict:
     if isinstance(tw, dict) and "window_speedup" in tw:
         compact["window_speedup"] = tw["window_speedup"]
         compact["gap_to_ceiling"] = tw.get("gap_to_device_ceiling")
+    # Kernel-autotune headline (ISSUE 9): tuned-over-default flash speedup
+    # at the workhorse shape and the measured flash/dense crossover.
+    fp = report.get("flash_probe")
+    if isinstance(fp, dict) and "sweep" in fp:
+        compact["flash_tuned_speedup"] = fp.get("flash_tuned_speedup")
+        compact["crossover_seq_len"] = fp.get("crossover_seq_len")
     # Analyzer health: total `tpp lint` findings over the six shipped
     # examples (must be 0 — see bench_lint).
     lint = report.get("lint")
@@ -2527,7 +2667,9 @@ def main() -> None:
     leg("data_plane", bench_data_plane, est_cost_s=120, retries=1)
     leg("mnist", bench_mnist, est_cost_s=60, retries=1)
     leg("resnet", bench_resnet, est_cost_s=150, retries=1)
-    leg("flash_probe", bench_flash_probe, est_cost_s=100, retries=1)
+    # +50 s vs r5: the seq sweep times ~4 candidate block configs per
+    # length instead of one fixed config.
+    leg("flash_probe", bench_flash_probe, est_cost_s=150, retries=1)
     leg("t5_decode", bench_t5_decode, est_cost_s=90, retries=1)
     # Least critical, so last: the converged-goodput evidence leg — sized
     # from whatever budget is actually left (~90 s compile/init reserve

@@ -512,6 +512,54 @@ def test_tpp207_window_host_traffic(tmp_path):
     assert check_callable(load_fn(str(mod), "windowed_clean"), "T") == []
 
 
+def test_tpp208_flash_below_committed_crossover(tmp_path):
+    """attn_impl="flash" hard-coded with a statically-known seq below every
+    committed autotune crossover fires WARN; "auto"/"dense", dynamic
+    shapes, and seqs at/above the crossover floor all stay silent."""
+    from tpu_pipelines.ops.autotune import committed_crossovers
+    from tpu_pipelines.utils.module_loader import load_fn
+
+    crossovers = committed_crossovers()
+    assert crossovers, "repo-committed autotune table must carry a crossover"
+    floor = min(crossovers.values())
+
+    mod = tmp_path / "flashy.py"
+    mod.write_text(textwrap.dedent(f'''
+        def hp_dict_flash():
+            return {{"max_len": 512, "attn_impl": "flash", "d_model": 32}}
+
+
+        def kwargs_flash():
+            from tpu_pipelines.models.transformer import MultiHeadAttention
+
+            return MultiHeadAttention(
+                n_heads=4, head_dim=8, attn_impl="flash", seq_len=128,
+            )
+
+
+        def auto_is_fine():
+            return {{"max_len": 512, "attn_impl": "auto"}}
+
+
+        def dynamic_shape_is_silent(max_len):
+            return {{"max_len": max_len, "attn_impl": "flash"}}
+
+
+        def above_crossover_is_fine():
+            return {{"max_len": {floor}, "attn_impl": "flash"}}
+    '''))
+    for fn, n in (("hp_dict_flash", 1), ("kwargs_flash", 1),
+                  ("auto_is_fine", 0), ("dynamic_shape_is_silent", 0),
+                  ("above_crossover_is_fine", 0)):
+        findings = check_callable(load_fn(str(mod), fn), "Trainer")
+        f208 = [f for f in findings if f.rule == "TPP208"]
+        assert len(f208) == n, (fn, findings)
+        if n:
+            assert f208[0].severity == "warn"
+            assert str(floor) in f208[0].message
+            assert 'attn_impl="auto"' in f208[0].fix
+
+
 # ------------------------------------------------------------------- gates
 
 
@@ -868,6 +916,21 @@ def WindowGen(ctx):
 
 def create_pipeline():
     gen = WindowGen()
+    return _pipe([gen, Sink(examples=gen.outputs["examples"])])
+''',
+    "TPP208": '''
+@component(outputs={{"examples": "Examples"}}, name="FlashGen")
+def FlashGen(ctx):
+    from tpu_pipelines.models.bert import build_bert_model
+
+    hp = {{"vocab_size": 64, "d_model": 32, "n_layers": 1, "n_heads": 4,
+           "d_ff": 64, "max_len": 512, "dropout_rate": 0.0,
+           "num_classes": 2, "attn_impl": "flash"}}
+    return build_bert_model(hp)
+
+
+def create_pipeline():
+    gen = FlashGen()
     return _pipe([gen, Sink(examples=gen.outputs["examples"])])
 ''',
 }

@@ -1,0 +1,361 @@
+"""Kernel autotuner (ops/autotune.py): keys, table, modes, dispatch.
+
+Tier-1-safe: tiny shapes, Pallas interpret mode on CPU, subprocesses only
+for the cross-process determinism contracts (the PR 6 fingerprint
+pattern).  The contracts under test:
+
+  * cache keys are byte-identical across fresh interpreters (canonical
+    fingerprint_json encoding — no reliance on randomized str hashing);
+  * the on-disk table round-trips across processes with identical keys;
+  * cache-only mode (the default) NEVER times anything — jit tracing
+    consults the table and must stay a pure dict lookup;
+  * explicit block args bypass the table entirely;
+  * a corrupt/torn cache file degrades to defaults, never an exception;
+  * ``attn_impl="auto"`` provably selects dense below a seeded crossover
+    and flash at/above it, with memory feasibility as the OOM guard.
+"""
+
+import importlib
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tpu_pipelines.ops import autotune as at
+
+pytestmark = pytest.mark.autotune
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def iso_cache(tmp_path, monkeypatch):
+    """Repoint the user cache at an empty dir and drop in-process memos."""
+    cache = tmp_path / "autotune"
+    monkeypatch.setenv("TPP_AUTOTUNE_CACHE", str(cache))
+    monkeypatch.delenv("TPP_AUTOTUNE", raising=False)
+    monkeypatch.delenv("TPP_AUTOTUNE_BLOCKS", raising=False)
+    at.clear_memo()
+    yield str(cache)
+    at.clear_memo()
+
+
+def _counter(name: str) -> float:
+    from tpu_pipelines.observability.metrics import default_registry
+
+    m = default_registry().get(name)
+    if m is None:
+        return 0.0
+    return sum(float(v) for v in m._snapshot_series().values())  # noqa: SLF001
+
+
+# ----------------------------------------------------------------- keys
+
+
+def test_key_id_deterministic_across_processes():
+    """Same shape => same table key in two fresh interpreters with
+    different hash seeds — the canonical-encoding contract the on-disk
+    table round-trip rests on."""
+    prog = (
+        "from tpu_pipelines.ops.autotune import make_key, key_id\n"
+        "key = make_key('flash_fwd', 8, 12, 2048, 64, 'bfloat16', False,\n"
+        "               device_kind='TPU v5 lite')\n"
+        "print(key_id(key))\n"
+    )
+    outs = []
+    for seed in ("1", "2"):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": seed}
+        res = subprocess.run(
+            [sys.executable, "-c", prog], cwd=REPO, env=env,
+            capture_output=True, text=True, check=True,
+        )
+        outs.append(res.stdout.strip())
+    assert outs[0] == outs[1]
+    assert len(outs[0]) == 16
+
+
+def test_key_buckets_batch_heads_not_seq():
+    """batch*heads buckets to the next power of two (nearby sizes share a
+    winner); seq_len stays exact (block validity hinges on it)."""
+    k1 = at.make_key("flash_fwd", 8, 12, 2048, 64, "bf16", False, "x")
+    k2 = at.make_key("flash_fwd", 16, 7, 2048, 64, "bf16", False, "x")
+    assert k1 == k2  # 96 and 112 both bucket to 128
+    k3 = at.make_key("flash_fwd", 8, 12, 1024, 64, "bf16", False, "x")
+    assert at.key_id(k1) != at.key_id(k3)
+
+
+def test_cache_round_trips_across_processes(iso_cache):
+    """A child process sweeps(-records); the parent reads the SAME entry
+    back through get_block_config — identical keys on both sides."""
+    prog = (
+        "from tpu_pipelines.ops import autotune as at\n"
+        "key = at.make_key('flash_fwd', 1, 2, 64, 8, 'float32', True)\n"
+        "print(at.record_entry(key, 16, 32, 1.25, source='test'))\n"
+    )
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "TPP_AUTOTUNE_CACHE": iso_cache, "PYTHONHASHSEED": "7"}
+    res = subprocess.run(
+        [sys.executable, "-c", prog], cwd=REPO, env=env,
+        capture_output=True, text=True, check=True,
+    )
+    kid = res.stdout.strip()
+    assert kid == at.key_id(
+        at.make_key("flash_fwd", 1, 2, 64, 8, "float32", True)
+    )
+    cfg = at.get_block_config(
+        "flash_fwd", 1, 2, 64, 8, "float32", True
+    )
+    assert cfg == (16, 32)
+
+
+# ---------------------------------------------------------------- modes
+
+
+def test_cache_only_mode_never_sweeps(iso_cache, monkeypatch):
+    """The default mode answers misses with None — it must never reach
+    the timing path (jit traces consult the table mid-trace)."""
+
+    def boom(*a, **k):
+        raise AssertionError("cache-only mode must not sweep")
+
+    monkeypatch.setattr(at, "sweep_flash", boom)
+    misses0 = _counter("autotune_cache_misses_total")
+    cfg = at.get_block_config("flash_fwd", 1, 2, 64, 8, "float32", False)
+    assert cfg is None
+    assert _counter("autotune_cache_misses_total") == misses0 + 1
+
+
+def test_off_mode_bypasses_table(iso_cache, monkeypatch):
+    key = at.make_key("flash_fwd", 1, 2, 64, 8, "float32", False)
+    at.record_entry(key, 16, 16, 1.0)
+    monkeypatch.setenv("TPP_AUTOTUNE", "0")
+    assert at.get_block_config(
+        "flash_fwd", 1, 2, 64, 8, "float32", False
+    ) is None
+    monkeypatch.setenv("TPP_AUTOTUNE", "cache-only")
+    assert at.get_block_config(
+        "flash_fwd", 1, 2, 64, 8, "float32", False
+    ) == (16, 16)
+
+
+def test_sweep_mode_respects_allow_sweep_guard(iso_cache, monkeypatch):
+    """allow_sweep=False (set under a jit trace) blocks timing even in
+    sweep mode — a miss inside a trace falls back to defaults."""
+    monkeypatch.setenv("TPP_AUTOTUNE", "sweep")
+
+    def boom(*a, **k):
+        raise AssertionError("traced call sites must not sweep")
+
+    monkeypatch.setattr(at, "sweep_flash", boom)
+    assert at.get_block_config(
+        "flash_fwd", 1, 2, 64, 8, "float32", False, allow_sweep=False
+    ) is None
+
+
+def test_sweep_in_interpret_mode_on_cpu(iso_cache, monkeypatch):
+    """A real sweep through the Pallas interpreter on the CPU mesh: times
+    the candidate, persists fwd AND bwd winners, and the next lookup is a
+    pure cache hit (no second sweep)."""
+    monkeypatch.setenv("TPP_AUTOTUNE", "sweep")
+    monkeypatch.setenv("TPP_AUTOTUNE_BLOCKS", "16x16")
+    monkeypatch.setenv("TPP_AUTOTUNE_ITERS", "1")
+    sweeps0 = _counter("autotune_sweeps_total")
+    cfg = at.get_block_config(
+        "flash_fwd", 1, 1, 32, 8, "float32", False, interpret=True
+    )
+    assert cfg == (16, 16)
+    assert _counter("autotune_sweeps_total") == sweeps0 + 2  # fwd + bwd
+    table = json.load(open(at.cache_path()))
+    ops = {e["key"]["op"] for e in table["entries"].values()}
+    assert ops == {"flash_fwd", "flash_bwd"}
+    for entry in table["entries"].values():
+        assert entry["swept"] and "ms" in entry["swept"][0]
+    # Second call: hit, not a second sweep.
+    at.clear_memo()
+    cfg2 = at.get_block_config(
+        "flash_fwd", 1, 1, 32, 8, "float32", False, interpret=True
+    )
+    assert cfg2 == (16, 16)
+    assert _counter("autotune_sweeps_total") == sweeps0 + 2
+
+
+def test_corrupt_cache_file_tolerated(iso_cache):
+    """A torn/garbage table degrades to a miss (defaults), never an
+    exception — and a later record overwrites it cleanly."""
+    path = at.cache_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write('{"entries": {"zzz": {"block_q": 16')  # torn mid-write
+    at.clear_memo()
+    assert at.get_block_config(
+        "flash_fwd", 1, 2, 64, 8, "float32", False
+    ) is None
+    assert at.lookup_crossover("cpu-ish") is None
+    key = at.make_key("flash_fwd", 1, 2, 64, 8, "float32", False)
+    at.record_entry(key, 32, 32, 2.0)
+    assert at.get_block_config(
+        "flash_fwd", 1, 2, 64, 8, "float32", False
+    ) == (32, 32)
+
+
+# ------------------------------------------------------- flash dispatch
+
+
+def _qkv(l=64, d=16):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(rng.normal(size=(2, l, 2, d)).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+def test_explicit_block_args_bypass_table(iso_cache, monkeypatch):
+    """Explicit block_q/block_k never consult the autotuner at all."""
+    fa = importlib.import_module("tpu_pipelines.ops.flash_attention")
+
+    def boom(*a, **k):
+        raise AssertionError("explicit blocks must bypass the table")
+
+    monkeypatch.setattr(at, "get_block_config", boom)
+    q, k, v = _qkv()
+    out = fa.flash_attention(q, k, v, block_q=16, block_k=16, interpret=True)
+    assert out.shape == q.shape
+    # ...and the tuned path DOES consult it (the guard actually guards).
+    with pytest.raises(AssertionError, match="bypass"):
+        fa.flash_attention(q, k, v, interpret=True)
+
+
+def test_flash_uses_tuned_blocks_from_table(iso_cache, monkeypatch):
+    """A seeded table entry flows through flash_attention into the kernel
+    launch (observed at the _flash custom_vjp boundary), and the result
+    still matches dense."""
+    fa = importlib.import_module("tpu_pipelines.ops.flash_attention")
+    from tpu_pipelines.parallel.ring_attention import dense_attention
+
+    for op, blocks in (("flash_fwd", (16, 32)), ("flash_bwd", (32, 16))):
+        at.record_entry(
+            at.make_key(op, 2, 2, 64, 16, "float32", False), *blocks, ms=1.0
+        )
+    seen = {}
+    real = fa._flash
+
+    def spy(q, k, v, m, causal, bq, bk, bbq, bbk, interpret):
+        seen.update(bq=bq, bk=bk, bbq=bbq, bbk=bbk)
+        return real(q, k, v, m, causal, bq, bk, bbq, bbk, interpret)
+
+    monkeypatch.setattr(fa, "_flash", spy)
+    q, k, v = _qkv()
+    out = fa.flash_attention(q, k, v, interpret=True)
+    assert (seen["bq"], seen["bk"]) == (16, 32)
+    assert (seen["bbq"], seen["bbk"]) == (32, 16)
+    want = dense_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+# ------------------------------------------------------------ crossover
+
+
+def test_auto_selects_dense_below_and_flash_above_seeded_crossover(
+    iso_cache, monkeypatch
+):
+    """Acceptance: against a seeded table, attn_impl="auto" provably picks
+    dense below the crossover, flash at/above it, and flash when dense
+    cannot fit regardless of the crossover (OOM guard)."""
+    from tpu_pipelines.models.transformer import choose_attn_impl
+
+    monkeypatch.setenv("TPP_HBM_BYTES", str(16 * 1024**3))
+    # Never measured on this device: dense wherever it fits.
+    assert choose_attn_impl(8, 12, 512, 512, 2) == "dense"
+    assert choose_attn_impl(8, 12, 2048, 2048, 2) == "dense"
+    assert choose_attn_impl(8, 12, 8192, 8192, 2) == "flash"  # can't fit
+
+    at.record_crossover(at.current_device_kind(), 1024, source="test")
+    at.clear_memo()
+    assert choose_attn_impl(8, 12, 512, 512, 2) == "dense"
+    assert choose_attn_impl(8, 12, 1023, 1023, 2) == "dense"
+    assert choose_attn_impl(8, 12, 1024, 1024, 2) == "flash"
+    assert choose_attn_impl(8, 12, 2048, 2048, 2) == "flash"
+    # The OOM guard is independent of the crossover: shrink device memory
+    # and even a below-crossover shape must go flash.
+    monkeypatch.setenv("TPP_HBM_BYTES", str(64 * 1024**2))
+    assert choose_attn_impl(8, 12, 512, 512, 2) == "flash"
+
+
+def test_measured_no_crossover_is_recorded_distinctly(iso_cache, monkeypatch):
+    """crossover=None ("dense won everywhere measured") persists as an
+    explicit record and keeps auto on dense."""
+    from tpu_pipelines.models.transformer import choose_attn_impl
+
+    monkeypatch.setenv("TPP_HBM_BYTES", str(16 * 1024**3))
+    kind = at.current_device_kind()
+    at.record_crossover(kind, None, source="test")
+    at.clear_memo()
+    table = json.load(open(at.cache_path(kind)))
+    assert table["crossover"][kind]["crossover_seq_len"] is None
+    assert at.lookup_crossover(kind) is None
+    assert choose_attn_impl(8, 12, 2048, 2048, 2) == "dense"
+
+
+def test_committed_table_carries_v5e_crossover():
+    """The repo-committed table (what TPP208 lints against) ships the
+    measured v5e evidence: a crossover and tuned 256-block entries."""
+    crossovers = at.committed_crossovers()
+    assert "TPU v5 lite" in crossovers
+    assert crossovers["TPU v5 lite"] >= 4096
+    with open(os.path.join(REPO, "tpu_pipelines", "ops",
+                           "autotune_table.json")) as f:
+        table = json.load(f)
+    for kid, entry in table["entries"].items():
+        # Committed ids must match what THIS interpreter derives — the
+        # cross-process key contract applied to the committed file.
+        assert at.key_id(entry["key"]) == kid
+
+
+# ---------------------------------------------------------------- blocks
+
+
+def test_clamp_block_validates_and_clamps():
+    # Largest valid divisor <= requested (f32: multiples of 8, or == L).
+    assert at.clamp_block(64, 128, 4) == 64
+    assert at.clamp_block(64, 16, 4) == 16
+    assert at.clamp_block(24, 16, 4) == 8
+    assert at.clamp_block(24, 128, 2) == 24  # bf16: only L itself tiles
+    assert at.clamp_block(17, 17, 4) == 17  # whole-axis block always valid
+    # The default path (requested >= L) can therefore never fail; an
+    # explicit request below every tileable divisor errors with choices.
+    with pytest.raises(ValueError, match="valid"):
+        at.clamp_block(64, 4, 2)  # bf16 floor is 16; nothing <= 4 works
+    with pytest.raises(ValueError, match="valid"):
+        at.clamp_block(24, 16, 2)  # bf16: 16 doesn't divide 24; 24 > 16
+
+
+def test_flash_attention_clamps_indivisible_blocks():
+    """The old implicit `l % block == 0` requirement is gone: indivisible
+    requests clamp to the largest valid divisor and still match dense."""
+    fa = importlib.import_module("tpu_pipelines.ops.flash_attention")
+    from tpu_pipelines.parallel.ring_attention import dense_attention
+
+    q, k, v = _qkv(l=24)
+    out = fa.flash_attention(q, k, v, block_q=16, block_k=16, interpret=True)
+    want = dense_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+    with pytest.raises(ValueError, match="no valid block_q"):
+        fa.flash_attention(q, k, v, block_q=2, block_k=8, interpret=True)
+
+
+def test_candidate_pairs_env_override_and_vmem_filter(monkeypatch):
+    monkeypatch.setenv("TPP_AUTOTUNE_BLOCKS", "128x128, 256x128")
+    assert at.candidate_pairs(2048, 64, 2) == [(128, 128), (256, 128)]
+    monkeypatch.delenv("TPP_AUTOTUNE_BLOCKS")
+    pairs = at.candidate_pairs(2048, 64, 2)
+    assert (128, 128) in pairs
+    assert all(2048 % bq == 0 and 2048 % bk == 0 for bq, bk in pairs)
+    # bf16 sublane floor: 64 is valid (mult of 16); nothing below appears.
+    assert all(bq >= 64 and bk >= 64 for bq, bk in pairs)

@@ -124,7 +124,10 @@ def test_flash_bf16_and_jit():
     )
 
 
-def test_flash_indivisible_falls_back_to_dense():
+def test_flash_indivisible_blocks_clamp_to_valid_divisor():
+    """L=24 with block 16 used to silently fall back to dense; the blocks
+    now clamp up front (largest valid divisor <= requested: 8 for f32) and
+    the kernel itself runs, still matching dense numerically."""
     q, k, v = _qkv(l=24)  # not divisible by block 16
     got = flash_attention(q, k, v, block_q=16, block_k=16, interpret=True)
     want = dense_attention(q, k, v)

@@ -392,6 +392,75 @@ def _check_window_host_traffic(
     return out
 
 
+# Static names that pin the attention sequence length in the same call /
+# hparam dict as an attn_impl choice (BERT-style hp dicts use max_len).
+_SEQ_KEYS = ("seq_len", "max_len", "max_seq_len")
+
+
+def _const_str_pairs(node: ast.AST):
+    """(key, value_node) pairs for call keywords and str-keyed dict
+    literals — the two ways model configs spell attn_impl."""
+    if isinstance(node, ast.Call):
+        for kw in node.keywords:
+            if kw.arg:
+                yield kw.arg, kw.value
+    elif isinstance(node, ast.Dict):
+        for key, value in zip(node.keys, node.values):
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                yield key.value, value
+
+
+def _check_flash_below_crossover(
+    src: _Source, node_id: str, fn_label: str
+) -> List[Finding]:
+    """TPP208: attn_impl="flash" hard-coded where the COMMITTED autotune
+    table says dense wins for the statically-known shape.
+
+    Only fires when the sequence length is pinned to an int constant in
+    the same call/dict as the attn_impl choice AND sits below every
+    crossover in the repo-committed table (dense measured faster on every
+    tuned device) — dynamic shapes and untuned devices stay silent.
+    """
+    try:
+        from tpu_pipelines.ops.autotune import committed_crossovers
+
+        crossovers = committed_crossovers()
+    except Exception:
+        return []
+    if not crossovers:
+        return []
+    floor = min(crossovers.values())
+    kinds = ", ".join(sorted(crossovers))
+    out: List[Finding] = []
+    for node in ast.walk(src.tree):
+        pairs = dict(_const_str_pairs(node))
+        impl = pairs.get("attn_impl")
+        if not (
+            isinstance(impl, ast.Constant) and impl.value == "flash"
+        ):
+            continue
+        seq = None
+        for name in _SEQ_KEYS:
+            val = pairs.get(name)
+            if isinstance(val, ast.Constant) and isinstance(val.value, int):
+                seq = val.value
+                break
+        if seq is None or seq >= floor:
+            continue
+        f = _finding(
+            src, impl, "TPP208", WARN, node_id,
+            f'{fn_label}: attn_impl="flash" hard-coded at statically-known '
+            f"seq {seq}, below every committed autotune crossover (dense "
+            f"attention measured faster up to {floor} on: {kinds})",
+            'use attn_impl="auto" (measured crossover + OOM guard), or '
+            "re-sweep on your device and commit the new table entry if "
+            "flash genuinely wins at this shape",
+        )
+        if f:
+            out.append(f)
+    return out
+
+
 def _check_closure_staleness(
     src: _Source, node_id: str, fn_label: str, fn: Callable
 ) -> List[Finding]:
@@ -441,6 +510,7 @@ def check_callable(
     out.extend(_check_jit_hazards(src, node_id, label))
     out.extend(_check_map_shards_payload(src, node_id, label, fn))
     out.extend(_check_window_host_traffic(src, node_id, label))
+    out.extend(_check_flash_below_crossover(src, node_id, label))
     return out
 
 

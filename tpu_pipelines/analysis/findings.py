@@ -111,6 +111,13 @@ RULES: Dict[str, Dict[str, str]] = {
                  "TrainLoopConfig(window_steps>1) is configured — the "
                  "windowed loop's host-tax win is forfeited",
     },
+    "TPP208": {
+        "severity": WARN,
+        "title": 'attn_impl="flash" hard-coded at a statically-known '
+                 "sequence length below every committed autotune-table "
+                 "crossover — dense attention measured faster there on "
+                 "every tuned device",
+    },
 }
 
 GRAPH_RULE_PREFIX = "TPP1"

@@ -29,18 +29,20 @@ from tpu_pipelines.parallel.ring_attention import dense_attention, ring_attentio
 
 Dtype = Any
 
-# "auto" attn_impl switchover is MEMORY-feasibility-based, not a sequence
-# threshold.  Measured on v5e (BENCH_R4_LOCAL.json flash_probe, BERT-base
-# geometry b=8 h=12 d=64): dense is faster than the Pallas kernel across
-# the whole band where its O(L^2) score temporaries fit in HBM — ~30%
-# faster at L=128 and still ~25% faster at L=2048 (22.2 ms vs 29.7 ms) —
-# because XLA fuses the fwd score/softmax chain well and the blockwise
-# kernel's extra passes are pure overhead while memory is plentiful.
-# Flash's win is FEASIBILITY: at L=8192 the dense fwd+bwd wants 38.7 GB of
-# temporaries (16x the 2.42 GB measured at 2048 — it scales with L^2) and
-# cannot compile on a 16 GB chip, while flash runs in O(block^2) VMEM
-# scratch.  So "auto" estimates the dense temp footprint and takes dense
-# whenever it fits comfortably:
+# "auto" attn_impl switchover is MEASURED where a measurement exists and
+# memory-feasibility-bounded always (choose_attn_impl): the autotune table
+# (ops/autotune.py) stores a per-device flash-vs-dense crossover sequence
+# length recorded by the bench flash_probe sweep — dense below it, flash
+# at/above it.  With no recorded crossover the rule degrades to the
+# feasibility estimate alone, which every probe so far justified: on v5e
+# (BENCH_R4/R5 flash_probe, BERT-base geometry b=8 h=12 d=64) dense is
+# faster than the untuned Pallas kernel across the whole band where its
+# O(L^2) score temporaries fit in HBM — ~30% faster at L=128, ~25% at
+# L=2048 — because XLA fuses the fwd score/softmax chain well.  Flash's
+# unconditional win is FEASIBILITY: at L=8192 the dense fwd+bwd wants
+# 38.7 GB of temporaries (16x the 2.42 GB measured at 2048 — it scales
+# with L^2) and cannot compile on a 16 GB chip, while flash runs in
+# O(block^2) VMEM scratch.  The feasibility estimate (the OOM guard):
 #
 #   temp ~= DENSE_ATTN_TEMP_FACTOR * B * H * Lq * Lkv * itemsize
 #
@@ -72,6 +74,28 @@ def _device_memory_bytes() -> int:
     return 16 * 1024**3
 
 
+def dense_attn_expected_temp_bytes(
+    batch: int,
+    heads: int,
+    seq_q: int,
+    seq_kv: int,
+    itemsize: int = 2,
+    mesh: Optional[Mesh] = None,
+) -> int:
+    """Calibrated estimate of dense attention's O(L^2) XLA temporaries
+    (per shard when a mesh divides batch over ``data`` / heads over
+    ``model``).  Exposed so callers that must *skip* a dense compile
+    cleanly (the bench OOM precheck) can record the number they acted on
+    instead of depending on a backend error string."""
+    if mesh is not None:
+        shape = dict(mesh.shape)
+        batch = -(-batch // max(1, shape.get("data", 1)))
+        heads = -(-heads // max(1, shape.get("model", 1)))
+    return int(
+        DENSE_ATTN_TEMP_FACTOR * batch * heads * seq_q * seq_kv * itemsize
+    )
+
+
 def dense_attn_fits(
     batch: int,
     heads: int,
@@ -81,7 +105,9 @@ def dense_attn_fits(
     mesh: Optional[Mesh] = None,
 ) -> bool:
     """True when dense attention's O(L^2) temporaries fit comfortably —
-    the "auto" attn_impl rule (see module comment for the calibration).
+    the OOM guard inside the "auto" attn_impl rule (see module comment
+    for the calibration; ``choose_attn_impl`` layers the measured
+    crossover on top).
 
     The estimate is PER SHARD: on a mesh, the batch dim shards over the
     ``data`` axis and heads over ``model`` (TP), so each device only
@@ -91,12 +117,40 @@ def dense_attn_fits(
     frac = float(
         os.environ.get("TPP_DENSE_ATTN_HBM_FRACTION", DENSE_ATTN_HBM_FRACTION)
     )
-    if mesh is not None:
-        shape = dict(mesh.shape)
-        batch = -(-batch // max(1, shape.get("data", 1)))
-        heads = -(-heads // max(1, shape.get("model", 1)))
-    temp = DENSE_ATTN_TEMP_FACTOR * batch * heads * seq_q * seq_kv * itemsize
+    temp = dense_attn_expected_temp_bytes(
+        batch, heads, seq_q, seq_kv, itemsize, mesh=mesh
+    )
     return temp <= frac * _device_memory_bytes()
+
+
+def choose_attn_impl(
+    batch: int,
+    heads: int,
+    seq_q: int,
+    seq_kv: int,
+    itemsize: int = 2,
+    mesh: Optional[Mesh] = None,
+) -> str:
+    """The measured "auto" rule: dense vs flash from the autotune table's
+    per-device crossover, with memory feasibility as the OOM guard only.
+
+    Decision order:
+      1. dense's O(L^2) temporaries don't fit => "flash" (the guard —
+         feasibility, exactly what ``dense_attn_fits`` was built for);
+      2. a measured crossover exists for this device_kind (recorded by
+         the bench flash_probe sweep via ``autotune.record_crossover``)
+         => "flash" at/above it, "dense" below it;
+      3. no measurement => "dense" (every probe so far measured dense
+         faster wherever it fits; flash must EARN the hot path).
+    """
+    if not dense_attn_fits(batch, heads, seq_q, seq_kv, itemsize, mesh=mesh):
+        return "flash"
+    from tpu_pipelines.ops import autotune
+
+    crossover = autotune.lookup_crossover()
+    if crossover is not None and max(seq_q, seq_kv) >= crossover:
+        return "flash"
+    return "dense"
 
 
 class MlpBlock(nn.Module):
@@ -259,12 +313,14 @@ class MultiHeadAttention(nn.Module):
         at moderate lengths, needs local heads divisible by the axis).
       - "flash": the Pallas blockwise kernel (ops/flash_attention.py) — no
         O(L²) score tensor in HBM, fwd and bwd.
-      - "auto":  dense while its O(L²) score temporaries fit comfortably
-        in device memory (dense_attn_fits — a feasibility estimate, NOT a
-        sequence threshold), flash beyond that.  Measured on v5e
-        (BENCH_R4_LOCAL flash_probe): dense is faster across the whole
-        fits-in-HBM band (~25-30% at L=128-2048); flash's win is running
-        at L=8192+ where dense's 38.7 GB of temporaries cannot compile.
+      - "auto":  measured flash-vs-dense choice (choose_attn_impl): dense
+        below the device's recorded crossover sequence length (autotune
+        table, written by the bench flash_probe sweep), flash at/above
+        it, and always flash when dense's O(L²) score temporaries cannot
+        fit (dense_attn_fits stays as the OOM guard).  With no recorded
+        crossover: dense wherever it fits — the measured default on v5e
+        (BENCH_R4/R5 flash_probe: dense ~25-30% faster at L=128-2048;
+        flash's win is running at L=8192+ where dense cannot compile).
     Ring/ulysses/flash require self-attention without an additive bias;
     cross attention and biased attention (T5 relative positions) always
     take the dense path.
@@ -359,17 +415,14 @@ class MultiHeadAttention(nn.Module):
 
         impl = self.attn_impl
         if impl == "auto":
-            # Per-shard feasibility: the mesh divides batch over `data` and
-            # heads over `model`, so the dense-score footprint per device is
-            # the sharded slice, not the global tensor.
-            impl = (
-                "dense"
-                if dense_attn_fits(
-                    q.shape[0], self.n_heads, q.shape[1], k.shape[1],
-                    jnp.dtype(self.dtype).itemsize,
-                    mesh=self.mesh,
-                )
-                else "flash"
+            # Measured crossover (autotune table) over per-shard memory
+            # feasibility: dense below the device's recorded flash-vs-dense
+            # crossover, flash at/above it, and always flash when dense's
+            # per-shard O(L^2) score footprint cannot fit (the OOM guard).
+            impl = choose_attn_impl(
+                q.shape[0], self.n_heads, q.shape[1], k.shape[1],
+                jnp.dtype(self.dtype).itemsize,
+                mesh=self.mesh,
             )
         has_seq_axis = (
             self.mesh is not None and self.mesh.shape.get("seq", 1) > 1

@@ -3,7 +3,10 @@
 XLA fuses most of the framework's elementwise/matmul work on its own; the
 kernels here cover the cases where hand-tiling beats the compiler —
 flash attention keeps the O(L²) score matrix out of HBM entirely by
-accumulating the softmax online in VMEM.
+accumulating the softmax online in VMEM.  Block sizes are not guessed:
+``autotune.py`` sweeps candidate tilings per (shape, dtype, device),
+persists winners in an on-disk + repo-committed table, and records the
+measured flash-vs-dense crossover that ``attn_impl="auto"`` consults.
 """
 
 from tpu_pipelines.ops.flash_attention import flash_attention  # noqa: F401

@@ -314,8 +314,9 @@ def _flash_backward(q, k, v, kv_mask, o, lse, g, *, causal, block_q, block_k,
 
 # ------------------------------------------------------------------ custom_vjp
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, kv_mask, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, kv_mask, causal, block_q, block_k, bwd_block_q,
+           bwd_block_k, interpret):
     out, _ = _flash_forward(
         q, k, v, kv_mask, causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret,
@@ -323,7 +324,8 @@ def _flash(q, k, v, kv_mask, causal, block_q, block_k, interpret):
     return out
 
 
-def _flash_fwd(q, k, v, kv_mask, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, kv_mask, causal, block_q, block_k, bwd_block_q,
+               bwd_block_k, interpret):
     out, lse = _flash_forward(
         q, k, v, kv_mask, causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret,
@@ -331,11 +333,12 @@ def _flash_fwd(q, k, v, kv_mask, causal, block_q, block_k, interpret):
     return out, (q, k, v, kv_mask, out, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
+def _flash_bwd(causal, block_q, block_k, bwd_block_q, bwd_block_k, interpret,
+               residuals, g):
     q, k, v, kv_mask, o, lse = residuals
     dq, dk, dv = _flash_backward(
-        q, k, v, kv_mask, o, lse, g, causal=causal, block_q=block_q,
-        block_k=block_k, interpret=interpret,
+        q, k, v, kv_mask, o, lse, g, causal=causal, block_q=bwd_block_q,
+        block_k=bwd_block_k, interpret=interpret,
     )
     # int mask gets a float0 cotangent (JAX's "no gradient" for int inputs)
     import numpy as np
@@ -354,30 +357,70 @@ def flash_attention(
     *,
     causal: bool = False,
     kv_mask: Optional[jnp.ndarray] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    bwd_block_q: Optional[int] = None,
+    bwd_block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Self-attention over [batch, len, heads, head_dim] via the kernels.
 
     Numerically equals ``dense_attention`` (same masking semantics, modulo
     rows whose whole allowed key set is empty: dense leaves them uniform,
-    flash leaves them zero).  Falls back to dense when the sequence length
-    doesn't tile into (block_q, block_k).  ``interpret=None`` auto-selects
-    the Pallas interpreter off-TPU (CPU tests/dry runs).
+    flash leaves them zero).  ``interpret=None`` auto-selects the Pallas
+    interpreter off-TPU (CPU tests/dry runs).
+
+    Block selection (ops/autotune.py): explicit ``block_q=``/``block_k=``
+    (and ``bwd_block_q=``/``bwd_block_k=`` for the backward kernels, which
+    tune independently) always win; otherwise the autotune table is
+    consulted for this (shape, dtype, causal, device) on first trace, and
+    the hard-coded defaults (128/128) apply on a miss.  ``TPP_AUTOTUNE``
+    controls table behavior — cache-only by default, so jit tracing never
+    times anything inside a trace.
+
+    Every block is validated up front and auto-clamped to the largest
+    L-divisible, TPU-tileable size <= the requested one (the kernels' grid
+    is ``l // block``; an indivisible block used to mis-tile with an
+    opaque Mosaic error).  A clear ``ValueError`` lists the valid choices
+    when nothing <= the request works.
     """
+    from tpu_pipelines.ops import autotune
+
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, l, h, d = q.shape
-    block_q = min(block_q, l)
-    block_k = min(block_k, l)
-    if l % block_q or l % block_k:
-        from tpu_pipelines.parallel.ring_attention import dense_attention
+    itemsize = jnp.dtype(q.dtype).itemsize
+    # Timing inside a jit trace would hang the trace on real device work:
+    # sweeps only ever run from concrete call sites.
+    concrete = not isinstance(q, jax.core.Tracer)
 
-        return dense_attention(q, k, v, causal=causal, kv_mask=kv_mask)
+    def tuned(op):
+        return autotune.get_block_config(
+            op, b, h, l, d, q.dtype, causal,
+            interpret=interpret, allow_sweep=concrete,
+        )
+
+    explicit = block_q is not None or block_k is not None
+    if not explicit:
+        cfg = tuned("flash_fwd")
+        if cfg is not None:
+            block_q, block_k = cfg
+    block_q = autotune.DEFAULT_BLOCK_Q if block_q is None else block_q
+    block_k = autotune.DEFAULT_BLOCK_K if block_k is None else block_k
+    if not explicit and bwd_block_q is None and bwd_block_k is None:
+        cfg = tuned("flash_bwd")
+        if cfg is not None:
+            bwd_block_q, bwd_block_k = cfg
+    bwd_block_q = block_q if bwd_block_q is None else bwd_block_q
+    bwd_block_k = block_k if bwd_block_k is None else bwd_block_k
+
+    block_q = autotune.clamp_block(l, block_q, itemsize, "block_q")
+    block_k = autotune.clamp_block(l, block_k, itemsize, "block_k")
+    bwd_block_q = autotune.clamp_block(l, bwd_block_q, itemsize, "bwd_block_q")
+    bwd_block_k = autotune.clamp_block(l, bwd_block_k, itemsize, "bwd_block_k")
     if kv_mask is None:
         kv_mask = jnp.ones((b, l), jnp.int32)
     return _flash(
         q, k, v, jnp.asarray(kv_mask, jnp.int32), causal, block_q, block_k,
-        interpret,
+        bwd_block_q, bwd_block_k, interpret,
     )
