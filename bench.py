@@ -1300,6 +1300,198 @@ def bench_serving(smoke: bool) -> dict:
     }
 
 
+def bench_serving_fleet(smoke: bool) -> dict:
+    """Serving-fleet leg (ISSUE 10), judged entirely from the fleet's OWN
+    ``/metrics`` scrape, in two passes:
+
+      A. **Steady state**: a sustained multi-thread REST hammer against
+         the 2-replica fleet with SLO-driven batching; the scraped p99
+         must land under the configured SLO target at the measured QPS.
+      B. **Reload under load**: the hammer continues while a freshly
+         pushed version hot-swaps via the ``:reload`` surface (the
+         Pusher push-URL hook's path); the cumulative scrape must record
+         zero 5xx across the whole leg and the swap must complete.
+
+    Judging p99 from pass A keeps the verdict about the SLO batcher, not
+    about CPU contention with the new version's (off-request-path) canary
+    compile on small smoke boxes; pass B's zero-5xx is the drop-free
+    contract the swap actually promises."""
+    import re
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from tpu_pipelines.observability.metrics import histogram_quantile
+    from tpu_pipelines.serving import ModelServer
+    from tpu_pipelines.trainer.export import export_model
+
+    n_threads = 8
+    n_requests = 160 if smoke else 960
+    # The SLO window spends 0.35 x budget - 2 x step (batching.py), so
+    # with the toy model's ~2-5 ms step the gather tops out ~85 ms and
+    # the scraped p99 sits at least one log-bucket under the target.
+    # The target itself budgets for a 1-core CI host (recorded as
+    # host_cpus): 8 hammer threads + 2 batcher workers on one core add
+    # tens of ms of pure scheduling jitter to the tail — on a multi-core
+    # serving host the same leg reads several x lower.
+    slo_p99_ms = 250.0
+    max_queue_depth = 64
+    with tempfile.TemporaryDirectory() as td:
+        module = os.path.join(td, "toy_model.py")
+        with open(module, "w") as f:
+            f.write(
+                "import jax.numpy as jnp\n"
+                "def build_model(hp):\n"
+                "    return None\n"
+                "def apply_fn(model, params, batch):\n"
+                "    return jnp.asarray(batch['x'], jnp.float32) "
+                "@ params['w']\n"
+            )
+        for version in ("1", "2"):
+            export_model(
+                serving_model_dir=os.path.join(td, "m", version),
+                params={"w": np.eye(3, 2).astype(np.float32)
+                        * float(version)},
+                module_file=module,
+            )
+        # v2 stays staged until mid-hammer (the server starts on v1).
+        v2 = os.path.join(td, "m", "2")
+        v2_hidden = os.path.join(td, "v2-staged")
+        os.rename(v2, v2_hidden)
+        server = ModelServer(
+            "fleet", os.path.join(td, "m"),
+            replicas=2, max_versions=2, slo_p99_ms=slo_p99_ms,
+            max_batch_size=8, batch_timeout_s=0.002,
+            max_queue_depth=max_queue_depth,
+        )
+        port = server.start()
+        url = f"http://127.0.0.1:{port}/v1/models/fleet:predict"
+        body = json.dumps({"instances": [{"x": [1.0, 2.0, 3.0]}]}).encode()
+        errors = [0]
+        codes: dict = {}
+        codes_lock = threading.Lock()
+
+        def fire(n: int) -> None:
+            for _ in range(n):
+                code = None
+                try:
+                    req = urllib.request.Request(url, data=body)
+                    with urllib.request.urlopen(req, timeout=30) as r:
+                        r.read()
+                        code = r.status
+                except urllib.error.HTTPError as e:
+                    code = e.code  # shed 429s: counted, not errors
+                except Exception:  # noqa: BLE001 — dropped connection
+                    errors[0] += 1
+                with codes_lock:
+                    codes[code] = codes.get(code, 0) + 1
+
+        def hammer(per_thread: int):
+            threads = [
+                threading.Thread(target=fire, args=(per_thread,))
+                for _ in range(n_threads)
+            ]
+            for t in threads:
+                t.start()
+            return threads
+
+        try:
+            fire(3)  # warm-up: XLA compile + canary-batch capture
+            # Pass A — steady state: p99 at the bench QPS, scraped before
+            # any reload work shares the box.
+            t0 = time.perf_counter()
+            for t in hammer(n_requests // n_threads):
+                t.join()
+            wall = time.perf_counter() - t0
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ) as r:
+                steady_scrape = r.read().decode()
+            # Pass B — reload under load: blessed push lands mid-storm;
+            # the :reload POST is exactly what the Pusher
+            # TPP_SERVING_PUSH_URL hook sends.
+            threads = hammer(max(1, n_requests // (2 * n_threads)))
+            time.sleep(0.1)
+            os.rename(v2_hidden, v2)
+            reload_req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/models/fleet:reload",
+                data=b"{}",
+            )
+            with urllib.request.urlopen(reload_req, timeout=60) as r:
+                reloaded_to = json.loads(r.read())["version"]
+            for t in threads:
+                t.join()
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ) as r:
+                scrape = r.read().decode()
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10
+            ) as r:
+                health = json.loads(r.read())
+        finally:
+            server.stop()
+
+    hist = _parse_prom_histogram(
+        steady_scrape, "serving_request_latency_seconds",
+        'endpoint="predict"'
+    )
+    p99 = None
+    if hist:
+        series = {"buckets": hist["buckets"], "count": hist["count"],
+                  "sum": hist["sum"]}
+        p99 = histogram_quantile(series, 0.99, hist["bounds"])
+    p99_ms = round(p99 * 1e3, 3) if p99 is not None else None
+    served = int(hist["count"]) if hist else 0
+    # Zero-5xx is judged over the WHOLE leg (steady + reload storm).
+    reload_5xx = int(_parse_prom_counter(
+        scrape, "serving_requests_total", 'code="5'
+    ))
+    shed = int(_parse_prom_counter(scrape, "serving_load_shed_total"))
+    per_replica = {}
+    for line in scrape.splitlines():
+        m = re.match(
+            r'serving_replica_requests_total\{replica="(\d+)"\} (\S+)', line
+        )
+        if m:
+            per_replica[m.group(1)] = int(float(m.group(2)))
+    swaps = int(_parse_prom_counter(scrape, "serving_version_swaps_total"))
+    green = bool(
+        errors[0] == 0
+        and reload_5xx == 0
+        and reloaded_to == "2"
+        and bool(health.get("healthy"))
+        and p99_ms is not None and p99_ms < slo_p99_ms
+        and served + shed >= n_requests
+        and swaps >= 2
+    )
+    return {
+        "green": green,
+        "requests": n_requests + n_threads * max(
+            1, n_requests // (2 * n_threads)
+        ) + 3,
+        "request_errors": errors[0],
+        "scraped_requests": served,
+        "qps": round(n_requests / wall, 1) if wall else None,
+        "p99_ms": p99_ms,
+        "slo_p99_ms": slo_p99_ms,
+        "slo_met": bool(p99_ms is not None and p99_ms < slo_p99_ms),
+        "reload_5xx": reload_5xx,
+        "reloaded_to": reloaded_to,
+        "version_swaps": swaps,
+        "shed_requests": shed,
+        "codes": {str(k): v for k, v in sorted(codes.items(),
+                                               key=lambda kv: str(kv[0]))},
+        "replicas": 2,
+        "per_replica_requests": per_replica,
+        "max_queue_depth": max_queue_depth,
+        "concurrency": n_threads,
+        "host_cpus": os.cpu_count(),
+        "healthz": health,
+    }
+
+
 def _trace_regression_report(prev_report, report: dict, smoke: bool) -> dict:
     """Self-report regressions vs the PREVIOUS bench run: diff the taxi
     e2e leg's trace-derived per-node profile against the one the prior
@@ -2443,6 +2635,14 @@ def _compact(report: dict) -> dict:
     if isinstance(sv, dict) and "green" in sv:
         compact["serving_green"] = bool(sv.get("green"))
         compact["serving_p99_ms"] = sv.get("p99_ms")
+    # Serving-fleet headline (ISSUE 10): p99-under-SLO at the bench QPS
+    # and the zero-5xx hot-swap, both off the fleet's own scrape.
+    fl = report.get("serving_fleet")
+    if isinstance(fl, dict) and "green" in fl:
+        compact["fleet_green"] = bool(fl.get("green"))
+        compact["fleet_p99_ms"] = fl.get("p99_ms")
+        compact["fleet_reload_5xx"] = fl.get("reload_5xx")
+        compact["fleet_shed_requests"] = fl.get("shed_requests")
     td = report.get("trace_diff")
     if isinstance(td, dict):
         # Capped: the compact line must stay under the driver-tail budget
@@ -2654,6 +2854,9 @@ def main() -> None:
     # Live serving telemetry: tail latency from the server's own
     # /metrics scrape + /healthz under concurrent load.
     leg("serving", bench_serving, est_cost_s=60, retries=1)
+    # Serving fleet (ISSUE 10): multi-replica + SLO batching + reload-
+    # under-load hammer, judged from the fleet's own scrape.
+    leg("serving_fleet", bench_serving_fleet, est_cost_s=60, retries=1)
     # Wall-clock head of the BASELINE metric: the same taxi DAG sequential
     # vs concurrent, identical-lineage checked (see bench_e2e_taxi_sched).
     e2e_leg("taxi_sched", bench_e2e_taxi_sched, est_cost_s=240)

@@ -129,7 +129,7 @@ def test_tpp101_sink_exempt(tmp_path):
     gen = _gen()
 
     @component(inputs={"examples": "Examples"},
-               outputs={"pushed_model": "PushedModel"}, name="SinkLike",
+               outputs={"report": "ModelEvaluation"}, name="SinkLike",
                is_sink=True)
     def SinkLike(ctx):
         pass
@@ -336,6 +336,119 @@ def test_tpp108_cli_spmd_sync_flag(tmp_path):
     assert gated_run.returncode == 3, gated_run.stdout + gated_run.stderr
     report = json.loads(gated_run.stdout)
     assert "TPP108" in report["rules"]
+
+
+def _pusher_like(model_src, name="Push", infra=None):
+    """A push-to-serving node (outputs a PushedModel) with or without an
+    InfraBlessing wired in — the TPP109 fixture pair."""
+    inputs = {"model": "Model"}
+    if infra is not None:
+        inputs["infra_blessing"] = "InfraBlessing"
+
+    @component(inputs=inputs, optional_inputs=tuple(
+        k for k in inputs if k != "model"
+    ), outputs={"pushed_model": "PushedModel"}, name=name, is_sink=True)
+    def Push(ctx):
+        pass
+
+    kwargs = {"model": model_src.outputs["model"]}
+    if infra is not None:
+        kwargs["infra_blessing"] = infra.outputs["blessing"]
+    return Push(**kwargs)
+
+
+def test_tpp109_pusher_without_infra_validator(tmp_path):
+    @component(outputs={"model": "Model"}, name="Train")
+    def Train(ctx):
+        pass
+
+    train = Train()
+    push = _pusher_like(train)
+    findings = analyze_ir(
+        Compiler().compile(_pipeline([train, push], tmp_path))
+    )
+    f109 = [f for f in findings if f.rule == "TPP109"]
+    assert len(f109) == 1
+    (f,) = f109
+    assert f.node_id == "Push" and f.severity == "warn"
+    assert "InfraValidator" in f.message
+    assert "infra_blessing" in f.fix
+
+    # Suppression drops it (an external canary may gate the push).
+    push.with_lint_suppressions("TPP109")
+    findings = analyze_ir(
+        Compiler().compile(_pipeline([train, push], tmp_path))
+    )
+    assert [f for f in findings if f.rule == "TPP109"] == []
+
+
+def test_tpp109_infra_blessing_wired_is_clean(tmp_path):
+    @component(outputs={"model": "Model"}, name="Train")
+    def Train(ctx):
+        pass
+
+    @component(inputs={"model": "Model"},
+               outputs={"blessing": "InfraBlessing"}, name="Infra",
+               is_sink=True)
+    def Infra(ctx):
+        pass
+
+    train = Train()
+    infra = Infra(model=train.outputs["model"])
+    push = _pusher_like(train, infra=infra)
+    findings = analyze_ir(
+        Compiler().compile(_pipeline([train, infra, push], tmp_path))
+    )
+    assert [f for f in findings if f.rule == "TPP109"] == []
+
+
+def test_tpp109_cli_fail_on_warn(tmp_path):
+    """`tpp lint --fail-on warn` gates (exit 3) on the ungated pusher;
+    the default error gate lets the WARN pass (exit 0)."""
+    module = tmp_path / "push_pipeline.py"
+    module.write_text(textwrap.dedent("""
+        import os
+        from tpu_pipelines.dsl.component import component
+        from tpu_pipelines.dsl.pipeline import Pipeline
+
+        @component(outputs={"model": "Model"}, name="Train")
+        def Train(ctx):
+            pass
+
+        @component(inputs={"model": "Model"},
+                   outputs={"pushed_model": "PushedModel"},
+                   name="Push", is_sink=True)
+        def Push(ctx):
+            pass
+
+        def create_pipeline():
+            home = os.environ.get("TPP_PIPELINE_HOME", "/tmp/x")
+            train = Train()
+            return Pipeline(
+                "push-fixture",
+                [train, Push(model=train.outputs["model"])],
+                pipeline_root=os.path.join(home, "root"),
+                metadata_path=os.path.join(home, "md.sqlite"),
+            )
+    """))
+    env = {**os.environ, "PYTHONPATH": REPO,
+           "TPP_PIPELINE_HOME": str(tmp_path)}
+    warn_only = subprocess.run(
+        [sys.executable, "-m", "tpu_pipelines", "lint",
+         "--pipeline-module", str(module), "--json"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert warn_only.returncode == 0, warn_only.stdout + warn_only.stderr
+    report = json.loads(warn_only.stdout)
+    assert "TPP109" in report["rules"]
+    gated_run = subprocess.run(
+        [sys.executable, "-m", "tpu_pipelines", "lint",
+         "--pipeline-module", str(module), "--fail-on", "warn", "--json"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert gated_run.returncode == 3, gated_run.stdout + gated_run.stderr
+    report = json.loads(gated_run.stdout)
+    assert "TPP109" in report["rules"]
 
 
 # ----------------------------------------------- TPP2xx seeded-bug fixtures

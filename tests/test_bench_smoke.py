@@ -160,6 +160,27 @@ def test_bench_smoke_emits_compact_stdout_and_full_report():
     assert sv["healthz"]["healthy"] is True
     assert compact["serving_green"] is True
     assert compact["serving_p99_ms"] == sv["p99_ms"]
+    # Serving-fleet leg (ISSUE 10): 2-replica fleet with SLO batching
+    # takes a hot-swap mid-hammer — p99 under the SLO target and zero
+    # 5xx, both judged from the fleet's own /metrics scrape; per-replica
+    # router series account for every request.
+    fl = report["serving_fleet"]
+    assert fl["green"] is True, fl
+    assert fl["p99_ms"] is not None and fl["p99_ms"] < fl["slo_p99_ms"]
+    assert fl["slo_met"] is True
+    assert fl["reload_5xx"] == 0
+    assert fl["reloaded_to"] == "2"
+    assert fl["version_swaps"] >= 2
+    assert fl["request_errors"] == 0
+    assert set(fl["per_replica_requests"]) == {"0", "1"}
+    assert sum(fl["per_replica_requests"].values()) >= fl["requests"] - 3
+    assert fl["healthz"]["healthy"] is True
+    assert fl["healthz"]["fleet"]["replicas"] == 2
+    assert fl["healthz"]["fleet"]["active_version"] == "2"
+    assert compact["fleet_green"] is True
+    assert compact["fleet_p99_ms"] == fl["p99_ms"]
+    assert compact["fleet_reload_5xx"] == 0
+    assert compact["fleet_shed_requests"] == fl["shed_requests"]
     # Unified fault-tolerance chaos leg (ISSUE 7): the taxi run completes
     # under the injected schedule with lineage identical to fault-free,
     # exact merged statistics, a quarantined poison shard in the salvage
@@ -282,6 +303,7 @@ def test_bench_budget_skips_but_emits():
     assert report["data_plane"]["skipped_budget"] is True
     assert "data_plane" in compact["skipped"]
     assert "serving" in compact["skipped"]
+    assert "serving_fleet" in compact["skipped"]
     # No taxi leg ran, so the trace-diff self-report degrades to empty
     # flags (never a crash, never a missing key).
     assert compact["regression_flags"] == []

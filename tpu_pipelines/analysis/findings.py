@@ -74,6 +74,12 @@ RULES: Dict[str, Dict[str, str]] = {
                  "runner refuses it at runtime (substrate owns multi-host "
                  "retries)",
     },
+    "TPP109": {
+        "severity": WARN,
+        "title": "Pusher without an InfraValidator upstream: models reach "
+                 "the live serving tier with no canary smoke check before "
+                 "the push",
+    },
     # ---- TPP2xx: executor/AST code rules (code_rules.py) ----
     "TPP201": {
         "severity": WARN,

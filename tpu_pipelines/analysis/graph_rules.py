@@ -302,6 +302,46 @@ def check_retry_policy_under_spmd(ir: PipelineIR) -> List[Finding]:
     return out
 
 
+def check_pusher_without_infra_validator(ir: PipelineIR) -> List[Finding]:
+    """TPP109: a push-to-serving node (outputs a ``PushedModel``) with no
+    InfraValidator feeding it.  The Evaluator blesses model QUALITY; only
+    the InfraValidator canary proves the exported payload actually LOADS
+    and answers the serving request shape — and the serving fleet's
+    hot-swap gate replays that same canary check (docs/SERVING.md), so a
+    pipeline without one pushes versions whose first smoke test happens
+    in production.  Detected structurally: none of the node's inputs
+    resolves to a producer output of type ``InfraBlessing``."""
+    out = []
+    producers = {n.id: n for n in ir.nodes}
+    for node in ir.nodes:
+        if "PushedModel" not in node.outputs.values():
+            continue
+        gated = any(
+            producers.get(ref.producer) is not None
+            and producers[ref.producer].outputs.get(ref.output_key)
+            == "InfraBlessing"
+            for refs in node.inputs.values()
+            for ref in refs
+        )
+        if gated:
+            continue
+        out.append(Finding(
+            rule="TPP109", severity=WARN, node_id=node.id,
+            message=(
+                "pushes a model to serving with no InfraValidator "
+                "upstream: nothing canary-loads the exported payload "
+                "before it lands in the live version directory"
+            ),
+            fix=(
+                "add an InfraValidator over the same model/examples and "
+                "wire its blessing into the pusher "
+                "(infra_blessing=infra.outputs['blessing']), or suppress "
+                "if an external canary gates the push"
+            ),
+        ))
+    return out
+
+
 def _walk_props(obj, prefix=""):
     """Yield (path, value) over nested dict/list exec-property trees."""
     if isinstance(obj, dict):
@@ -327,4 +367,5 @@ GRAPH_RULES = (
     check_missing_producers,
     check_duplicate_node_ids,
     check_retry_policy_under_spmd,
+    check_pusher_without_infra_validator,
 )

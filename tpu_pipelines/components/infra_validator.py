@@ -24,6 +24,25 @@ BLESSING_FILE = "BLESSED"
 NOT_BLESSED_FILE = "NOT_BLESSED"
 
 
+def canary_check(predict, batch) -> str:
+    """One smoke inference; returns an error string ('' = pass).
+
+    THE canary verdict — shared by the InfraValidator executor and the
+    serving fleet's version gate (serving/fleet/versions.py), so "gated by
+    the InfraValidator canary" means literally the same check at push time
+    and at hot-swap time: the prediction count must match the batch, and
+    every prediction must be finite."""
+    try:
+        preds = predict(batch)
+        if len(preds) != len(next(iter(batch.values()))):
+            return f"prediction count {len(preds)} != batch size"
+        if not np.isfinite(np.asarray(preds, dtype=np.float64)).all():
+            return "non-finite predictions"
+    except Exception as e:  # noqa: BLE001 — the canary's job is catching
+        return f"{type(e).__name__}: {e}"
+    return ""
+
+
 def serving_batch_filter(batch, schema, environment):
     """Keep only features the schema expects in ``environment`` (labels drop
     out under "SERVING") — the canary then poses exactly the request
@@ -112,11 +131,9 @@ def InfraValidator(ctx):
             )
             predict = lambda b: np.asarray(raw_fn(b))  # noqa: E731
         try:
-            preds = predict(batch)  # smoke-infer doubles as warmup
-            if len(preds) != len(next(iter(batch.values()))):
-                error = f"prediction count {len(preds)} != batch size"
-            elif not np.isfinite(np.asarray(preds, dtype=np.float64)).all():
-                error = "non-finite predictions"
+            # Smoke-infer doubles as warmup; the verdict logic is shared
+            # with the fleet's hot-swap gate (canary_check).
+            error = canary_check(predict, batch)
             if not error and probes:
                 lat_ms = []
                 for _ in range(probes):

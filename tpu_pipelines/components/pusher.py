@@ -6,15 +6,63 @@ payload into a monotonically-versioned directory under ``push_destination``
 — staged to a temp dir and renamed, so a serving binary watching the
 directory never sees a partial version (the TF Serving version-dir
 convention).
+
+Push-is-deploy (ROADMAP item 4 seam): with ``serving_push_url`` set (or env
+``TPP_SERVING_PUSH_URL``), a successful push also POSTs the serving tier's
+``:reload`` route, so a live ModelServer/fleet hot-swaps to the new version
+immediately instead of waiting out its poll interval.  The notify is
+best-effort by design — the version is already durably on disk and the
+server's file watcher WILL pick it up, so a notify failure (or a fleet
+canary refusing the version: HTTP 409) is recorded on the execution, never
+a push failure.
 """
 
 from __future__ import annotations
 
+import json
+import logging
 import os
 import shutil
 import time
 
 from tpu_pipelines.dsl.component import Parameter, component
+
+log = logging.getLogger("tpu_pipelines.components.pusher")
+
+# "push-URL" env rung: the serving tier's model endpoint, e.g.
+# http://serving:8501/v1/models/taxi — the component parameter wins.
+ENV_PUSH_URL = "TPP_SERVING_PUSH_URL"
+
+
+def notify_serving(push_url: str, timeout: float = 120.0) -> dict:
+    """POST ``<push_url>:reload`` and return the notify verdict dict.
+
+    Returns ``{"notified": bool, "version" | "error": ...}``; transient
+    connection faults retry with backoff (the InfraValidator urlopen
+    policy), an HTTP verdict (including a 409 canary refusal) is final.
+    """
+    import urllib.error
+    import urllib.request
+
+    from tpu_pipelines.components.infra_validator import _urlopen_backoff
+
+    url = push_url.rstrip("/")
+    if not url.endswith(":reload"):
+        url += ":reload"
+    req = urllib.request.Request(url, data=b"{}", method="POST")
+    try:
+        with _urlopen_backoff(req, timeout=timeout) as r:
+            payload = json.load(r)
+        return {"notified": True, "version": payload.get("version")}
+    except urllib.error.HTTPError as e:
+        body = ""
+        try:
+            body = e.read().decode("utf-8", "replace")[:500]
+        except Exception:  # noqa: BLE001
+            pass
+        return {"notified": False, "error": f"HTTP {e.code}: {body}"}
+    except Exception as e:  # noqa: BLE001 — server down/unreachable
+        return {"notified": False, "error": f"{type(e).__name__}: {e}"}
 
 
 @component(
@@ -28,6 +76,8 @@ from tpu_pipelines.dsl.component import Parameter, component
     outputs={"pushed_model": "PushedModel"},
     parameters={
         "push_destination": Parameter(type=str, required=True),
+        # Live-fleet reload hook: "" = env TPP_SERVING_PUSH_URL, else off.
+        "serving_push_url": Parameter(type=str, default=""),
     },
 )
 def Pusher(ctx):
@@ -59,4 +109,25 @@ def Pusher(ctx):
     pushed_art.properties.update(
         {"pushed": True, "pushed_version": version, "pushed_destination": final}
     )
-    return {"pushed": True, "pushed_version": version, "destination": final}
+    result = {"pushed": True, "pushed_version": version, "destination": final}
+
+    push_url = (
+        ctx.exec_properties.get("serving_push_url")
+        or os.environ.get(ENV_PUSH_URL, "")
+    ).strip()
+    if push_url:
+        notify = notify_serving(push_url)
+        if notify["notified"]:
+            result["reload_notified"] = True
+            result["reload_version"] = notify.get("version")
+        else:
+            # Best-effort: the push is durable and the server's poll will
+            # converge on it; surface the miss, don't fail the node.
+            log.warning(
+                "pushed version %s but serving notify to %r failed: %s",
+                version, push_url, notify.get("error"),
+            )
+            result["reload_notified"] = False
+            result["reload_error"] = notify.get("error")
+        pushed_art.properties["reload_notified"] = result["reload_notified"]
+    return result

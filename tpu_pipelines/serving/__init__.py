@@ -11,6 +11,12 @@ TensorFlow Serving (C++ gRPC/REST, versioned model dirs).  Here:
     a PredictionService sharing the same loaded model and micro-batcher.
   - ``tpu_pipelines.serving.saved_model`` — optional jax2tf SavedModel export
     for interop with actual TF Serving deployments.
+  - ``tpu_pipelines.serving.fleet`` — the production tier behind the same
+    surfaces: multi-replica serving with a latency-aware router, N model
+    versions resident with canary-gated atomic hot-swap, and SLO-driven
+    batch deadlines (docs/SERVING.md).  ``ModelServer(replicas=...,
+    max_versions=..., slo_p99_ms=...)`` switches it on.
 """
 
 from tpu_pipelines.serving.server import ModelServer  # noqa: F401
+from tpu_pipelines.serving.fleet import ServingFleet  # noqa: F401

@@ -49,6 +49,23 @@ def main(argv=None) -> int:
                              "Retry-After) predict/generate requests once "
                              "in-flight + queued work reaches this; 0 = "
                              "env TPP_SERVING_MAX_QUEUE, else unbounded")
+    parser.add_argument("--replicas", type=int, default=0,
+                        help="serving-fleet worker replicas behind the "
+                             "latency-aware router (one micro-batcher + "
+                             "model runner each, own device when the host "
+                             "has several); 0 = env TPP_SERVING_REPLICAS, "
+                             "else 1 (single-server mode)")
+    parser.add_argument("--max-versions", type=int, default=0,
+                        help="model versions kept resident for instant "
+                             "hot-swap/rollback (old versions drain, then "
+                             "evict); 0 = env TPP_SERVING_MAX_VERSIONS, "
+                             "else 1")
+    parser.add_argument("--slo-p99-ms", type=float, default=-1.0,
+                        help="p99 latency budget driving the dynamic "
+                             "batch deadline (gather window = budget - "
+                             "2x observed model step time); negative = "
+                             "env TPP_SERVING_SLO_P99_MS, 0 = fixed "
+                             "--batch-timeout-ms window")
     parser.add_argument("--grpc-port", type=int, default=-1,
                         help="also serve gRPC predict on this port "
                              "(0 = ephemeral; -1 = REST only)")
@@ -69,6 +86,9 @@ def main(argv=None) -> int:
                 max_batch_size=args.max_batch_size,
                 batch_timeout_s=args.batch_timeout_ms / 1000.0,
                 max_queue_depth=args.max_queue_depth,
+                replicas=args.replicas,
+                max_versions=args.max_versions,
+                slo_p99_ms=args.slo_p99_ms,
             )
             break
         except FileNotFoundError:

@@ -15,6 +15,25 @@ batcher fixes both:
 Rows are padded with copies of the batch's first row (always a valid feature
 row, unlike zeros which may violate vocab/string constraints) and the pad
 tail is sliced off before replies fan back out.
+
+Two batch-close policies govern how long the worker gathers:
+
+  - **fixed window** (default): gather for ``batch_timeout_s`` — the
+    TF-Serving ``batch_timeout_micros`` knob.
+  - **SLO-driven deadline** (``slo_p99_s > 0``): gather for
+    ``SLO_WINDOW_FRAC x slo_p99_s - 2 x EWMA(model step time)`` — the
+    spendable share of the p99 budget minus the request's own device
+    call plus (worst case) the batch already in flight.  Most of the
+    budget is deliberately held back for everything the step EWMA cannot
+    see: HTTP parse, thread scheduling, GC, and — decisive when p99 is
+    judged from a Prometheus scrape — the log-2 latency buckets, which
+    can make a measured p99 read up to ~2x the true tail.  Spending the
+    whole budget would put measured p99 asymptotically AT the target;
+    the margin keeps it comfortably under.  The window adapts as the
+    observed step time drifts (bigger model, busier device -> shorter
+    gather) and degenerates to immediate dispatch when the steps alone
+    consume the spendable share.  Until the first step has been
+    observed, the fixed window applies.
 """
 
 from __future__ import annotations
@@ -62,11 +81,29 @@ class RequestBatcher:
     """Coalesces concurrent ``submit`` calls into padded device batches.
 
     One daemon worker drains the queue: it blocks for the first pending
-    request, then gathers more for up to ``batch_timeout_s`` (or until
-    ``max_batch_size`` rows), concatenates, pads to a bucket, runs
-    ``predict_fn`` ONCE, and distributes row slices back to each caller's
-    future.  A request bigger than ``max_batch_size`` runs alone, unsplit.
+    request, then gathers more until the group's deadline (the oldest
+    request's enqueue time + the gather window — fixed ``batch_timeout_s``
+    or the SLO-derived window) or until ``max_batch_size`` rows,
+    concatenates, pads to a bucket, runs ``predict_fn`` ONCE, and
+    distributes row slices back to each caller's future.  A request bigger
+    than ``max_batch_size`` runs alone, unsplit.
     """
+
+    # The deadline budgets TWO step times: the request's own device call
+    # plus, worst case, the batch already in flight ahead of it.
+    SLO_STEP_BUDGET = 2.0
+    # Fraction of the p99 budget the gather window may spend; the rest is
+    # safety margin for un-modeled latency (transport, scheduling jitter,
+    # scrape-histogram bucket rounding).  Strictly below 0.5 on purpose:
+    # p99 judged from the log-2-bucketed scrape can read up to ~2x the
+    # true value (it lands at the enclosing bucket's upper bound), so a
+    # window at half the budget would make the MEASURED p99 ride the
+    # target even when the true tail is under it.
+    SLO_WINDOW_FRAC = 0.35
+    # EWMA smoothing for the observed model step time: heavy enough to
+    # ride out one slow batch (GC pause), light enough to track a real
+    # drift (hot-swap to a bigger version) within a few batches.
+    STEP_EWMA_ALPHA = 0.25
 
     def __init__(
         self,
@@ -74,6 +111,7 @@ class RequestBatcher:
         *,
         max_batch_size: int = 64,
         batch_timeout_s: float = 0.005,
+        slo_p99_s: float = 0.0,
         registry=None,
     ):
         if max_batch_size < 1:
@@ -81,6 +119,8 @@ class RequestBatcher:
         self.predict_fn = predict_fn
         self.max_batch_size = max_batch_size
         self.batch_timeout_s = batch_timeout_s
+        self.slo_p99_s = max(0.0, slo_p99_s)
+        self._step_ewma_s: Optional[float] = None
         self.buckets = bucket_sizes(max_batch_size)
         self.batches_run = 0          # observability: device calls issued
         self.requests_served = 0
@@ -98,6 +138,8 @@ class RequestBatcher:
         self._m_batch_size = None
         self._m_batches = None
         self._m_requests = None
+        self._m_deadline = None
+        self._m_step = None
         if registry is not None:
             registry.gauge(
                 "serving_batcher_queue_depth",
@@ -115,8 +157,47 @@ class RequestBatcher:
                 "serving_batched_requests_total",
                 "Requests served through the micro-batcher.",
             )
+            self._m_deadline = registry.gauge(
+                "serving_batch_deadline_seconds",
+                "Effective batch-gather window (SLO-derived when "
+                "slo_p99_s is configured, else the fixed timeout).",
+            )
+            self._m_step = registry.gauge(
+                "serving_model_step_seconds",
+                "EWMA wall time of one coalesced device call.",
+            )
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
+
+    # --------------------------------------------------- SLO batch window
+
+    def gather_window_s(self) -> float:
+        """The wait budget for coalescing the batch that opens NOW.
+
+        SLO mode spends what the spendable half of the p99 budget leaves
+        after reserving ``SLO_STEP_BUDGET`` observed step times;
+        unconfigured (or before the first observed step) it is the fixed
+        ``batch_timeout_s``."""
+        if self.slo_p99_s <= 0 or self._step_ewma_s is None:
+            window = self.batch_timeout_s
+        else:
+            window = max(
+                0.0,
+                self.slo_p99_s * self.SLO_WINDOW_FRAC
+                - self.SLO_STEP_BUDGET * self._step_ewma_s,
+            )
+        if self._m_deadline is not None:
+            self._m_deadline.set(window)
+        return window
+
+    def _observe_step(self, step_s: float) -> None:
+        if self._step_ewma_s is None:
+            self._step_ewma_s = step_s
+        else:
+            a = self.STEP_EWMA_ALPHA
+            self._step_ewma_s = (1 - a) * self._step_ewma_s + a * step_s
+        if self._m_step is not None:
+            self._m_step.set(self._step_ewma_s)
 
     # ------------------------------------------------------------- client
 
@@ -134,7 +215,10 @@ class RequestBatcher:
             # land in a queue nobody services.
             if self._closed:
                 raise RuntimeError("batcher is closed")
-            self._queue.put((batch, n_rows, fut))
+            # The enqueue instant anchors the gather deadline: a request
+            # that waited out the PREVIOUS group's gather must not pay a
+            # second full window.
+            self._queue.put((batch, n_rows, fut, time.monotonic()))
         return fut.result(timeout=timeout_s)
 
     def close(self, timeout_s: float = 5.0) -> None:
@@ -146,12 +230,30 @@ class RequestBatcher:
         worker does not come back within ``timeout_s`` (predict_fn
         wedged), the in-flight group's futures are failed too, so
         blocked callers return immediately instead of waiting out their
-        own submit timeout."""
+        own submit timeout.
+
+        Fleet note: ``close`` joins THIS batcher's worker for up to
+        ``timeout_s``, so closing N replica batchers serially would cost
+        up to N x timeout.  ``ReplicaPool.close`` instead calls
+        :meth:`request_close` on every batcher first (all workers drain
+        concurrently) and then :meth:`join_close` against one shared
+        deadline — the two halves this method simply runs back to back.
+        """
+        self.request_close()
+        self.join_close(timeout_s)
+
+    def request_close(self) -> None:
+        """Phase 1 (non-blocking): reject new submits and sentinel the
+        worker so it starts draining.  Idempotent."""
         with self._close_lock:
             if self._closed:
                 return
             self._closed = True
             self._queue.put(None)  # wake the worker
+
+    def join_close(self, timeout_s: float = 5.0) -> None:
+        """Phase 2: wait for the drain started by :meth:`request_close`;
+        past the deadline, fail the wedged in-flight futures."""
         self._worker.join(timeout=timeout_s)
         if self._worker.is_alive():
             # Wedged device call: its group's futures would otherwise
@@ -190,8 +292,14 @@ class RequestBatcher:
             group = [item]
             rows = item[1]
             sig = self._signature(item[0])
-            # Gather more requests within the timeout window / size budget.
-            t_end = time.monotonic() + self.batch_timeout_s
+            # Gather more requests within the window / size budget.  The
+            # window is fixed (batch_timeout_s) or SLO-derived — computed
+            # per group so it tracks the step-time EWMA as it drifts — and
+            # anchored at the OLDEST request's enqueue instant, so time a
+            # request already spent queued behind the previous group
+            # counts against its window (per-request wait stays bounded
+            # by ~one window, not one per preceding group).
+            t_end = item[3] + self.gather_window_s()
             while rows < self.max_batch_size:
                 remaining = t_end - time.monotonic()
                 if remaining <= 0:
@@ -223,13 +331,15 @@ class RequestBatcher:
     def _predict_group(self, group) -> None:
         merged = {
             k: np.concatenate(
-                [np.asarray(b[k])[:n] for b, n, _ in group], axis=0
+                [np.asarray(b[k])[:n] for b, n, *_ in group], axis=0
             )
             for k in group[0][0]
         }
-        total = sum(n for _, n, _ in group)
+        total = sum(n for _, n, *_ in group)
         padded = pad_to_bucket(merged, total, self.buckets)
+        t0 = time.monotonic()
         preds = np.asarray(self.predict_fn(padded))[:total]
+        self._observe_step(time.monotonic() - t0)
         self.batches_run += 1
         self.requests_served += len(group)
         if self._m_batches is not None:
@@ -237,7 +347,7 @@ class RequestBatcher:
             self._m_requests.inc(len(group))
             self._m_batch_size.set(total)
         offset = 0
-        for _, n, fut in group:
+        for _, n, fut, *_ in group:
             if not fut.done():  # close() may have failed a wedged group
                 try:
                     fut.set_result(preds[offset:offset + n])

@@ -1,0 +1,39 @@
+"""Serving fleet: multi-replica, multi-version production serving tier.
+
+The single :class:`~tpu_pipelines.serving.server.ModelServer` is one model,
+one replica, one device, with a fixed micro-batch window.  This package is
+the "millions of users" layer on top of the same payloads and the same
+request surfaces (docs/SERVING.md):
+
+  - :class:`~tpu_pipelines.serving.fleet.versions.ModelVersionManager` —
+    N model versions resident at once, atomic blessed-push hot-swap
+    (load-outside-lock, swap-under-lock, old version drained then evicted;
+    zero dropped requests), gated by the InfraValidator-style canary check
+    before a new version becomes eligible.
+  - :class:`~tpu_pipelines.serving.fleet.replica.Replica` — one micro-
+    batcher + model runner per replica, optionally pinned to its own
+    device, with per-replica queue-depth and EWMA-p99 telemetry
+    (``serving_replica_*`` gauges).
+  - :class:`~tpu_pipelines.serving.fleet.router.LatencyAwareRouter` —
+    picks the replica with the least estimated work (observed queue depth
+    x EWMA p99), so a slow or busy replica sheds traffic to its peers.
+  - :class:`~tpu_pipelines.serving.fleet.pool.ReplicaPool` — the replicas
+    behind the router; ``close(timeout_s=)`` drains every replica batcher
+    IN PARALLEL so fleet shutdown stays bounded by one timeout, not N.
+  - :class:`~tpu_pipelines.serving.fleet.fleet.ServingFleet` — the facade
+    ``ModelServer`` front-ends route through (``replicas=``/
+    ``max_versions=`` knobs; REST/gRPC surfaces unchanged).
+
+SLO-driven batch deadlines (``slo_p99_ms``) live in
+serving/batching.py — every replica batcher computes its gather window
+from the p99 budget minus the observed model step time.
+"""
+
+from tpu_pipelines.serving.fleet.fleet import ServingFleet  # noqa: F401
+from tpu_pipelines.serving.fleet.pool import ReplicaPool  # noqa: F401
+from tpu_pipelines.serving.fleet.replica import Replica  # noqa: F401
+from tpu_pipelines.serving.fleet.router import LatencyAwareRouter  # noqa: F401
+from tpu_pipelines.serving.fleet.versions import (  # noqa: F401
+    CanaryRefused,
+    ModelVersionManager,
+)

@@ -1,0 +1,194 @@
+"""ServingFleet: the facade ModelServer front-ends route through.
+
+One fleet = one model name served by ``replicas`` workers (each its own
+micro-batcher, optionally its own device) over a shared
+:class:`ModelVersionManager`.  The REST/gRPC surfaces stay on
+``ModelServer``; in fleet mode its ``predict_batch``/``reload`` simply
+delegate here, so canaries, tests, and the bench hammer exercise the
+identical request path single-server deployments use.
+
+Canary gating: the fleet remembers the first feature batch it serves and
+replays it against every subsequently pushed version via the SAME check
+InfraValidator runs (``canary_check``: prediction count + finiteness)
+BEFORE the version becomes eligible — a bad push is refused
+(:class:`CanaryRefused`) while the prior version keeps serving.  Callers
+with a better batch (e.g. a schema-filtered serving request) can install
+it with :meth:`set_canary_batch`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from tpu_pipelines.serving.fleet.pool import ReplicaPool
+from tpu_pipelines.serving.fleet.replica import Replica
+from tpu_pipelines.serving.fleet.versions import ModelVersionManager
+
+
+def _local_devices() -> List[Any]:
+    """Accelerators to pin replicas to; [] means run on the default."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+        return list(devices) if len(devices) > 1 else []
+    except Exception:  # noqa: BLE001 — no jax / no backend: default device
+        return []
+
+
+class ServingFleet:
+    def __init__(
+        self,
+        model_name: str,
+        base_dir: str,
+        *,
+        replicas: int = 2,
+        raw: bool = True,
+        max_batch_size: int = 64,
+        batch_timeout_s: float = 0.005,
+        slo_p99_s: float = 0.0,
+        max_versions: int = 2,
+        registry=None,
+        loader: Optional[Callable[[str], Any]] = None,
+    ):
+        self.model_name = model_name
+        self.base_dir = base_dir
+        self.raw = raw
+        self.slo_p99_s = slo_p99_s
+        self._max_batch_size = max_batch_size
+        self._canary_batch: Optional[Dict[str, Any]] = None
+        self._canary_lock = threading.Lock()
+        self.versions = ModelVersionManager(
+            model_name,
+            max_versions=max_versions,
+            loader=loader,
+            canary_fn=self._canary,
+            registry=registry,
+        )
+        devices = _local_devices()
+        n = max(1, int(replicas))
+        self.pool = ReplicaPool([
+            Replica(
+                i,
+                self._leased_predict,
+                max_batch_size=max_batch_size,
+                batch_timeout_s=batch_timeout_s,
+                slo_p99_s=slo_p99_s,
+                device=devices[i % len(devices)] if devices else None,
+                registry=registry,
+            )
+            for i in range(n)
+        ])
+
+    # ------------------------------------------------------------- predict
+
+    def _predict_callable(self, loaded):
+        return loaded.predict if self.raw else loaded.predict_transformed
+
+    def _leased_predict(self, batch: Dict[str, Any]) -> np.ndarray:
+        """Every device call runs under a version lease: a hot-swap during
+        the call cannot evict the version mid-predict, and the drain the
+        swap contract promises is the lease count hitting zero."""
+        with self.versions.lease() as (_, loaded):
+            return np.asarray(self._predict_callable(loaded)(batch))
+
+    def submit(
+        self, batch: Dict[str, Any], n_rows: int, timeout_s: float = 300.0
+    ) -> np.ndarray:
+        if self._canary_batch is None:
+            with self._canary_lock:
+                if self._canary_batch is None:
+                    # First served request becomes the canary probe for
+                    # future pushes: by construction it is a batch the
+                    # ACTIVE version answers, i.e. the live request shape.
+                    self._canary_batch = {
+                        k: np.asarray(v) for k, v in batch.items()
+                    }
+        return self.pool.submit(batch, n_rows, timeout_s=timeout_s)
+
+    # -------------------------------------------------------------- canary
+
+    def set_canary_batch(self, batch: Optional[Dict[str, Any]]) -> None:
+        with self._canary_lock:
+            self._canary_batch = (
+                None if batch is None
+                else {k: np.asarray(v) for k, v in batch.items()}
+            )
+
+    def _canary(self, loaded, version: str) -> str:
+        from tpu_pipelines.components.infra_validator import canary_check
+
+        with self._canary_lock:
+            batch = self._canary_batch
+        if batch is None:
+            return ""  # nothing served yet: a loadable payload is eligible
+        error = canary_check(self._predict_callable(loaded), batch)
+        if error:
+            return error
+        return self._warm_buckets(loaded, batch)
+
+    def _warm_buckets(self, loaded, batch: Dict[str, Any]) -> str:
+        """Pre-compile the padded bucket shapes the replica batchers will
+        pose, BEFORE the swap: without this, the new version's first
+        batches pay their XLA compiles mid-traffic and the latency spike
+        lands inside the SLO window.  Runs outside every serving lock
+        (part of load-outside-lock); a shape the version cannot answer is
+        a gate failure — it WOULD fail in production."""
+        from tpu_pipelines.serving.batching import bucket_sizes
+
+        fn = self._predict_callable(loaded)
+        row = {k: np.asarray(v)[:1] for k, v in batch.items()}
+        try:
+            for bucket in bucket_sizes(self._max_batch_size):
+                fn({
+                    k: np.repeat(v, bucket, axis=0) for k, v in row.items()
+                })
+        except Exception as e:  # noqa: BLE001 — same verdict as the canary
+            return f"bucket warmup failed: {type(e).__name__}: {e}"
+        return ""
+
+    # ----------------------------------------------------------- lifecycle
+
+    def load_version(self, version_dir: str) -> str:
+        return self.versions.load_version(version_dir)
+
+    def reload(self) -> str:
+        """Load-and-activate the newest version under ``base_dir``."""
+        from tpu_pipelines.serving.server import latest_version_dir
+
+        vdir = latest_version_dir(self.base_dir)
+        if vdir is None:
+            raise FileNotFoundError(
+                f"no model versions under {self.base_dir!r}"
+            )
+        return self.load_version(vdir)
+
+    @property
+    def active_version(self) -> Optional[str]:
+        return self.versions.active_version
+
+    def active_loaded(self):
+        return self.versions.active_loaded()
+
+    def queue_depth(self) -> int:
+        return self.pool.queue_depth()
+
+    @property
+    def closed(self) -> bool:
+        return self.pool.closed
+
+    def health(self) -> Dict[str, Any]:
+        return {
+            "replicas": len(self.pool),
+            "versions_resident": self.versions.resident_versions(),
+            "active_version": self.active_version,
+            "slo_p99_ms": (
+                round(self.slo_p99_s * 1e3, 3) if self.slo_p99_s else None
+            ),
+        }
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        self.pool.close(timeout_s=timeout_s)

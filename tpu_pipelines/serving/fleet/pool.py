@@ -1,0 +1,59 @@
+"""ReplicaPool: the replica set behind the router, with bounded shutdown.
+
+``close(timeout_s=)`` is the satellite fix for the fixed-window
+RequestBatcher contract: the single-batcher ``close`` joins ITS worker
+for up to ``timeout_s``, so closing N replicas serially could take
+N x timeout against a fleet of wedged device calls.  The pool instead
+broadcasts the close sentinel to every batcher first (all workers start
+draining concurrently) and then joins them against ONE shared absolute
+deadline — fleet shutdown is bounded by ``timeout_s`` total, not per
+replica.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+from tpu_pipelines.serving.fleet.replica import Replica
+from tpu_pipelines.serving.fleet.router import LatencyAwareRouter
+
+
+class ReplicaPool:
+    def __init__(self, replicas: List[Replica], router=None):
+        if not replicas:
+            raise ValueError("ReplicaPool needs at least one replica")
+        self.replicas = list(replicas)
+        self.router = router or LatencyAwareRouter()
+        self._closed = False
+
+    def __len__(self) -> int:
+        return len(self.replicas)
+
+    def queue_depth(self) -> int:
+        """Fleet-wide queued + in-flight work (admission control input)."""
+        return sum(r.queue_depth() for r in self.replicas)
+
+    def submit(
+        self, batch: Dict[str, Any], n_rows: int, timeout_s: float = 300.0
+    ) -> np.ndarray:
+        replica = self.router.pick(self.replicas)
+        return replica.submit(batch, n_rows, timeout_s=timeout_s)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Parallel drain: sentinel every batcher, then join all against a
+        shared deadline.  Every queued request is served or failed; a
+        wedged replica's in-flight futures are failed at the deadline so
+        callers unblock (RequestBatcher.join_close semantics)."""
+        self._closed = True
+        for r in self.replicas:
+            r.batcher.request_close()
+        deadline = time.monotonic() + timeout_s
+        for r in self.replicas:
+            r.batcher.join_close(max(0.0, deadline - time.monotonic()))

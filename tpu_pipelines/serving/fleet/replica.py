@@ -1,0 +1,191 @@
+"""Replica: one micro-batcher + model runner with its own telemetry.
+
+Each replica owns a private :class:`RequestBatcher` (its queue IS the
+per-replica queue the router inspects) and, when the host exposes more
+than one accelerator, can be pinned to its own device so N replicas feed
+N chips from one server process.  The replica publishes the
+``serving_replica_*`` family the router and operators read:
+
+  ==============================================  =========================
+  serving_replica_queue_depth{replica}            requests queued+in-flight
+  serving_replica_p99_seconds{replica}            EWMA p99 request latency
+  serving_replica_ewma_latency_seconds{replica}   EWMA mean request latency
+  serving_replica_requests_total{replica}         requests routed here
+  serving_replica_batch_deadline_seconds{replica} effective gather window
+  serving_replica_step_seconds{replica}           EWMA device-call wall
+  ==============================================  =========================
+
+(The last two mirror the single-server batcher's unlabeled
+``serving_batch_deadline_seconds``/``serving_model_step_seconds`` — per
+replica, because each batcher observes its own device's step time.)
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from tpu_pipelines.serving.batching import RequestBatcher
+
+# Routing cost for a replica nothing has been observed on yet: small but
+# non-zero, so fresh replicas attract traffic without dividing by zero.
+DEFAULT_LATENCY_S = 1e-3
+
+
+class LatencyTracker:
+    """Sliding-window p99 + EWMA smoothing over observed request latencies.
+
+    The window (last ``window`` requests) makes p99 a real order statistic
+    over recent traffic; the EWMA keeps the routed-on estimate from
+    whiplashing on a single outlier while still converging within ~1/alpha
+    observations when a replica genuinely degrades."""
+
+    def __init__(self, alpha: float = 0.2, window: int = 128):
+        self.alpha = alpha
+        self._samples: collections.deque = collections.deque(maxlen=window)
+        self._lock = threading.Lock()
+        self.ewma_mean_s = 0.0
+        self.ewma_p99_s = 0.0
+        self.count = 0
+
+    def observe(self, latency_s: float) -> None:
+        with self._lock:
+            self._samples.append(float(latency_s))
+            p99 = float(np.percentile(self._samples, 99))
+            mean = float(np.mean(self._samples))
+            if self.count == 0:
+                self.ewma_p99_s = p99
+                self.ewma_mean_s = mean
+            else:
+                a = self.alpha
+                self.ewma_p99_s = (1 - a) * self.ewma_p99_s + a * p99
+                self.ewma_mean_s = (1 - a) * self.ewma_mean_s + a * mean
+            self.count += 1
+
+
+class Replica:
+    """One worker: batcher + runner + latency telemetry.
+
+    ``predict_fn`` resolves the model at call time (the version manager's
+    lease), so hot-swaps apply to queued work without touching the
+    replica.  ``device`` (a ``jax.Device``) pins this replica's dispatch;
+    None runs on the process default — on a single-device host every
+    replica still wins by splitting queue wait across batchers."""
+
+    def __init__(
+        self,
+        index: int,
+        predict_fn: Callable[[Dict[str, Any]], np.ndarray],
+        *,
+        max_batch_size: int = 64,
+        batch_timeout_s: float = 0.005,
+        slo_p99_s: float = 0.0,
+        device: Any = None,
+        registry=None,
+    ):
+        self.index = index
+        self.name = str(index)
+        self.device = device
+        self.latency = LatencyTracker()
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        if device is not None:
+            inner = predict_fn
+
+            def predict_fn(batch, _inner=inner, _dev=device):
+                import jax
+
+                with jax.default_device(_dev):
+                    return _inner(batch)
+
+        self.batcher = RequestBatcher(
+            predict_fn,
+            max_batch_size=max_batch_size,
+            batch_timeout_s=batch_timeout_s,
+            slo_p99_s=slo_p99_s,
+            registry=None,  # per-replica series below; shared batcher
+            #               gauges would collide across replicas
+        )
+        self._m_depth = self._m_p99 = self._m_ewma = self._m_requests = None
+        self._m_deadline = self._m_step = None
+        if registry is not None:
+            self._m_depth = registry.gauge(
+                "serving_replica_queue_depth",
+                "Requests queued or in flight on this replica.",
+                labels=("replica",),
+            ).labels(self.name)
+            self._m_p99 = registry.gauge(
+                "serving_replica_p99_seconds",
+                "EWMA p99 request latency observed on this replica.",
+                labels=("replica",),
+            ).labels(self.name)
+            self._m_ewma = registry.gauge(
+                "serving_replica_ewma_latency_seconds",
+                "EWMA mean request latency observed on this replica.",
+                labels=("replica",),
+            ).labels(self.name)
+            self._m_requests = registry.counter(
+                "serving_replica_requests_total",
+                "Requests the router assigned to this replica.",
+                labels=("replica",),
+            ).labels(self.name)
+            self._m_deadline = registry.gauge(
+                "serving_replica_batch_deadline_seconds",
+                "Effective batch-gather window on this replica "
+                "(SLO-derived when slo_p99_ms is configured).",
+                labels=("replica",),
+            ).labels(self.name)
+            self._m_step = registry.gauge(
+                "serving_replica_step_seconds",
+                "EWMA wall time of one coalesced device call on this "
+                "replica.",
+                labels=("replica",),
+            ).labels(self.name)
+
+    # ------------------------------------------------------------- routing
+
+    def queue_depth(self) -> int:
+        """Queued + in-flight work: the router's load signal."""
+        with self._inflight_lock:
+            inflight = self._inflight
+        return self.batcher._queue.qsize() + inflight
+
+    def ewma_p99_s(self) -> float:
+        return self.latency.ewma_p99_s or DEFAULT_LATENCY_S
+
+    def routing_cost(self) -> float:
+        """Estimated wait for one MORE request routed here: every request
+        already queued (plus this one) pays ~the replica's observed
+        latency.  Queue depth carries the instantaneous load, EWMA p99 the
+        replica's demonstrated speed — a slow replica's cost rises even at
+        equal depth, so the router redirects before its queue grows."""
+        return (self.queue_depth() + 1) * self.ewma_p99_s()
+
+    # ------------------------------------------------------------- serving
+
+    def submit(self, batch, n_rows: int, timeout_s: float = 300.0):
+        import time
+
+        with self._inflight_lock:
+            self._inflight += 1
+        if self._m_requests is not None:
+            self._m_requests.inc()
+        if self._m_depth is not None:
+            self._m_depth.set(self.queue_depth())
+        t0 = time.perf_counter()
+        try:
+            return self.batcher.submit(batch, n_rows, timeout_s=timeout_s)
+        finally:
+            dt = time.perf_counter() - t0
+            with self._inflight_lock:
+                self._inflight -= 1
+            self.latency.observe(dt)
+            if self._m_p99 is not None:
+                self._m_p99.set(self.latency.ewma_p99_s)
+                self._m_ewma.set(self.latency.ewma_mean_s)
+                self._m_depth.set(self.queue_depth())
+                self._m_deadline.set(self.batcher.gather_window_s())
+                self._m_step.set(self.batcher._step_ewma_s or 0.0)

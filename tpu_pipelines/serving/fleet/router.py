@@ -1,0 +1,47 @@
+"""Latency-aware replica selection.
+
+Round-robin treats a wedged replica like a healthy one until its queue is
+already deep; classic least-connections ignores that replicas can have
+genuinely different speeds (per-device thermal throttling, a replica
+pinned to a busier chip, a version mid-warmup).  This router scores each
+replica by *estimated wait* — observed queue depth x EWMA p99 latency
+(:meth:`Replica.routing_cost`) — and sends the request to the cheapest
+one, with a rotating tie-break so equal replicas share load evenly
+instead of herding onto index 0.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Sequence
+
+from tpu_pipelines.serving.fleet.replica import Replica
+
+
+class LatencyAwareRouter:
+    """Pick-min-cost over the replica set; thread-safe, stateless apart
+    from the tie-break rotation counter."""
+
+    def __init__(self):
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    def pick(self, replicas: Sequence[Replica]) -> Replica:
+        if not replicas:
+            raise RuntimeError("replica pool is empty")
+        if len(replicas) == 1:
+            return replicas[0]
+        with self._lock:
+            start = self._rr % len(replicas)
+            self._rr += 1
+        best = None
+        best_cost = float("inf")
+        # Rotate the scan start so exact-tie costs (cold start, idle
+        # fleet) spread round-robin rather than always landing on the
+        # lowest index.
+        for off in range(len(replicas)):
+            r = replicas[(start + off) % len(replicas)]
+            cost = r.routing_cost()
+            if cost < best_cost:
+                best, best_cost = r, cost
+        return best

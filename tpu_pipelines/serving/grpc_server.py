@@ -7,6 +7,7 @@ the gRPC half.  One ``GrpcPredictionService`` wraps an existing
     /tpu_pipelines.serving.PredictionService/Predict
     /tpu_pipelines.serving.PredictionService/Generate
     /tpu_pipelines.serving.PredictionService/GetModelStatus
+    /tpu_pipelines.serving.PredictionService/Reload
 
 Requests route through ``ModelServer``'s predict path, so micro-batching
 (``batching=True``) coalesces concurrent gRPC and REST callers into the
@@ -171,6 +172,33 @@ class GrpcPredictionService:
             version=self._server.version or "", state="AVAILABLE"
         )
 
+    def Reload(self, request: "pb.ModelStatusRequest", context):
+        """Rescan the version dir and hot-swap to the newest version — the
+        gRPC twin of REST ``:reload`` (Pusher push-URL hook, ops tooling).
+        A canary-refused push maps to FAILED_PRECONDITION: the server is
+        healthy, the pushed payload is not."""
+        import grpc
+
+        from tpu_pipelines.serving.fleet.versions import CanaryRefused
+
+        if request.model_name and request.model_name != self._server.model_name:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"unknown model {request.model_name!r}",
+            )
+        try:
+            version = self._server.reload()
+        except CanaryRefused as e:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"{type(e).__name__}: {e}",
+            )
+        except Exception as e:  # noqa: BLE001 — reload fault is server-side
+            context.abort(
+                grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}"
+            )
+        return pb.ModelStatusResponse(version=version, state="AVAILABLE")
+
 
 def _method_handlers(service: GrpcPredictionService):
     import grpc
@@ -188,6 +216,11 @@ def _method_handlers(service: GrpcPredictionService):
         ),
         "GetModelStatus": grpc.unary_unary_rpc_method_handler(
             service.GetModelStatus,
+            request_deserializer=pb.ModelStatusRequest.FromString,
+            response_serializer=pb.ModelStatusResponse.SerializeToString,
+        ),
+        "Reload": grpc.unary_unary_rpc_method_handler(
+            service.Reload,
             request_deserializer=pb.ModelStatusRequest.FromString,
             response_serializer=pb.ModelStatusResponse.SerializeToString,
         ),
@@ -246,6 +279,11 @@ class PredictionClient:
             request_serializer=pb.PredictRequest.SerializeToString,
             response_deserializer=pb.PredictResponse.FromString,
         )
+        self._reload = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/Reload",
+            request_serializer=pb.ModelStatusRequest.SerializeToString,
+            response_deserializer=pb.ModelStatusResponse.FromString,
+        )
 
     def predict(
         self, model_name: str, batch: Dict[str, Any], timeout: float = 30.0
@@ -264,6 +302,17 @@ class PredictionClient:
             req.inputs[k].CopyFrom(array_to_tensor(np.asarray(v)))
         resp = self._generate(req, timeout=timeout)
         return tensor_to_array(resp.predictions), resp.model_version
+
+    def reload(
+        self, model_name: str, timeout: float = 120.0
+    ) -> Dict[str, str]:
+        """Trigger a version rescan + hot-swap; returns the now-active
+        version.  Generous default timeout: the server loads (and canary-
+        smokes) the new payload before answering."""
+        resp = self._reload(
+            pb.ModelStatusRequest(model_name=model_name), timeout=timeout
+        )
+        return {"version": resp.version, "state": resp.state}
 
     def model_status(
         self, model_name: str, timeout: float = 10.0

@@ -12,12 +12,21 @@ jitted function — trainer/export.py), and answers TF-Serving-style REST:
          body: {"instances": [{feature: value, ...}, ...]}
          or    {"inputs": {feature: [values...], ...}}
 
+    POST /v1/models/<name>:reload     -> {"version": "..."} (rescan +
+         hot-swap to the newest pushed version; the Pusher push-URL hook
+         and ops tooling call this instead of waiting for the poll)
+
 Implementation is stdlib ``ThreadingHTTPServer``; concurrent requests are
 safe (jax dispatch is thread-safe) and, with ``batching=True``, coalesce
 through a micro-batcher into padded fixed-bucket device calls
 (serving/batching.py) — the BatchingSession equivalent.  This server exists
-for InfraValidator canaries, e2e tests, and small deployments.  High-QPS
-serving exports a SavedModel (serving/saved_model.py) into TF Serving.
+for InfraValidator canaries, e2e tests, and small deployments.  For
+high-QPS serving, ``replicas``/``max_versions``/``slo_p99_ms`` switch the
+SAME surfaces onto the serving fleet (serving/fleet/, docs/SERVING.md):
+N replica workers behind a latency-aware router, N model versions
+resident with canary-gated atomic hot-swap, and SLO-driven batch
+deadlines.  SavedModel export into TF Serving (serving/saved_model.py)
+remains the interop escape hatch.
 """
 
 from __future__ import annotations
@@ -44,6 +53,19 @@ log = logging.getLogger("tpu_pipelines.serving")
 # Admission-control bound fallback when the constructor leaves it 0
 # (deployment knob for `python -m tpu_pipelines.serving`).
 ENV_MAX_QUEUE = "TPP_SERVING_MAX_QUEUE"
+# Fleet knobs, same constructor-0-falls-back-to-env convention: replica
+# worker count, versions kept resident for instant rollback, and the p99
+# budget (ms) the SLO-driven batch deadline spends (0 = fixed window).
+ENV_REPLICAS = "TPP_SERVING_REPLICAS"
+ENV_MAX_VERSIONS = "TPP_SERVING_MAX_VERSIONS"
+ENV_SLO_P99_MS = "TPP_SERVING_SLO_P99_MS"
+
+
+def _env_number(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "").strip() or default)
+    except ValueError:
+        return default
 
 
 class GenerateUnsupported(ValueError):
@@ -93,10 +115,25 @@ class ModelServer:
         batch_timeout_s: float = 0.005,
         metrics_registry: Optional[MetricsRegistry] = None,
         max_queue_depth: int = 0,
+        replicas: int = 0,
+        max_versions: int = 0,
+        slo_p99_ms: float = -1.0,
     ):
         self.model_name = model_name
         self.base_dir = base_dir
         self.raw = raw
+        # Fleet knobs: constructor wins, 0/-1 falls back to env, then to
+        # the single-server defaults (1 replica, 1 resident version,
+        # fixed batch window).
+        if replicas <= 0:
+            replicas = int(_env_number(ENV_REPLICAS, 1))
+        if max_versions <= 0:
+            max_versions = int(_env_number(ENV_MAX_VERSIONS, 1))
+        if slo_p99_ms < 0:
+            slo_p99_ms = _env_number(ENV_SLO_P99_MS, 0.0)
+        self.replicas = max(1, replicas)
+        self.max_versions = max(1, max_versions)
+        self.slo_p99_ms = max(0.0, slo_p99_ms)
         self._lock = threading.Lock()
         # Serializes reload(): concurrent version swaps would race the
         # load-outside-lock / swap-under-lock dance.  Never held while
@@ -160,14 +197,33 @@ class ModelServer:
         # Micro-batching (serving/batching.py): coalesce concurrent requests
         # into padded fixed-bucket device calls.  The batcher resolves the
         # current model at call time, so hot-swaps apply to queued requests.
+        # Fleet mode (replicas/max_versions > 1) moves batching into the
+        # per-replica workers behind the latency-aware router; the REST/
+        # gRPC surfaces, admission control, and /metrics stay right here.
         self._batcher = None
-        if batching:
+        self._fleet = None
+        if self.replicas > 1 or self.max_versions > 1:
+            from tpu_pipelines.serving.fleet import ServingFleet
+
+            self._fleet = ServingFleet(
+                model_name,
+                base_dir,
+                replicas=self.replicas,
+                raw=raw,
+                max_batch_size=max_batch_size,
+                batch_timeout_s=batch_timeout_s,
+                slo_p99_s=self.slo_p99_ms / 1e3,
+                max_versions=self.max_versions,
+                registry=self.metrics,
+            )
+        elif batching:
             from tpu_pipelines.serving.batching import RequestBatcher
 
             self._batcher = RequestBatcher(
                 lambda b: np.asarray(self._predict_fn()(b)),
                 max_batch_size=max_batch_size,
                 batch_timeout_s=batch_timeout_s,
+                slo_p99_s=self.slo_p99_ms / 1e3,
                 registry=self.metrics,
             )
         self.reload()
@@ -200,6 +256,16 @@ class ModelServer:
                         f"no model versions under {self.base_dir!r}"
                     )
             version = os.path.basename(vdir.rstrip("/"))
+            if self._fleet is not None:
+                # Fleet path: the version manager owns load-outside-lock,
+                # the canary gate, swap, drain and eviction; it also
+                # maintains serving_model_info.  A CanaryRefused
+                # propagates — the prior version keeps serving.
+                if version == self._fleet.active_version:
+                    return version
+                self._fleet.load_version(vdir)
+                self._m_reloads.inc()
+                return version
             if version == self._loaded_version:
                 return version
             loaded = load_exported_model(vdir)
@@ -227,7 +293,9 @@ class ModelServer:
         with self._inflight_lock:
             if self.max_queue_depth > 0:
                 depth = self._inflight
-                if self._batcher is not None:
+                if self._fleet is not None:
+                    depth += self._fleet.queue_depth()
+                elif self._batcher is not None:
                     depth += self._batcher._queue.qsize()
                 if depth >= self.max_queue_depth:
                     self._m_shed.labels(endpoint).inc()
@@ -243,13 +311,20 @@ class ModelServer:
 
     @property
     def version(self) -> Optional[str]:
+        if self._fleet is not None:
+            return self._fleet.active_version
         return self._loaded_version
 
     # ------------------------------------------------------------- predict
 
-    def _predict_fn(self):
+    def _current_loaded(self):
+        if self._fleet is not None:
+            return self._fleet.active_loaded()
         with self._lock:
-            loaded = self._loaded
+            return self._loaded
+
+    def _predict_fn(self):
+        loaded = self._current_loaded()
         if loaded is None:
             raise RuntimeError("no model loaded")
         return loaded.predict if self.raw else loaded.predict_transformed
@@ -257,8 +332,12 @@ class ModelServer:
     def predict_batch(self, batch: Dict[str, Any]) -> np.ndarray:
         """Predict on a columnar feature batch — the shared entry for every
         surface (REST, gRPC, InfraValidator canaries), so all of them ride
-        the same micro-batcher and see hot-swaps at the same instant."""
+        the same micro-batcher (or, in fleet mode, the latency-aware
+        router's pick of replica batcher) and see hot-swaps at the same
+        instant."""
         n_rows = len(next(iter(batch.values())))
+        if self._fleet is not None:
+            return self._fleet.submit(batch, n_rows)
         if self._batcher is not None:
             return self._batcher.submit(batch, n_rows)
         return np.asarray(self._predict_fn()(batch))
@@ -289,8 +368,7 @@ class ModelServer:
         """The loaded model's generate callable; raises GenerateUnsupported
         (a ValueError) when this server/payload cannot decode — the typed
         contract the gRPC surface maps to FAILED_PRECONDITION."""
-        with self._lock:
-            loaded = self._loaded
+        loaded = self._current_loaded()
         if loaded is None:
             raise RuntimeError("no model loaded")
         if loaded.generate is None:
@@ -333,16 +411,20 @@ class ModelServer:
         Healthy = a model is loaded and the batcher (when enabled) is
         accepting work; the probe never touches the device, so a slow
         model cannot fail the liveness check."""
-        with self._lock:
-            loaded = self._loaded is not None
-            version = self._loaded_version
+        loaded = self._current_loaded() is not None
+        version = self.version
         batcher_open = self._batcher is None or not self._batcher._closed
-        return {
+        if self._fleet is not None:
+            batcher_open = not self._fleet.closed
+        health = {
             "healthy": loaded and batcher_open and not self._stopped,
             "model": self.model_name,
             "version": version,
-            "batching": self._batcher is not None,
+            "batching": self._batcher is not None or self._fleet is not None,
         }
+        if self._fleet is not None:
+            health["fleet"] = self._fleet.health()
+        return health
 
     # ---------------------------------------------------------------- HTTP
 
@@ -422,6 +504,15 @@ class ModelServer:
                         ("predict", server.predict),
                     f"/v1/models/{server.model_name}:generate":
                         ("generate", server.generate),
+                    # Management op (Pusher push-URL hook, ops tooling):
+                    # rescan base_dir and hot-swap to the newest version.
+                    # Never admission-controlled — a full queue is exactly
+                    # when an operator may need to roll the model.
+                    f"/v1/models/{server.model_name}:reload":
+                        ("reload", lambda _payload: {
+                            "version": server.reload(),
+                            "model": server.model_name,
+                        }),
                 }
                 route = routes.get(self.path)
                 if route is None:
@@ -437,8 +528,9 @@ class ModelServer:
                     # Fault hook (RELOAD_DURING_HAMMER): a no-op global
                     # read unless a test plan is active.
                     _faults.serving_request(server, endpoint)
-                    server._admit(endpoint)
-                    admitted = True
+                    if endpoint != "reload":
+                        server._admit(endpoint)
+                        admitted = True
                     n = int(self.headers.get("Content-Length", "0"))
                     payload = json.loads(self.rfile.read(n) or b"{}")
                     self._reply(200, handler(payload), endpoint=endpoint)
@@ -456,7 +548,17 @@ class ModelServer:
                     # "anything went wrong"): caller mistakes are 4xx,
                     # not-ready is a retriable 503, everything else is an
                     # honest 500.
-                    if isinstance(
+                    from tpu_pipelines.serving.fleet.versions import (
+                        CanaryRefused,
+                    )
+
+                    if isinstance(e, CanaryRefused):
+                        # The pushed payload failed the canary gate; the
+                        # prior version keeps serving.  The server is
+                        # healthy, so this is a conflict verdict on the
+                        # push, not a 5xx.
+                        code, retry = 409, 0
+                    elif isinstance(
                         e, (ValueError, KeyError, TypeError)
                     ):
                         code, retry = 400, 0
@@ -504,3 +606,8 @@ class ModelServer:
         if self._batcher is not None:
             self._batcher.close()
             self._batcher = None
+        if self._fleet is not None:
+            # Parallel drain across every replica batcher: shutdown is
+            # bounded by one timeout, not replicas x timeout.
+            self._fleet.close()
+            self._fleet = None
