@@ -793,6 +793,69 @@ def bench_t5_decode(smoke: bool) -> dict:
             "tokens_per_sec": round(batch * dec_len / dt, 1),
             "ms_per_token": round(dt / dec_len * 1e3, 3),
         }
+
+    # Flash-decode datapoint (ISSUE 11): the generative engine's per-step
+    # kernel — single-query attention against the KV cache — tuned by the
+    # autotuner's 1-D block_k sweep and measured against dense cache
+    # attention per cache length.  The first length where tuned flash
+    # wins is persisted as the DECODE crossover
+    # (autotune.record_decode_crossover) that attn_impl="auto" consults
+    # in the decode regime (models/transformer.py choose_decode_impl).
+    from tpu_pipelines.models.transformer import (
+        choose_decode_impl, dense_attention,
+    )
+    from tpu_pipelines.ops import autotune
+    from tpu_pipelines.ops.flash_attention import flash_decode_attention
+
+    interpret = jax.default_backend() != "tpu"
+    if smoke:
+        db, heads, hd, kv_lens, fd_iters = 2, 2, 8, [128, 256], 1
+    else:
+        db, heads, hd, kv_lens, fd_iters = 32, 8, 64, [512, 2048, 8192], 20
+    fd: dict = {"per_len": {}, "interpret": interpret}
+    crossover = None
+    for kv_len in kv_lens:
+        kq, kk, kv = jax.random.split(jax.random.key(kv_len), 3)
+        q = jax.random.normal(kq, (db, 1, heads, hd), jnp.float32)
+        k = jax.random.normal(kk, (db, kv_len, heads, hd), jnp.float32)
+        v = jax.random.normal(kv, (db, kv_len, heads, hd), jnp.float32)
+        sw = autotune.sweep_decode(
+            db, heads, kv_len, hd, jnp.float32, interpret, iters=fd_iters,
+        )["flash_decode"]
+        best = sw["best"]
+        dense_c = jax.jit(
+            lambda q, k, v: dense_attention(q, k, v, causal=False)
+        ).lower(q, k, v).compile()
+        dense_ms = round(
+            autotune.time_compiled(dense_c, (q, k, v), fd_iters), 4
+        )
+        row = {
+            "dense_ms": dense_ms,
+            "flash_ms": best["ms"] if best else None,
+            "block_k": best["block_k"] if best else None,
+            "candidates_timed": sum(1 for r in sw["swept"] if "ms" in r),
+        }
+        fd["per_len"][str(kv_len)] = row
+        if (
+            crossover is None and best is not None
+            and best["ms"] <= dense_ms
+        ):
+            crossover = kv_len
+    kind = autotune.current_device_kind()
+    autotune.record_decode_crossover(
+        kind, crossover,
+        geometry={"batch": db, "heads": heads, "head_dim": hd,
+                  "kv_lens": kv_lens},
+        source="bench-smoke" if smoke else "bench",
+    )
+    fd["crossover_kv_len"] = crossover
+    fd["device_kind"] = kind
+    # What "auto" now resolves to at each measured length (reads the
+    # crossover just recorded).
+    fd["auto_choice"] = {
+        str(l): choose_decode_impl(db, heads, l, hd) for l in kv_lens
+    }
+    out["flash_decode"] = fd
     return out
 
 
@@ -1486,6 +1549,322 @@ def bench_serving_fleet(smoke: bool) -> dict:
         "replicas": 2,
         "per_replica_requests": per_replica,
         "max_queue_depth": max_queue_depth,
+        "concurrency": n_threads,
+        "host_cpus": os.cpu_count(),
+        "healthz": health,
+    }
+
+
+def bench_generative_serving(smoke: bool) -> dict:
+    """Continuous-batching decode leg (ISSUE 11), judged from the fleet's
+    OWN ``/metrics`` scrape, as an A/B on identical traffic:
+
+      A. **Continuous** (``model_type="generative"``): mixed-length
+         requests with Poisson-jittered arrivals hammer the REST
+         ``:generate`` surface of a generative fleet — sequences join the
+         running decode batch per step and leave at EOS / their own
+         ``max_new_tokens``.  Headline tokens/s and p99-per-token come
+         from the fleet's scrape (``serving_decode_*``); a second pass
+         hot-swaps a freshly pushed version MID-HAMMER and the cumulative
+         scrape must show zero 5xx (in-flight generations finish on the
+         version they started on).
+      B. **Whole-request**: the SAME requests (same inputs, same wanted
+         budgets) against the same payload served the PR-10 way — each
+         request decodes alone to the exported ``max_decode_len``
+         regardless of how few tokens it wants.
+
+    Useful tokens are counted identically on both sides (the stream up to
+    EOS, capped at the requested budget — greedy math is identical, so
+    per-request counts agree); the speedup is useful-tokens/s A over B.
+    """
+    import queue as queue_mod
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import jax
+
+    from tpu_pipelines.models.t5 import build_t5_model
+    from tpu_pipelines.observability.metrics import histogram_quantile
+    from tpu_pipelines.serving import ModelServer
+    from tpu_pipelines.trainer.export import export_model
+
+    # Geometry note: the exported max_decode_len is the whole-request
+    # pass's fixed cost (its scan always runs the full exported budget,
+    # EOS is masking not control flow) while the continuous pass pays
+    # only each request's OWN ``max_new_tokens`` — exactly the asymmetry
+    # the engine exists to exploit, and the realistic serving shape: one
+    # exported ceiling, mostly-short replies.  The model is sized so
+    # decode compute (not HTTP framing) dominates both passes even on a
+    # 1-core smoke host; two rows per request halve the framing share.
+    if smoke:
+        hp = {"vocab_size": 64, "d_model": 128, "n_layers": 2,
+              "n_heads": 4, "head_dim": 16, "d_ff": 384,
+              "dropout_rate": 0.0, "max_decode_len": 128, "eos_id": 1,
+              "max_input_len": 8}
+        n_requests, n_threads = 40, 8
+    else:
+        hp = {"vocab_size": 256, "d_model": 128, "n_layers": 2,
+              "n_heads": 4, "head_dim": 16, "d_ff": 384,
+              "dropout_rate": 0.0, "max_decode_len": 128, "eos_id": 1,
+              "max_input_len": 8}
+        n_requests, n_threads = 200, 8
+    dec_len = hp["max_decode_len"]
+    in_len = hp["max_input_len"]
+    rows_per_request = 2
+    long_budget = 48  # the 15% "long reply" tail; shorts want 3-7
+
+    module_src = (
+        "import jax.numpy as jnp\n"
+        "from tpu_pipelines.models.t5 import (\n"
+        "    build_t5_model, make_continuous_decode_fns,\n"
+        "    make_greedy_generate,\n"
+        ")\n"
+        "def build_model(hp):\n"
+        "    return build_t5_model(hp)\n"
+        "def make_generate_step(model, hp):\n"
+        "    gen = make_greedy_generate(\n"
+        "        model, max_decode_len=int(hp['max_decode_len']),\n"
+        "        eos_id=int(hp['eos_id']))\n"
+        "    def fn(params, batch):\n"
+        "        mask = (jnp.asarray(batch['input_mask'], jnp.int32)\n"
+        "                if 'input_mask' in batch else None)\n"
+        "        tokens, _ = gen(\n"
+        "            params, jnp.asarray(batch['inputs'], jnp.int32), mask)\n"
+        "        return tokens\n"
+        "    return fn\n"
+        "def make_decode_fns(model, hp):\n"
+        "    return make_continuous_decode_fns(\n"
+        "        model, max_decode_len=int(hp['max_decode_len']),\n"
+        "        eos_id=int(hp['eos_id']),\n"
+        "        max_input_len=int(hp['max_input_len']))\n"
+    )
+
+    # Identical traffic for both passes: mixed true lengths padded to one
+    # wire shape (no per-shape recompiles on either side), mixed decode
+    # budgets — mostly short replies plus a 15% tail wanting the full
+    # budget, the mix whole-request batching is worst at.
+    rng = np.random.default_rng(0)
+    requests = []
+    for _ in range(n_requests):
+        rows = []
+        for _ in range(rows_per_request):
+            true_len = int(rng.integers(2, in_len + 1))
+            row = rng.integers(2, min(60, hp["vocab_size"]), size=(in_len,))
+            rows.append({
+                "inputs": [int(x) for x in row],
+                "input_mask": [1] * true_len + [0] * (in_len - true_len),
+            })
+        m = long_budget if rng.random() < 0.15 else int(rng.integers(3, 8))
+        requests.append({"rows": rows, "max_new_tokens": m})
+    wanted_total = sum(
+        r["max_new_tokens"] * rows_per_request for r in requests
+    )
+
+    def useful_tokens(stream, m):
+        n = 0
+        for t in stream[:m]:
+            n += 1
+            if t == hp["eos_id"]:
+                break
+        return n
+
+    def hammer(url, with_params: bool, reqs) -> dict:
+        """Closed-loop n_threads workers with exponential (Poisson)
+        arrival jitter; returns per-request latency + useful tokens."""
+        work: "queue_mod.Queue" = queue_mod.Queue()
+        for r in reqs:
+            work.put(r)
+        out_lock = threading.Lock()
+        lat, tok, errors, codes = [], [], [0], {}
+        jit_rng = np.random.default_rng(1)
+
+        def worker():
+            while True:
+                try:
+                    r = work.get_nowait()
+                except queue_mod.Empty:
+                    return
+                payload = {"instances": r["rows"]}
+                if with_params:
+                    payload["params"] = {
+                        "max_new_tokens": r["max_new_tokens"]
+                    }
+                body = json.dumps(payload).encode()
+                with out_lock:
+                    delay = float(jit_rng.exponential(0.002))
+                time.sleep(delay)
+                t0 = time.perf_counter()
+                code = None
+                try:
+                    req = urllib.request.Request(url, data=body)
+                    with urllib.request.urlopen(req, timeout=120) as resp:
+                        streams = json.loads(resp.read())["outputs"]
+                        code = resp.status
+                except urllib.error.HTTPError as e:
+                    code = e.code
+                    streams = []
+                except Exception:  # noqa: BLE001 — dropped connection
+                    errors[0] += 1
+                    streams = []
+                dt = time.perf_counter() - t0
+                with out_lock:
+                    codes[code] = codes.get(code, 0) + 1
+                    if code == 200:
+                        u = sum(
+                            useful_tokens(s, r["max_new_tokens"])
+                            for s in streams
+                        )
+                        lat.append(dt)
+                        tok.append(u)
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=worker) for _ in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        per_tok_ms = sorted(
+            d / max(1, u) * 1e3 for d, u in zip(lat, tok)
+        )
+        return {
+            "wall_s": wall,
+            "useful_tokens": sum(tok),
+            "tok_s": round(sum(tok) / wall, 1) if wall else None,
+            "p99_ms_per_token": (
+                round(per_tok_ms[int(0.99 * (len(per_tok_ms) - 1))], 3)
+                if per_tok_ms else None
+            ),
+            "errors": errors[0],
+            "codes": {str(k): v for k, v in sorted(
+                codes.items(), key=lambda kv: str(kv[0])
+            )},
+        }
+
+    with tempfile.TemporaryDirectory() as td:
+        module = os.path.join(td, "gen_model.py")
+        with open(module, "w") as f:
+            f.write(module_src)
+        model = build_t5_model(hp)
+        sample = {"inputs": np.ones((1, in_len), np.int32),
+                  "targets": np.ones((1, 4), np.int32)}
+        for version, seed in (("1", 0), ("2", 1)):
+            params = model.init(jax.random.key(seed), sample)["params"]
+            export_model(
+                serving_model_dir=os.path.join(td, "a", version),
+                params=params, module_file=module, hyperparameters=hp,
+            )
+        # B serves the SAME v1 payload from its own dir (no v2 in sight).
+        import shutil
+
+        shutil.copytree(os.path.join(td, "a", "1"), os.path.join(td, "b", "1"))
+        v2 = os.path.join(td, "a", "2")
+        v2_hidden = os.path.join(td, "v2-staged")
+        os.rename(v2, v2_hidden)
+
+        # ---- Pass A: continuous batching (generative fleet). ----------
+        server_a = ModelServer(
+            "gen", os.path.join(td, "a"),
+            model_type="generative", max_batch_size=8, max_versions=2,
+        )
+        port = server_a.start()
+        url_a = f"http://127.0.0.1:{port}/v1/models/gen:generate"
+        try:
+            a_warm = hammer(url_a, True, requests[:2])  # HTTP-path warmup
+            a = hammer(url_a, True, requests)
+            # Reload under load: stage v2, swap mid-hammer; generations
+            # in flight finish on v1 (version leases), new ones decode
+            # on v2 — zero 5xx over the cumulative scrape.
+            threads = threading.Thread(
+                target=lambda: hammer(
+                    url_a, True, requests[: max(6, n_requests // 3)]
+                )
+            )
+            threads.start()
+            time.sleep(0.05)
+            os.rename(v2_hidden, v2)
+            reload_req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/models/gen:reload", data=b"{}",
+            )
+            with urllib.request.urlopen(reload_req, timeout=300) as r:
+                reloaded_to = json.loads(r.read())["version"]
+            threads.join()
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ) as r:
+                scrape = r.read().decode()
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10
+            ) as r:
+                health = json.loads(r.read())
+        finally:
+            server_a.stop()
+
+        # ---- Pass B: whole-request decode on the same payload. --------
+        server_b = ModelServer("req", os.path.join(td, "b"))
+        port_b = server_b.start()
+        url_b = f"http://127.0.0.1:{port_b}/v1/models/req:generate"
+        try:
+            hammer(url_b, False, requests[:2])          # compile + warmup
+            b = hammer(url_b, False, requests)
+        finally:
+            server_b.stop()
+
+    decode_5xx = int(_parse_prom_counter(
+        scrape, "serving_requests_total", 'code="5'
+    ))
+    hist = _parse_prom_histogram(
+        scrape, "serving_decode_per_token_latency_seconds", 'replica="0"'
+    )
+    scraped_p99_tok_ms = None
+    if hist:
+        series = {"buckets": hist["buckets"], "count": hist["count"],
+                  "sum": hist["sum"]}
+        q = histogram_quantile(series, 0.99, hist["bounds"])
+        scraped_p99_tok_ms = round(q * 1e3, 3) if q is not None else None
+    scraped_tokens = int(_parse_prom_counter(
+        scrape, "serving_decode_tokens_total"
+    ))
+    scraped_steps = int(_parse_prom_counter(
+        scrape, "serving_decode_steps_total"
+    ))
+    speedup = (
+        round(a["tok_s"] / b["tok_s"], 2)
+        if a["tok_s"] and b["tok_s"] else None
+    )
+    green = bool(
+        a["errors"] == 0 and b["errors"] == 0
+        and decode_5xx == 0
+        and reloaded_to == "2"
+        and bool(health.get("healthy"))
+        and speedup is not None and speedup >= 2.0
+        and a["p99_ms_per_token"] is not None
+        and b["p99_ms_per_token"] is not None
+        and a["p99_ms_per_token"] <= b["p99_ms_per_token"]
+    )
+    return {
+        "green": green,
+        "continuous": a,
+        "whole_request": b,
+        "warmup": a_warm["codes"],
+        "decode_tok_s": a["tok_s"],
+        "decode_p99_ms_per_token": scraped_p99_tok_ms,
+        "client_p99_ms_per_token": {
+            "continuous": a["p99_ms_per_token"],
+            "whole_request": b["p99_ms_per_token"],
+        },
+        "continuous_vs_request_speedup": speedup,
+        "decode_5xx": decode_5xx,
+        "reloaded_to": reloaded_to,
+        "scraped_decode_tokens": scraped_tokens,
+        "scraped_decode_steps": scraped_steps,
+        "requests_per_pass": n_requests,
+        "wanted_tokens_per_pass": wanted_total,
+        "max_decode_len": dec_len,
         "concurrency": n_threads,
         "host_cpus": os.cpu_count(),
         "healthz": health,
@@ -2643,6 +3022,20 @@ def _compact(report: dict) -> dict:
         compact["fleet_p99_ms"] = fl.get("p99_ms")
         compact["fleet_reload_5xx"] = fl.get("reload_5xx")
         compact["fleet_shed_requests"] = fl.get("shed_requests")
+    # Continuous-batching decode headline (ISSUE 11): tokens/s and
+    # p99-per-token off the fleet's own scrape, the A/B speedup over
+    # whole-request decode, and the zero-5xx-across-hot-swap count.
+    gs = report.get("generative_serving")
+    if isinstance(gs, dict) and "green" in gs:
+        compact["generative_green"] = bool(gs.get("green"))
+        compact["decode_tok_s"] = gs.get("decode_tok_s")
+        compact["decode_p99_ms_per_token"] = gs.get(
+            "decode_p99_ms_per_token"
+        )
+        compact["continuous_vs_request_speedup"] = gs.get(
+            "continuous_vs_request_speedup"
+        )
+        compact["decode_5xx"] = gs.get("decode_5xx")
     td = report.get("trace_diff")
     if isinstance(td, dict):
         # Capped: the compact line must stay under the driver-tail budget
@@ -2857,6 +3250,13 @@ def main() -> None:
     # Serving fleet (ISSUE 10): multi-replica + SLO batching + reload-
     # under-load hammer, judged from the fleet's own scrape.
     leg("serving_fleet", bench_serving_fleet, est_cost_s=60, retries=1)
+    # Continuous-batching decode (ISSUE 11): generative fleet vs
+    # whole-request A/B on identical mixed-length traffic + zero-5xx
+    # hot-swap with generations in flight, off the fleet's own scrape.
+    leg(
+        "generative_serving", bench_generative_serving,
+        est_cost_s=120, retries=1,
+    )
     # Wall-clock head of the BASELINE metric: the same taxi DAG sequential
     # vs concurrent, identical-lineage checked (see bench_e2e_taxi_sched).
     e2e_leg("taxi_sched", bench_e2e_taxi_sched, est_cost_s=240)

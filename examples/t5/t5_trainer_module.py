@@ -51,6 +51,21 @@ def make_generate_step(model, hyperparameters):
     return fn
 
 
+def make_decode_fns(model, hyperparameters):
+    """Export hook (trainer/export.py): the continuous-batching decode
+    contract — prefill/step + geometry — that opts this payload into the
+    generative fleet model type (serving/generative.py).  Same eos/pad
+    conventions as make_generate_step above."""
+    from tpu_pipelines.models.t5 import make_continuous_decode_fns
+
+    return make_continuous_decode_fns(
+        model,
+        max_decode_len=int(hyperparameters.get("max_decode_len", 32)),
+        eos_id=int(hyperparameters.get("eos_id", 3)),
+        max_input_len=int(hyperparameters.get("max_input_len", 64)),
+    )
+
+
 def apply_fn(model, params, batch):
     return model.apply({"params": params}, {
         "inputs": jnp.asarray(batch["inputs"], jnp.int32),

@@ -673,6 +673,48 @@ def test_tpp208_flash_below_committed_crossover(tmp_path):
             assert 'attn_impl="auto"' in f208[0].fix
 
 
+def test_tpp209_whole_request_decode(tmp_path):
+    """TPP209: an explicit non-generative model_type next to decode
+    geometry fires WARN; generative endpoints, configs without a
+    model_type, and predict-only configs stay silent."""
+    from tpu_pipelines.utils.module_loader import load_fn
+
+    mod = tmp_path / "servey.py"
+    mod.write_text(textwrap.dedent('''
+        def dict_predict_decode():
+            return {"model_type": "predict", "max_decode_len": 32,
+                    "replicas": 2}
+
+
+        def call_predict_beam():
+            from tpu_pipelines.serving import ModelServer
+
+            return ModelServer("t5", "/m", model_type="predict",
+                               beam_size=4)
+
+
+        def generative_is_fine():
+            return {"model_type": "generative", "max_decode_len": 32}
+
+
+        def no_model_type_is_silent():
+            return {"max_decode_len": 32, "beam_size": 4}
+
+
+        def predict_without_decode_is_fine():
+            return {"model_type": "predict", "replicas": 2}
+    '''))
+    for fn, n in (("dict_predict_decode", 1), ("call_predict_beam", 1),
+                  ("generative_is_fine", 0), ("no_model_type_is_silent", 0),
+                  ("predict_without_decode_is_fine", 0)):
+        findings = check_callable(load_fn(str(mod), fn), "Server")
+        f209 = [f for f in findings if f.rule == "TPP209"]
+        assert len(f209) == n, (fn, findings)
+        if n:
+            assert f209[0].severity == "warn"
+            assert 'model_type="generative"' in f209[0].fix
+
+
 # ------------------------------------------------------------------- gates
 
 
@@ -1044,6 +1086,18 @@ def FlashGen(ctx):
 
 def create_pipeline():
     gen = FlashGen()
+    return _pipe([gen, Sink(examples=gen.outputs["examples"])])
+''',
+    "TPP209": '''
+@component(outputs={{"examples": "Examples"}}, name="ServeGen")
+def ServeGen(ctx):
+    serving = {{"model_type": "predict", "max_decode_len": 32,
+                "replicas": 2}}
+    return serving
+
+
+def create_pipeline():
+    gen = ServeGen()
     return _pipe([gen, Sink(examples=gen.outputs["examples"])])
 ''',
 }

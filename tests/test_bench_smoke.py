@@ -181,6 +181,56 @@ def test_bench_smoke_emits_compact_stdout_and_full_report():
     assert compact["fleet_p99_ms"] == fl["p99_ms"]
     assert compact["fleet_reload_5xx"] == 0
     assert compact["fleet_shed_requests"] == fl["shed_requests"]
+    # Continuous-batching decode leg (ISSUE 11): the generative fleet
+    # beats whole-request decode >= 2x on identical mixed-length traffic
+    # at equal-or-better client p99-per-token, with zero 5xx across a
+    # hot-swap with generations in flight — tokens/s and the headline
+    # p99-per-token judged from the fleet's own scrape.
+    gs = report["generative_serving"]
+    assert gs["green"] is True, gs
+    assert gs["continuous_vs_request_speedup"] >= 2.0
+    assert gs["decode_tok_s"] > 0
+    assert gs["decode_p99_ms_per_token"] is not None
+    assert gs["decode_5xx"] == 0
+    assert gs["reloaded_to"] == "2"
+    assert gs["continuous"]["errors"] == 0
+    assert gs["whole_request"]["errors"] == 0
+    # Identical useful-token accounting on both sides of the A/B.
+    assert (
+        gs["continuous"]["useful_tokens"]
+        == gs["whole_request"]["useful_tokens"] > 0
+    )
+    cp = gs["client_p99_ms_per_token"]
+    assert cp["continuous"] <= cp["whole_request"]
+    assert gs["scraped_decode_steps"] > 0
+    # Iteration-level batching: strictly fewer steps than tokens (several
+    # sequences advance per step).
+    assert gs["scraped_decode_steps"] < gs["scraped_decode_tokens"]
+    assert gs["healthz"]["healthy"] is True
+    assert compact["generative_green"] is True
+    assert compact["decode_tok_s"] == gs["decode_tok_s"]
+    assert (
+        compact["decode_p99_ms_per_token"] == gs["decode_p99_ms_per_token"]
+    )
+    assert (
+        compact["continuous_vs_request_speedup"]
+        == gs["continuous_vs_request_speedup"]
+    )
+    assert compact["decode_5xx"] == 0
+    # t5_decode now carries the flash-decode datapoint: per-cache-length
+    # dense-vs-tuned-flash timings, the recorded decode crossover, and
+    # what "auto" resolves to at each measured length.
+    fdec = report["t5_decode"]["flash_decode"]
+    assert set(fdec["per_len"]) == {"128", "256"}
+    for row in fdec["per_len"].values():
+        assert row["dense_ms"] > 0
+        assert row["flash_ms"] is None or row["flash_ms"] > 0
+        assert row["candidates_timed"] >= 1
+    assert "crossover_kv_len" in fdec
+    assert set(fdec["auto_choice"]) == set(fdec["per_len"])
+    assert all(
+        v in ("dense", "flash") for v in fdec["auto_choice"].values()
+    )
     # Unified fault-tolerance chaos leg (ISSUE 7): the taxi run completes
     # under the injected schedule with lineage identical to fault-free,
     # exact merged statistics, a quarantined poison shard in the salvage
@@ -304,6 +354,7 @@ def test_bench_budget_skips_but_emits():
     assert "data_plane" in compact["skipped"]
     assert "serving" in compact["skipped"]
     assert "serving_fleet" in compact["skipped"]
+    assert "generative_serving" in compact["skipped"]
     # No taxi leg ran, so the trace-diff self-report degrades to empty
     # flags (never a crash, never a missing key).
     assert compact["regression_flags"] == []

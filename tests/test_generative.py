@@ -1,0 +1,878 @@
+"""Continuous batching for autoregressive decode (ISSUE 11).
+
+Two layers, both tier-1-safe (``generative`` marker):
+
+* **Engine semantics on a stub decode contract** — a deterministic
+  token-chain "model" (next token is a pure function of the input seed,
+  the cache contents and the position) exercises the iteration-level
+  scheduler exactly: join/leave/EOS edges, the warmup compile contract,
+  token-level admission, per-token SLO eviction, and the token-identity
+  acceptance (randomized arrival schedules must reproduce the isolated
+  single-request stream bit for bit — ints, so bitwise IS equality).
+* **A real tiny T5** — the engine's token streams must be bitwise equal
+  to isolated ``make_greedy_generate`` decode (the vector ``decode_pos``
+  arena path vs the scalar scan path), plus the flash-decode kernel's
+  parity against dense attention and the decode-regime crossover rule.
+
+The fleet/REST layer runs on the stub-loader seam like
+tests/test_serving_fleet.py: real version manager, canary gate, engines,
+HTTP surface — no model export, no heavyweight jit.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.generative
+
+VOCAB = 16
+EOS = 4  # with the chain below: ~half the seeds terminate, half run full
+
+
+# --------------------------------------------------------- stub contract
+
+
+def make_stub_fns(max_decode_len=12, eos_id=EOS, pad_id=0, max_input_len=6):
+    """A deterministic autoregressive chain with the engine's duck-typed
+    contract: the next token depends on the input seed, every token the
+    cache has accumulated, and the decode position — so any arena slot
+    mix-up, stale cache row, or wrong position corrupts the stream."""
+    import jax
+    import jax.numpy as jnp
+
+    def prefill(params, inputs, input_mask=None):
+        if input_mask is None:
+            input_mask = jnp.ones_like(inputs)
+        seed = (inputs * input_mask).sum(axis=1)                    # [1]
+        off = params.get("offset", 0) if isinstance(params, dict) else 0
+        cache = {"toks": jnp.zeros((1, max_decode_len), jnp.int32)}
+        logits = jax.nn.one_hot((seed * 3 + 1 + off) % VOCAB, VOCAB)
+        encoded = seed[:, None].astype(jnp.float32)                 # [1, 1]
+        return cache, encoded, logits
+
+    def step(params, cache, tok, pos, encoded, enc_mask, klen):
+        rows = jnp.arange(tok.shape[0])
+        off = params.get("offset", 0) if isinstance(params, dict) else 0
+        toks = cache["toks"].at[rows, pos].set(tok)
+        seed = encoded[:, 0].astype(jnp.int32)
+        # Nonlinear in the last token (tok*tok) so the chain never
+        # collapses to a seed-independent tail: every sequence walks its
+        # own trajectory, and any cross-row cache contamination shows.
+        nxt = (
+            seed * 2 + tok * tok + toks.sum(axis=1) * 3 + pos * 11 + off
+        ) % VOCAB
+        return {"toks": toks}, jax.nn.one_hot(nxt, VOCAB)
+
+    return SimpleNamespace(
+        prefill=prefill, step=step,
+        max_decode_len=int(max_decode_len), eos_id=int(eos_id),
+        pad_id=int(pad_id), max_input_len=int(max_input_len),
+    )
+
+
+def ref_stream(inputs, max_new_tokens, max_decode_len=12, offset=0):
+    """Pure-python reference for one isolated sequence of the stub chain."""
+    seed = int(np.asarray(inputs).sum())
+    t = (seed * 3 + 1 + offset) % VOCAB
+    out = [t]
+    toks = [0] * max_decode_len
+    pos = 1
+    while t != EOS and len(out) < max_new_tokens:
+        toks[pos] = t
+        t = (seed * 2 + t * t + sum(toks) * 3 + pos * 11 + offset) % VOCAB
+        out.append(t)
+        pos += 1
+    return out
+
+
+# ------------------------------------------------------------- unit math
+
+
+def test_kv_bucket_sizes():
+    from tpu_pipelines.serving.generative import kv_bucket_sizes
+
+    # Unpaged (0 or page >= cache): one bucket, the whole cache.
+    assert kv_bucket_sizes(32, 0) == [32]
+    assert kv_bucket_sizes(32, 32) == [32]
+    assert kv_bucket_sizes(32, 64) == [32]
+    # Paged: page, 2p, 4p, ... capped at the cache length.
+    assert kv_bucket_sizes(32, 4) == [4, 8, 16, 32]
+    # Non-power-of-two cache still terminates exactly at the cache.
+    assert kv_bucket_sizes(24, 4) == [4, 8, 16, 24]
+
+
+def test_validate_generation_params():
+    from tpu_pipelines.serving.batching import validate_generation_params
+
+    # Default fills the full decode budget.
+    assert validate_generation_params(None, max_decode_len=32) == {
+        "max_new_tokens": 32
+    }
+    assert validate_generation_params(
+        {"max_new_tokens": 4}, max_decode_len=32
+    ) == {"max_new_tokens": 4}
+    with pytest.raises(ValueError, match="unknown generation parameter"):
+        validate_generation_params({"temperature": 1.0}, max_decode_len=32)
+    with pytest.raises(ValueError, match="must be an integer"):
+        validate_generation_params(
+            {"max_new_tokens": "8"}, max_decode_len=32
+        )
+    with pytest.raises(ValueError, match="must be an integer"):
+        validate_generation_params(
+            {"max_new_tokens": True}, max_decode_len=32
+        )
+    with pytest.raises(ValueError, match=r"in \[1, 32\]"):
+        validate_generation_params({"max_new_tokens": 0}, max_decode_len=32)
+    with pytest.raises(ValueError, match=r"in \[1, 32\]"):
+        validate_generation_params(
+            {"max_new_tokens": 33}, max_decode_len=32
+        )
+
+
+def test_token_deadline_math():
+    from tpu_pipelines.serving.batching import token_deadline_s
+
+    assert token_deadline_s(10.0, 100, 0.0) is None
+    assert token_deadline_s(10.0, 100, 2.0) == pytest.approx(10.2)
+
+
+# ------------------------------------------------------ engine semantics
+
+
+def test_engine_identity_under_randomized_join_leave():
+    """Acceptance: token streams under a randomized arrival/departure
+    schedule are identical to isolated single-request decode.  Tokens are
+    ints, so equality IS bitwise."""
+    from tpu_pipelines.serving.generative import GenerativeEngine
+
+    fns = make_stub_fns()
+    rng = np.random.default_rng(11)
+    reqs = [
+        (
+            rng.integers(1, VOCAB, size=(int(rng.integers(2, 6)),)).astype(
+                np.int32
+            ),
+            int(rng.integers(1, 12)),
+        )
+        for _ in range(24)
+    ]
+    engine = GenerativeEngine(fns, {}, max_batch_size=4, page_size=0)
+    try:
+        engine.warm()
+        handles = []
+        for i, (inp, m) in enumerate(reqs):
+            handles.append(engine.submit_nowait(inp, max_new_tokens=m))
+            # Randomized arrivals: bursts, pauses, mid-decode joins.
+            if rng.random() < 0.4:
+                time.sleep(float(rng.random()) * 0.01)
+        outs = [h.wait(30.0) for h in handles]
+    finally:
+        engine.close()
+    for (inp, m), out in zip(reqs, outs):
+        assert [int(t) for t in out] == ref_stream(inp, m)
+    # Departures compacted the batch: with 24 sequences through 4 slots,
+    # slots were recycled many times.
+    assert engine.steps_run > 0
+
+
+def test_engine_paged_kv_buckets_identity_and_pages():
+    """Paged mode (page_size=2 over a 12-deep cache): same streams, and
+    the telemetry pages gauge tracks ceil((len+1)/page) per live row."""
+    from tpu_pipelines.serving.generative import GenerativeEngine
+
+    fns = make_stub_fns()
+    engine = GenerativeEngine(fns, {}, max_batch_size=2, page_size=2)
+    try:
+        assert engine.kv_buckets == [2, 4, 8, 12]
+        engine.warm()
+        assert engine.compiles_after_warm == 0
+        inp = np.asarray([3, 5], np.int32)
+        out = engine.submit(inp, max_new_tokens=10, timeout_s=30.0)
+        assert [int(t) for t in out] == ref_stream(inp, 10)
+        # Every step ran pre-compiled (bucket sweep covered the schedule).
+        assert engine.compiles_after_warm == 0
+    finally:
+        engine.close()
+
+
+def test_engine_eos_and_budget_edges():
+    from tpu_pipelines.serving.generative import GenerativeEngine
+
+    fns = make_stub_fns()
+    engine = GenerativeEngine(fns, {}, max_batch_size=2, page_size=0)
+    try:
+        # max_new_tokens=1: completes at prefill, never occupies a slot.
+        inp = np.asarray([2, 2], np.int32)
+        out = engine.submit(inp, max_new_tokens=1, timeout_s=30.0)
+        assert len(out) == 1
+        assert [int(out[0])] == ref_stream(inp, 1)
+        assert engine.idle()
+
+        # A seed whose chain hits EOS: stream ends WITH the EOS token.
+        for seed_try in range(1, 40):
+            ref = ref_stream(np.asarray([seed_try], np.int32), 12)
+            if ref[-1] == EOS and len(ref) > 1:
+                inp = np.asarray([seed_try], np.int32)
+                out = engine.submit(inp, max_new_tokens=12, timeout_s=30.0)
+                assert [int(t) for t in out] == ref
+                break
+        else:
+            pytest.fail("no EOS-terminating seed in range")
+
+        # Full budget without EOS: exactly max_new_tokens emitted.
+        for seed_try in range(1, 40):
+            ref = ref_stream(np.asarray([seed_try], np.int32), 5)
+            if ref[-1] != EOS and len(ref) == 5:
+                inp = np.asarray([seed_try], np.int32)
+                out = engine.submit(inp, max_new_tokens=5, timeout_s=30.0)
+                assert len(out) == 5
+                assert [int(t) for t in out] == ref
+                break
+        else:
+            pytest.fail("no budget-bound seed in range")
+    finally:
+        engine.close()
+
+
+def test_engine_input_validation_is_submit_time():
+    from tpu_pipelines.serving.generative import GenerativeEngine
+
+    fns = make_stub_fns(max_input_len=4)
+    engine = GenerativeEngine(fns, {}, max_batch_size=2)
+    try:
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            engine.submit_nowait(np.asarray([1], np.int32), max_new_tokens=0)
+        with pytest.raises(ValueError, match="input length"):
+            engine.submit_nowait(np.asarray([], np.int32))
+        with pytest.raises(ValueError, match="input length"):
+            engine.submit_nowait(np.arange(5, dtype=np.int32))
+        # Nothing joined the engine: malformed requests cannot poison a
+        # shared decode step.
+        assert engine.idle()
+    finally:
+        engine.close()
+
+
+def test_engine_token_admission_shed():
+    from tpu_pipelines.observability.metrics import MetricsRegistry
+    from tpu_pipelines.serving.generative import (
+        EngineOverloaded,
+        GenerativeEngine,
+    )
+
+    reg = MetricsRegistry()
+    fns = make_stub_fns()
+    engine = GenerativeEngine(
+        fns, {}, max_batch_size=2, max_queue_tokens=5, registry=reg,
+        replica="0",
+    )
+    try:
+        with pytest.raises(EngineOverloaded, match="exceed the bound"):
+            engine.submit_nowait(np.asarray([3], np.int32), max_new_tokens=8)
+        shed = reg.get("serving_decode_shed_total")
+        assert shed.labels("0").get() == 1
+        # Within the bound the same request is admitted.
+        out = engine.submit(
+            np.asarray([3], np.int32), max_new_tokens=5, timeout_s=30.0
+        )
+        assert len(out) >= 1
+    finally:
+        engine.close()
+
+
+def test_engine_hard_deadline_eviction():
+    """A sequence that blows its token-proportional deadline under
+    ``hard_deadline`` is evicted with ``GenerationEvicted`` and its slot
+    freed; without the flag the same SLO only prices the deadline."""
+    from tpu_pipelines.observability.metrics import MetricsRegistry
+    from tpu_pipelines.serving.generative import (
+        GenerationEvicted,
+        GenerativeEngine,
+    )
+
+    reg = MetricsRegistry()
+    fns = make_stub_fns()
+    # Pick a seed whose isolated stream does NOT terminate early.
+    inp = None
+    for seed_try in range(1, 40):
+        cand = np.asarray([seed_try], np.int32)
+        if len(ref_stream(cand, 10)) == 10:
+            inp = cand
+            break
+    assert inp is not None
+    engine = GenerativeEngine(
+        fns, {}, max_batch_size=2, slo_ms_per_token=1e-6,
+        hard_deadline=True, registry=reg, replica="0",
+    )
+    try:
+        h = engine.submit_nowait(inp, max_new_tokens=10)
+        with pytest.raises(GenerationEvicted, match="deadline"):
+            h.wait(30.0)
+        assert reg.get("serving_decode_evicted_total").labels("0").get() == 1
+        deadline = time.monotonic() + 5
+        while not engine.idle() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert engine.idle()  # the slot was freed for admissible work
+    finally:
+        engine.close()
+
+    # Same SLO without hard_deadline: the generation completes.
+    engine2 = GenerativeEngine(
+        fns, {}, max_batch_size=2, slo_ms_per_token=1e-6,
+        hard_deadline=False,
+    )
+    try:
+        out = engine2.submit(inp, max_new_tokens=10, timeout_s=30.0)
+        assert [int(t) for t in out] == ref_stream(inp, 10)
+    finally:
+        engine2.close()
+
+
+def test_engine_close_fails_pending():
+    from tpu_pipelines.serving.generative import (
+        GenerationEvicted,
+        GenerativeEngine,
+    )
+
+    fns = make_stub_fns()
+    engine = GenerativeEngine(fns, {}, max_batch_size=2)
+    engine.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        engine.submit_nowait(np.asarray([1], np.int32))
+
+    # Pending work at close is failed with the eviction verdict, not
+    # left hanging.
+    engine2 = GenerativeEngine(fns, {}, max_batch_size=1)
+    hs = [
+        engine2.submit_nowait(np.asarray([s], np.int32), max_new_tokens=12)
+        for s in (3, 4, 5, 6)
+    ]
+    engine2.close(timeout_s=5.0)
+    evicted = 0
+    for h in hs:
+        try:
+            h.wait(5.0)
+        except GenerationEvicted:
+            evicted += 1
+    # The engine was closed mid-schedule: at least the queued tail cannot
+    # have finished.
+    assert evicted >= 1
+
+
+def test_engine_warmup_contract_and_telemetry():
+    """No decode step compiles after ``warm()`` (the no-mid-traffic-XLA
+    acceptance), and the serving_decode_* family is published."""
+    from tpu_pipelines.observability.metrics import MetricsRegistry
+    from tpu_pipelines.serving.generative import GenerativeEngine
+
+    reg = MetricsRegistry()
+    fns = make_stub_fns()
+    engine = GenerativeEngine(
+        fns, {}, max_batch_size=4, page_size=4, registry=reg, replica="0",
+    )
+    try:
+        engine.warm()
+        rng = np.random.default_rng(5)
+        handles = [
+            engine.submit_nowait(
+                rng.integers(1, VOCAB, size=(3,)).astype(np.int32),
+                max_new_tokens=int(rng.integers(2, 12)),
+            )
+            for _ in range(10)
+        ]
+        for h in handles:
+            h.wait(30.0)
+    finally:
+        engine.close()
+    assert engine.compiles_after_warm == 0
+    assert engine.steps_run > 0
+    assert reg.get("serving_decode_steps_total").labels("0").get() == (
+        engine.steps_run
+    )
+    assert reg.get("serving_decode_tokens_total").labels("0").get() > 0
+    assert reg.get("serving_decode_sequences_total").labels("0").get() == 10
+    occ = reg.get("serving_decode_batch_occupancy").labels("0").get()
+    assert 0.0 < occ <= 1.0
+    assert reg.get("serving_decode_cache_pages_in_use") is not None
+    scrape = reg.to_prometheus()
+    assert (
+        'serving_decode_per_token_latency_seconds_count{replica="0"} 10'
+        in scrape
+    )
+
+
+# ----------------------------------------------------- real-model parity
+
+
+@pytest.fixture(scope="module")
+def tiny_t5():
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_pipelines.models.t5 import T5
+
+    tiny = dict(
+        vocab_size=48, d_model=16, n_layers=2, n_heads=2, head_dim=8,
+        d_ff=32, dropout_rate=0.0, dtype=jnp.float32,
+    )
+    model = T5(**tiny)
+    batch = {
+        "inputs": np.arange(12, dtype=np.int32).reshape(2, 6) % 13 + 2,
+        "targets": np.ones((2, 5), np.int32),
+    }
+    params = model.init(jax.random.key(0), batch)["params"]
+    return model, params
+
+
+def test_engine_bitwise_identity_vs_isolated_greedy_t5(tiny_t5):
+    """Acceptance: the continuous-batch arena path (vector ``decode_pos``,
+    bucketed steps, slot moves) reproduces isolated
+    ``make_greedy_generate`` token streams BITWISE on a real T5, under a
+    staggered arrival schedule, with zero post-warm compiles."""
+    from tpu_pipelines.models.t5 import (
+        make_continuous_decode_fns,
+        make_greedy_generate,
+    )
+    from tpu_pipelines.serving.generative import GenerativeEngine
+
+    model, params = tiny_t5
+    L = 8
+    fns = make_continuous_decode_fns(
+        model, max_decode_len=L, eos_id=1, max_input_len=6
+    )
+    greedy = make_greedy_generate(model, max_decode_len=L, eos_id=1)
+    rng = np.random.default_rng(0)
+    reqs = [
+        rng.integers(2, 40, size=(int(rng.integers(2, 7)),)).astype(np.int32)
+        for _ in range(8)
+    ]
+    iso = []
+    for r in reqs:
+        toks, _ = greedy(params, r[None], np.ones((1, len(r)), np.int32))
+        row = [int(t) for t in np.asarray(toks)[0]]
+        if 1 in row:
+            row = row[: row.index(1) + 1]
+        iso.append(row)
+
+    engine = GenerativeEngine(fns, params, max_batch_size=4, page_size=0)
+    try:
+        engine.warm()
+        handles = []
+        for i, r in enumerate(reqs):
+            handles.append(engine.submit_nowait(r, max_new_tokens=L))
+            if i % 3 == 0:
+                time.sleep(0.01)
+        outs = [h.wait(60.0) for h in handles]
+    finally:
+        engine.close()
+    assert engine.compiles_after_warm == 0
+    for out, ref in zip(outs, iso):
+        assert [int(t) for t in out] == ref
+
+
+def test_flash_decode_kernel_matches_dense():
+    """The single-query flash-decode kernel (online-softmax over KV
+    blocks) matches dense cache attention with per-row validity masks and
+    both broadcast and per-batch relative-position bias."""
+    import jax.numpy as jnp
+
+    from tpu_pipelines.models.transformer import dense_attention
+    from tpu_pipelines.ops.flash_attention import flash_decode_attention
+
+    rng = np.random.default_rng(0)
+    b, l, h, d = 3, 128, 2, 8
+    q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, l, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, l, h, d)), jnp.float32)
+    pos = np.array([5, 63, 127])
+    mask = jnp.asarray(
+        (np.arange(l)[None, :] <= pos[:, None]).astype(np.int32)
+    )
+    for bias_shape in (None, (1, h, 1, l), (b, h, 1, l)):
+        bias = (
+            None if bias_shape is None
+            else jnp.asarray(rng.standard_normal(bias_shape), jnp.float32)
+        )
+        ref = dense_attention(
+            q, k, v, causal=False, kv_mask=mask, bias=bias
+        )
+        got = flash_decode_attention(
+            q, k, v, kv_mask=mask, bias=bias, block_k=32, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+
+def test_choose_decode_impl_uses_measured_crossover(tmp_path, monkeypatch):
+    """The decode-regime "auto" rule: dense with no measurement, flash
+    at/above a recorded crossover KV length, dense below it — its OWN
+    table entry, independent of the training-shape crossover."""
+    from tpu_pipelines.models.transformer import choose_decode_impl
+    from tpu_pipelines.ops import autotune
+
+    monkeypatch.setenv("TPP_AUTOTUNE_CACHE", str(tmp_path / "cache"))
+    kind = autotune.current_device_kind()
+    # Never measured: the kernel has not earned the hot path.
+    assert choose_decode_impl(4, 8, 4096, 64) == "dense"
+    autotune.record_decode_crossover(kind, 1024, {"heads": 8})
+    assert autotune.lookup_decode_crossover(kind) == 1024
+    assert choose_decode_impl(4, 8, 4096, 64) == "flash"
+    assert choose_decode_impl(4, 8, 1024, 64) == "flash"
+    assert choose_decode_impl(4, 8, 512, 64) == "dense"
+    # Measured-no-crossover (dense won everywhere): explicit None.
+    autotune.record_decode_crossover(kind, None)
+    assert autotune.lookup_decode_crossover(kind) is None
+    assert choose_decode_impl(4, 8, 8192, 64) == "dense"
+
+
+def test_sweep_decode_times_block_k(monkeypatch):
+    """The decode sweep times real kernels (interpret mode on CPU) over a
+    1-D block_k grid and returns a best entry."""
+    monkeypatch.setenv("TPP_AUTOTUNE_ITERS", "1")
+    import jax.numpy as jnp
+
+    from tpu_pipelines.ops import autotune
+
+    out = autotune.sweep_decode(
+        2, 2, 128, 8, jnp.float32, True,
+        pairs=[(8, 64), (8, 128)], iters=1,
+    )
+    res = out["flash_decode"]
+    assert res["best"] is not None
+    assert res["best"]["block_k"] in (64, 128)
+    assert all("ms" in r or "error" in r for r in res["swept"])
+
+
+# ------------------------------------------------- fleet / REST surface
+
+
+class FakeGenLoaded:
+    """Stub LoadedModel carrying the continuous-decode contract: the
+    per-version ``offset`` shifts every token, so streams prove WHICH
+    version served them (the drain-across-hot-swap evidence)."""
+
+    def __init__(self, offset):
+        self.offset = offset
+        self.params = {"offset": int(offset)}
+        self.decode_fns = make_stub_fns()
+        self.generate = None
+        self.transform = None
+
+    def predict(self, batch):
+        return np.asarray(batch["inputs"], np.float64) + self.offset
+
+    predict_transformed = predict
+
+
+def _gen_payload(base, version, offset):
+    vdir = base / str(version)
+    vdir.mkdir(parents=True)
+    (vdir / "offset.txt").write_text(str(offset))
+    return str(vdir)
+
+
+def _gen_loader(version_dir):
+    import os
+
+    with open(os.path.join(version_dir, "offset.txt")) as f:
+        return FakeGenLoaded(int(f.read()))
+
+
+@pytest.fixture
+def gen_loader(monkeypatch):
+    monkeypatch.setattr(
+        "tpu_pipelines.serving.fleet.versions._default_loader", _gen_loader
+    )
+    return _gen_loader
+
+
+def _post(url, body=b"{}", timeout=30):
+    req = urllib.request.Request(url, data=body)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_replica_engines_drain_and_prune_across_versions(
+    tmp_path, gen_loader
+):
+    """The engine half of drain-then-evict: each resident version gets
+    its own warmed engine; once a version drains out of residency and
+    its engine idles, the engine is pruned."""
+    from tpu_pipelines.serving.fleet import ServingFleet
+
+    base = tmp_path / "m"
+    d1 = _gen_payload(base, 1, 0)
+    d2 = _gen_payload(base, 2, 3)
+    fleet = ServingFleet(
+        "m", str(base), replicas=1, max_versions=1,
+        model_type="generative", max_batch_size=2,
+    )
+    try:
+        fleet.load_version(d1)
+        replica = fleet.pool.replicas[0]
+        assert set(replica._engines) == {"1"}
+        out1 = fleet.generate_submit(
+            {"inputs": np.asarray([[3, 5]], np.int32)},
+            {"max_new_tokens": 6},
+        )
+        assert [int(t) for t in out1[0]] == ref_stream(
+            np.asarray([3, 5]), 6
+        )
+        # Hot-swap: v2 becomes active (and with max_versions=1, v1 left
+        # residency the moment its lease count hit zero).
+        fleet.load_version(d2)
+        out2 = fleet.generate_submit(
+            {"inputs": np.asarray([[3, 5]], np.int32)},
+            {"max_new_tokens": 6},
+        )
+        assert [int(t) for t in out2[0]] == ref_stream(
+            np.asarray([3, 5]), 6, offset=3
+        )
+        # The request that leased v2 also pruned v1's idle engine.
+        assert set(replica._engines) == {"2"}
+        assert fleet.health()["outstanding_decode_tokens"] == 0
+    finally:
+        fleet.close()
+
+
+def test_generative_rest_surface(tmp_path, gen_loader):
+    """REST e2e on the generative model type: token streams, submit-time
+    4xx for malformed generation params, and decode telemetry on the
+    server's own scrape."""
+    from tpu_pipelines.serving import ModelServer
+
+    base = tmp_path / "m"
+    _gen_payload(base, 1, 0)
+    server = ModelServer(
+        "toy", str(base), model_type="generative", max_batch_size=4,
+    )
+    assert server._fleet is not None and server._fleet.generative
+    port = server.start()
+    url = f"http://127.0.0.1:{port}/v1/models/toy:generate"
+    try:
+        # Mixed true lengths ride a padded batch + mask (REST instances
+        # are columnar); the engine decodes each row to its OWN length.
+        body = json.dumps({
+            "instances": [
+                {"inputs": [3, 5, 0], "input_mask": [1, 1, 0]},
+                {"inputs": [2, 2, 4], "input_mask": [1, 1, 1]},
+            ],
+            "params": {"max_new_tokens": 6},
+        }).encode()
+        status, out = _post(url, body)
+        assert status == 200
+        rows = out["outputs"]
+        ref0 = ref_stream(np.asarray([3, 5]), 6)
+        ref1 = ref_stream(np.asarray([2, 2, 4]), 6)
+        width = max(len(ref0), len(ref1))
+        assert rows[0] == ref0 + [0] * (width - len(ref0))
+        assert rows[1] == ref1 + [0] * (width - len(ref1))
+
+        # Malformed generation params: a 400 at submit time.
+        for bad in (
+            {"max_new_tokens": 0},
+            {"max_new_tokens": 99},
+            {"temperature": 0.7},
+        ):
+            bad_body = json.dumps({
+                "instances": [{"inputs": [3, 5]}], "params": bad,
+            }).encode()
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(url, bad_body)
+            assert err.value.code == 400
+
+        # Health + scrape carry the decode family.
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10
+        ) as r:
+            health = json.loads(r.read())
+        assert health["fleet"]["model_type"] == "generative"
+        assert health["fleet"]["outstanding_decode_tokens"] == 0
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as r:
+            scrape = r.read().decode()
+        assert 'serving_decode_steps_total{replica="0"}' in scrape
+        assert 'serving_decode_sequences_total{replica="0"} 2' in scrape
+        assert "serving_decode_per_token_latency_seconds" in scrape
+    finally:
+        server.stop()
+
+
+def test_generative_hot_swap_with_inflight_generations(
+    tmp_path, gen_loader
+):
+    """Acceptance: a generate hammer runs ACROSS a version hot-swap —
+    zero non-200 anywhere, every stream valid for the version that
+    served it (v1 or v2, never a mix), and the new version serves after
+    the swap."""
+    from tpu_pipelines.serving import ModelServer
+
+    base = tmp_path / "m"
+    _gen_payload(base, 1, 0)
+    server = ModelServer(
+        "toy", str(base), model_type="generative", max_batch_size=4,
+        max_versions=2,
+    )
+    port = server.start()
+    url = f"http://127.0.0.1:{port}/v1/models/toy:generate"
+    inp = [3, 5]
+    ref_v1 = ref_stream(np.asarray(inp), 8)
+    ref_v2 = ref_stream(np.asarray(inp), 8, offset=3)
+    body = json.dumps({
+        "instances": [{"inputs": inp}], "params": {"max_new_tokens": 8},
+    }).encode()
+    errors, streams = [], []
+    lock = threading.Lock()
+
+    def fire(n):
+        for _ in range(n):
+            try:
+                status, out = _post(url, body)
+                with lock:
+                    if status != 200:
+                        errors.append(status)
+                    else:
+                        streams.append(out["outputs"][0])
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(repr(e))
+
+    try:
+        fire(2)  # warm the path
+        threads = [
+            threading.Thread(target=fire, args=(20,)) for _ in range(3)
+        ]
+        for t in threads:
+            t.start()
+        _gen_payload(base, 2, 3)
+        status, reply = _post(f"http://127.0.0.1:{port}/v1/models/toy:reload")
+        assert (status, reply["version"]) == (200, "2")
+        for t in threads:
+            t.join()
+        assert errors == []
+        # Every stream is a complete, valid decode of exactly one version
+        # — an in-flight generation finished on the version it started on.
+        for s in streams:
+            assert s in (ref_v1, ref_v2), s
+        # Post-swap traffic decodes on v2.
+        _, out = _post(url, body)
+        assert out["outputs"][0] == ref_v2
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as r:
+            scrape = r.read().decode()
+    finally:
+        server.stop()
+    import re
+
+    assert not re.search(r'serving_requests_total\{[^}]*code="5', scrape)
+
+
+def test_generative_token_admission_429(tmp_path, gen_loader):
+    """The generate door counts outstanding TOKENS: with a 1-token bound
+    and a wedged... rather, a tiny bound, concurrent long generations
+    shed with 429 + Retry-After instead of queueing into the SLO cliff."""
+    from tpu_pipelines.serving import ModelServer
+
+    base = tmp_path / "m"
+    _gen_payload(base, 1, 0)
+    server = ModelServer(
+        "toy", str(base), model_type="generative", max_batch_size=1,
+        max_queue_tokens=4,
+    )
+    port = server.start()
+    url = f"http://127.0.0.1:{port}/v1/models/toy:generate"
+    try:
+        # One request whose token budget exceeds the engine bound: the
+        # ENGINE sheds it (EngineOverloaded -> 429 + Retry-After).
+        body = json.dumps({
+            "instances": [{"inputs": [3, 5]}],
+            "params": {"max_new_tokens": 8},
+        }).encode()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(url, body)
+        assert err.value.code == 429
+        assert err.value.headers.get("Retry-After") is not None
+        # Within the bound: served.
+        ok_body = json.dumps({
+            "instances": [{"inputs": [3, 5]}],
+            "params": {"max_new_tokens": 3},
+        }).encode()
+        status, out = _post(url, ok_body)
+        assert status == 200
+        assert out["outputs"][0] == ref_stream(np.asarray([3, 5]), 3)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as r:
+            scrape = r.read().decode()
+        assert 'serving_decode_shed_total{replica="0"} 1' in scrape
+    finally:
+        server.stop()
+
+
+def test_generative_env_knobs(tmp_path, gen_loader, monkeypatch):
+    from tpu_pipelines.serving import ModelServer
+
+    base = tmp_path / "m"
+    _gen_payload(base, 1, 0)
+    monkeypatch.setenv("TPP_SERVING_MODEL_TYPE", "generative")
+    monkeypatch.setenv("TPP_SERVING_PAGE_SIZE", "4")
+    monkeypatch.setenv("TPP_SERVING_MAX_TOKENS", "64")
+    monkeypatch.setenv("TPP_SERVING_SLO_MS_PER_TOKEN", "5")
+    server = ModelServer("toy", str(base), max_batch_size=2)
+    try:
+        assert server.model_type == "generative"
+        assert server.decode_page_size == 4
+        assert server.max_queue_tokens == 64
+        assert server.slo_ms_per_token == 5.0
+        assert server._fleet is not None and server._fleet.generative
+        eng = server._fleet.pool.replicas[0]._engines["1"]
+        assert eng.page_size == 4
+        assert eng.max_queue_tokens == 64
+        assert eng.slo_ms_per_token == 5.0
+    finally:
+        server.stop()
+
+
+def test_non_generative_payload_refused_by_canary(tmp_path, monkeypatch):
+    """A generative fleet refuses a payload with no decode contract at
+    the CANARY gate: the push is a 4xx-class verdict, serving state
+    untouched."""
+    from tpu_pipelines.serving.fleet import CanaryRefused, ServingFleet
+
+    class NoDecode:
+        params = {}
+        decode_fns = None
+        generate = None
+        transform = None
+
+        def predict(self, batch):
+            return np.asarray(batch["inputs"], np.float64)
+
+        predict_transformed = predict
+
+    monkeypatch.setattr(
+        "tpu_pipelines.serving.fleet.versions._default_loader",
+        lambda vdir: NoDecode(),
+    )
+    base = tmp_path / "m"
+    vdir = base / "1"
+    vdir.mkdir(parents=True)
+    fleet = ServingFleet(
+        "m", str(base), replicas=1, model_type="generative",
+        max_batch_size=2,
+    )
+    try:
+        with pytest.raises(CanaryRefused, match="generative warmup"):
+            fleet.load_version(str(vdir))
+        assert fleet.active_version is None
+    finally:
+        fleet.close()

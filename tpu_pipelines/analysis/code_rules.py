@@ -461,6 +461,59 @@ def _check_flash_below_crossover(
     return out
 
 
+# Keys whose presence in a serving call/config declares the payload
+# autoregressive (decode geometry the predict path never takes).
+_DECODE_KEYS = ("max_decode_len", "max_new_tokens", "beam_size")
+
+
+def _check_whole_request_decode(
+    src: _Source, node_id: str, fn_label: str
+) -> List[Finding]:
+    """TPP209: autoregressive decode geometry configured next to an
+    explicit non-generative serving ``model_type``.
+
+    A whole-request-batching endpoint serves a generation for its FULL
+    decode before any co-batched request advances — one long generation
+    pins its replica (the t5_decode beam-4 vs greedy gap, ISSUE 11).
+    Fires only when one call / dict literal pins BOTH facts statically:
+    ``model_type`` a string constant other than "generative" AND a decode
+    key (``max_decode_len``/``max_new_tokens``/``beam_size``) an int
+    constant.  Configs that omit model_type (training hparams, predict
+    deployments) stay silent.
+    """
+    out: List[Finding] = []
+    for node in ast.walk(src.tree):
+        pairs = dict(_const_str_pairs(node))
+        mt = pairs.get("model_type")
+        if not (
+            isinstance(mt, ast.Constant)
+            and isinstance(mt.value, str)
+            and mt.value != "generative"
+        ):
+            continue
+        decode_key = None
+        for name in _DECODE_KEYS:
+            val = pairs.get(name)
+            if isinstance(val, ast.Constant) and isinstance(val.value, int):
+                decode_key = name
+                break
+        if decode_key is None:
+            continue
+        f = _finding(
+            src, mt, "TPP209", WARN, node_id,
+            f"{fn_label}: model_type={mt.value!r} with autoregressive "
+            f"decode geometry ({decode_key}) — whole-request batching "
+            "serves each generation to completion, so one long decode "
+            "pins its replica and stalls every co-batched request",
+            'set model_type="generative" (continuous batching: sequences '
+            "join per decode step and leave at EOS; serving/generative.py, "
+            "docs/SERVING.md)",
+        )
+        if f:
+            out.append(f)
+    return out
+
+
 def _check_closure_staleness(
     src: _Source, node_id: str, fn_label: str, fn: Callable
 ) -> List[Finding]:
@@ -511,6 +564,7 @@ def check_callable(
     out.extend(_check_map_shards_payload(src, node_id, label, fn))
     out.extend(_check_window_host_traffic(src, node_id, label))
     out.extend(_check_flash_below_crossover(src, node_id, label))
+    out.extend(_check_whole_request_decode(src, node_id, label))
     return out
 
 

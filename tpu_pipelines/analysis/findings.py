@@ -124,6 +124,14 @@ RULES: Dict[str, Dict[str, str]] = {
                  "crossover — dense attention measured faster there on "
                  "every tuned device",
     },
+    "TPP209": {
+        "severity": WARN,
+        "title": "autoregressive model configured on a whole-request-"
+                 "batching serving endpoint — one long generation pins "
+                 "its replica for the full decode; continuous batching "
+                 '(model_type="generative") serves at the decode-step '
+                 "level",
+    },
 }
 
 GRAPH_RULE_PREFIX = "TPP1"

@@ -73,11 +73,21 @@ class RelativePositionBias(nn.Module):
             (self.num_buckets, self.n_heads),
         )
         if row is not None:
-            # Incremental decode: only query position ``row`` is live this
-            # step — slice its bucket row so the bias is [1, h, 1, klen].
-            buckets = jax.lax.dynamic_slice_in_dim(
-                buckets, jnp.asarray(row, jnp.int32), 1, axis=0
-            )
+            row = jnp.asarray(row, jnp.int32)
+            if row.ndim == 0:
+                # Incremental decode: only query position ``row`` is live
+                # this step — slice its bucket row so the bias is
+                # [1, h, 1, klen].
+                buckets = jax.lax.dynamic_slice_in_dim(buckets, row, 1, axis=0)
+            else:
+                # Continuous batching: each batch row sits at its OWN
+                # decode position, so gather one bucket row per sequence —
+                # bias [b, h, 1, klen], row i carrying position row[i]'s
+                # slice of the full relative-position matrix.
+                rows = jnp.take(buckets, row, axis=0)      # [b, klen]
+                return jnp.transpose(
+                    table[rows], (0, 2, 1)
+                )[:, :, None, :].astype(jnp.float32)
         # [q, k, h] -> [1, h, q, k] additive bias
         return jnp.transpose(table[buckets], (2, 0, 1))[None].astype(jnp.float32)
 
@@ -91,6 +101,11 @@ class T5Stack(nn.Module):
     dtype: Any
     causal: bool          # True = decoder
     mesh: Optional[Mesh] = None
+    # Forwarded to the attention blocks.  T5's biased self-attention always
+    # takes the dense path in training/full passes; the knob matters for
+    # the single-query DECODE step, where "flash"/"auto" select the
+    # flash-decode kernel against the KV cache (ops/flash_attention.py).
+    attn_impl: str = "dense"
 
     @nn.compact
     def __call__(self, x, *, encoded=None, kv_mask=None, enc_mask=None,
@@ -118,6 +133,7 @@ class T5Stack(nn.Module):
                 causal=self.causal, prenorm=True, norm="rmsnorm",
                 mlp_dropout_site="hidden",   # T5's DenseReluDense recipe
                 use_cross=self.causal and encoded is not None,
+                attn_impl=self.attn_impl,
                 mesh=self.mesh, name=f"layer_{i}",
             )(
                 x, encoded=encoded, kv_mask=kv_mask, enc_mask=enc_mask,
@@ -143,6 +159,7 @@ class T5(nn.Module):
     dropout_rate: float = 0.1
     dtype: Any = jnp.bfloat16
     mesh: Optional[Mesh] = None
+    attn_impl: str = "dense"   # decode-step kernel choice; see T5Stack
 
     def setup(self):
         self.shared = nn.Embed(
@@ -151,6 +168,7 @@ class T5(nn.Module):
         common = dict(
             n_heads=self.n_heads, head_dim=self.head_dim, d_ff=self.d_ff,
             dropout_rate=self.dropout_rate, dtype=self.dtype, mesh=self.mesh,
+            attn_impl=self.attn_impl,
         )
         self.encoder = T5Stack(n_layers=self.n_layers, causal=False,
                                name="encoder", **common)
@@ -217,6 +235,7 @@ def build_t5_model(hparams: Dict, mesh: Optional[Mesh] = None) -> T5:
         head_dim=int(hp["head_dim"]),
         d_ff=int(hp["d_ff"]),
         dropout_rate=float(hp["dropout_rate"]),
+        attn_impl=str(hp.get("attn_impl", "dense")),
         mesh=mesh,
     )
 
@@ -253,6 +272,84 @@ def _decode_one(model, params, cache, tok, encoded, enc_mask, pos,
     return mut["cache"], logits[:, 0]
 
 
+def prefill_decode(model, params, inputs, input_mask, max_decode_len: int,
+                   pad_id: int = 0):
+    """Encoder pass + the cache-creating step-0 decoder pass, once per ROW.
+
+    The shared front half of every decode entry point: greedy, beam
+    (which tiles this result across beams instead of re-running the
+    encoder K/V projections and the step-0 decoder pass per beam) and the
+    continuous-batching engine's per-request prefill
+    (serving/generative.py) all run the identical step-0 math through
+    here.  Returns ``(cache, encoded, logits0 [b, V])`` — the cache holds
+    the BOS K/V at position 0 plus the cross-attention K/V projected from
+    ``encoded``.
+    """
+    encoded = model.apply(
+        {"params": params}, inputs, input_mask, method=T5.encode
+    )
+    bos = jnp.full((inputs.shape[0],), pad_id, jnp.int32)
+    cache, logits0 = _decode_one(
+        model, params, None, bos, encoded, input_mask, 0, max_decode_len
+    )
+    return cache, encoded, logits0
+
+
+def make_continuous_decode_fns(
+    model: T5,
+    *,
+    max_decode_len: int = 32,
+    eos_id: int = 1,
+    pad_id: int = 0,
+    max_input_len: int = 64,
+):
+    """Decode fns for the continuous-batching engine (serving/generative.py).
+
+    Returns a namespace with the engine's duck-typed contract:
+
+      - ``prefill(params, inputs [1, enc_len], input_mask)`` ->
+        ``(cache, encoded, logits0)`` — one request's encoder pass + the
+        cache-creating step-0 decoder pass (``prefill_decode``, the same
+        math greedy/beam step 0 runs);
+      - ``step(params, cache, tok [b], pos [b], encoded, enc_mask, klen)``
+        -> ``(cache, logits [b, V])`` — ONE decode step for a batch whose
+        rows sit at per-row positions ``pos``, over a cache sliced to the
+        static KV bucket ``klen`` (the engine's paged-arena slice; the
+        per-row masking makes the result independent of ``klen`` as long
+        as every live position fits);
+      - geometry/vocabulary constants (``max_decode_len``, ``eos_id``,
+        ``pad_id``, ``max_input_len``) the engine sizes its arena from.
+
+    Exported modules opt their payloads into generative serving by
+    defining ``make_decode_fns(model, hyperparameters)`` returning this
+    (trainer/export.py wires it onto ``LoadedModel.decode_fns``).
+    """
+    from types import SimpleNamespace
+
+    def prefill(params, inputs, input_mask=None):
+        return prefill_decode(
+            model, params, inputs, input_mask, max_decode_len, pad_id
+        )
+
+    def step(params, cache, tok, pos, encoded, enc_mask, klen: int):
+        variables = {"params": params, "cache": cache}
+        logits, mut = model.apply(
+            variables, tok[:, None], encoded, enc_mask=enc_mask,
+            decode_pos=pos, max_decode_len=klen,
+            method=T5.decode, mutable=["cache"],
+        )
+        return mut["cache"], logits[:, 0]
+
+    return SimpleNamespace(
+        prefill=prefill,
+        step=step,
+        max_decode_len=int(max_decode_len),
+        eos_id=int(eos_id),
+        pad_id=int(pad_id),
+        max_input_len=int(max_input_len),
+    )
+
+
 def make_greedy_generate(
     model: T5,
     *,
@@ -284,17 +381,12 @@ def make_greedy_generate(
             raise ValueError("sampling (temperature > 0) requires rng")
         if rng is None:
             rng = jax.random.key(0)
-        encoded = model.apply(
-            {"params": params}, inputs, input_mask, method=T5.encode
-        )
-        b = inputs.shape[0]
-        bos = jnp.full((b,), pad_id, jnp.int32)
-
-        # Step 0 runs outside the scan: its mutable apply CREATES the cache
-        # collection, so the scan carry has a fixed structure.
+        # Step 0 runs outside the scan (prefill_decode): its mutable apply
+        # CREATES the cache collection, so the scan carry has a fixed
+        # structure.
         rng, r0 = jax.random.split(rng)
-        cache, logits0 = _decode_one(
-            model, params, None, bos, encoded, input_mask, 0, max_decode_len
+        cache, encoded, logits0 = prefill_decode(
+            model, params, inputs, input_mask, max_decode_len, pad_id
         )
         tok0 = pick(logits0, r0)
         finished0 = tok0 == eos_id
@@ -342,10 +434,19 @@ def make_beam_generate(
 
     def fn(params, inputs, input_mask=None):
         b, k = inputs.shape[0], beam_size
-        encoded = model.apply(
-            {"params": params}, inputs, input_mask, method=T5.encode
+        # Encoder + step-0 decoder run ONCE PER ROW (prefill_decode — the
+        # same entry greedy and the continuous-batch engine use) and the
+        # result is TILED across beams below: the k beams of a row are
+        # identical at step 0, so the old flat [b*k] step 0 re-ran the
+        # encoder K/V projections and the BOS decoder pass k x for
+        # nothing.
+        cache, encoded, logits0 = prefill_decode(
+            model, params, inputs, input_mask, max_decode_len, pad_id
         )
-        # Flat [b*k, ...] layout: beam j of row i lives at i*k + j.
+        # Flat [b*k, ...] layout: beam j of row i lives at i*k + j.  The
+        # cross-attention K/V ride inside the tiled cache; flat_encoded
+        # is only the decode call's x_kv placeholder from here on (the
+        # cached projections are what attention reads), so XLA DCEs it.
         flat_encoded = jnp.repeat(encoded, k, axis=0)
         flat_enc_mask = (
             None if input_mask is None else jnp.repeat(input_mask, k, axis=0)
@@ -384,25 +485,18 @@ def make_beam_generate(
                 return out.reshape(x.shape)
             return jax.tree_util.tree_map_with_path(leaf, tree)
 
-        bos = jnp.full((b * k,), pad_id, jnp.int32)
-        cache, logits0 = _decode_one(
-            model, params, None, bos, flat_encoded, flat_enc_mask, 0,
-            max_decode_len,
-        )
         vocab = logits0.shape[-1]
-        logprobs0 = jax.nn.log_softmax(
-            logits0.astype(jnp.float32)
-        ).reshape(b, k, vocab)
-        # All beams start identical: only beam 0 is live at step 0, so the
-        # first topk selects k DISTINCT first tokens instead of k copies.
-        init_live = jnp.where(
-            jnp.arange(k) == 0, 0.0, -jnp.inf
-        )[None, :, None]
-        top0, idx0 = jax.lax.top_k(
-            (logprobs0 + init_live).reshape(b, k * vocab), k
+        logprobs0 = jax.nn.log_softmax(logits0.astype(jnp.float32))  # [b, V]
+        # All beams share the step-0 distribution, so one top-k over the
+        # per-row vocab picks the k DISTINCT first tokens directly.
+        top0, idx0 = jax.lax.top_k(logprobs0, k)
+        tok0 = idx0.astype(jnp.int32)                   # [b, k]
+        # Tile the shared step-0 state into the beam layout: self-KV row 0
+        # (the BOS K/V) is identical across beams, and the cross-attention
+        # K/V were projected once per row instead of once per beam.
+        cache = jax.tree_util.tree_map(
+            lambda x: jnp.repeat(x, k, axis=0), cache
         )
-        tok0 = (idx0 % vocab).astype(jnp.int32)
-        cache = reorder(cache, idx0 // vocab)
         logp = top0                                     # [b, k]
         finished = tok0 == eos_id
         lengths = jnp.ones((b, k), jnp.int32)

@@ -153,6 +153,33 @@ def choose_attn_impl(
     return "dense"
 
 
+def choose_decode_impl(
+    batch: int,
+    heads: int,
+    kv_len: int,
+    head_dim: int,
+) -> str:
+    """The "auto" rule for the single-query DECODE regime (KV-cache
+    attention during autoregressive generation).
+
+    A decode step's score temporaries are [B, H, 1, L] — tiny — so there
+    is no OOM guard here; the only question is measured speed.  The
+    decode step streams the whole KV cache per token, a bandwidth-bound
+    profile unlike the training shapes, so it gets its OWN crossover
+    (``autotune.lookup_decode_crossover``, recorded by the bench
+    ``t5_decode`` leg): flash-decode at/above the measured cache length,
+    dense below it, and dense whenever no measurement exists — the
+    kernel must earn the hot path, same as training flash (PR 9).
+    """
+    del batch, heads, head_dim  # keyed per device kind + cache length only
+    from tpu_pipelines.ops import autotune
+
+    crossover = autotune.lookup_decode_crossover()
+    if crossover is not None and kv_len >= crossover:
+        return "flash"
+    return "dense"
+
+
 class MlpBlock(nn.Module):
     d_ff: int
     dropout_rate: float = 0.0
@@ -384,6 +411,12 @@ class MultiHeadAttention(nn.Module):
             # The cache is a flax "cache" collection created on the first
             # mutable apply — static shapes keep the whole decode loop
             # jit/scan-compatible (no growing arrays).
+            #
+            # ``decode_pos`` may be a scalar (every row at the same step:
+            # the greedy/beam scan) or a [b] vector (continuous batching:
+            # each sequence in the batch sits at its OWN step, so the
+            # update is a per-row scatter and the validity mask is
+            # per-row).  Both paths compute identical per-row math.
             if max_decode_len is None:
                 raise ValueError("decode_pos requires max_decode_len")
             b = q.shape[0]
@@ -396,19 +429,46 @@ class MultiHeadAttention(nn.Module):
                 (b, max_decode_len, self.n_heads, self.head_dim), v.dtype,
             )
             pos = jnp.asarray(decode_pos, jnp.int32)
-            cached_k.value = jax.lax.dynamic_update_slice_in_dim(
-                cached_k.value, k, pos, axis=1
-            )
-            cached_v.value = jax.lax.dynamic_update_slice_in_dim(
-                cached_v.value, v, pos, axis=1
-            )
-            # Positions after ``pos`` are zeros (future steps): mask them.
-            valid = (jnp.arange(max_decode_len) <= pos)[None, :]
-            out = dense_attention(
-                q, cached_k.value, cached_v.value, causal=False,
-                kv_mask=jnp.broadcast_to(valid, (b, max_decode_len)),
-                bias=bias,
-            )
+            if pos.ndim == 0:
+                cached_k.value = jax.lax.dynamic_update_slice_in_dim(
+                    cached_k.value, k, pos, axis=1
+                )
+                cached_v.value = jax.lax.dynamic_update_slice_in_dim(
+                    cached_v.value, v, pos, axis=1
+                )
+                # Positions after ``pos`` are zeros (future steps): mask.
+                valid = jnp.broadcast_to(
+                    (jnp.arange(max_decode_len) <= pos)[None, :],
+                    (b, max_decode_len),
+                )
+            else:
+                rows = jnp.arange(b)
+                cached_k.value = cached_k.value.at[rows, pos].set(k[:, 0])
+                cached_v.value = cached_v.value.at[rows, pos].set(v[:, 0])
+                valid = jnp.arange(max_decode_len)[None, :] <= pos[:, None]
+            impl = self.attn_impl
+            if impl == "auto":
+                # Decode-regime choice: the single-query step is bandwidth-
+                # bound on the KV cache, a different balance from training
+                # attention — its own measured crossover applies
+                # (choose_decode_impl), never the training-shape one.
+                impl = choose_decode_impl(
+                    b, self.n_heads, max_decode_len, self.head_dim
+                )
+            if impl == "flash":
+                from tpu_pipelines.ops.flash_attention import (
+                    flash_decode_attention,
+                )
+
+                out = flash_decode_attention(
+                    q, cached_k.value, cached_v.value,
+                    kv_mask=valid, bias=bias,
+                )
+            else:
+                out = dense_attention(
+                    q, cached_k.value, cached_v.value, causal=False,
+                    kv_mask=valid, bias=bias,
+                )
             return nn.DenseGeneral(
                 x_q.shape[-1], axis=(-2, -1), dtype=self.dtype, name="out"
             )(out)

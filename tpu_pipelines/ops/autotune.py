@@ -294,6 +294,47 @@ def lookup_crossover(device_kind: Optional[str] = None) -> Optional[int]:
     return None
 
 
+def record_decode_crossover(
+    device_kind: str,
+    crossover_kv_len: Optional[int],
+    geometry: Optional[Dict[str, Any]] = None,
+    source: str = "measured",
+) -> None:
+    """Store the measured flash-decode-vs-dense crossover CACHE length.
+
+    The decode regime (single-query attention against the KV cache during
+    autoregressive generation) is bandwidth-bound on streaming the cache,
+    a different balance from the training shapes — so it carries its own
+    crossover, recorded by the bench ``t5_decode`` leg and consulted by
+    ``models/transformer.py choose_decode_impl``.  ``None`` means "dense
+    won at every measured cache length" (measured-no-crossover, distinct
+    from never-measured)."""
+
+    def mutate(table):
+        table.setdefault("decode_crossover", {})[device_kind] = {
+            "crossover_kv_len": (
+                int(crossover_kv_len)
+                if crossover_kv_len is not None else None
+            ),
+            "geometry": geometry or {},
+            "source": source,
+        }
+
+    _update_table(cache_path(device_kind), mutate)
+
+
+def lookup_decode_crossover(device_kind: Optional[str] = None) -> Optional[int]:
+    """Measured decode-regime crossover KV length for this device, or
+    None when no measurement exists (or dense won everywhere measured)."""
+    kind = device_kind or current_device_kind()
+    for path in (cache_path(kind), _COMMITTED_TABLE):
+        rec = (_load_table(path).get("decode_crossover") or {}).get(kind)
+        if isinstance(rec, dict):
+            v = rec.get("crossover_kv_len")
+            return int(v) if v is not None else None
+    return None
+
+
 def committed_crossovers() -> Dict[str, int]:
     """device_kind -> crossover from the REPO-COMMITTED table only (what
     the TPP208 lint rule consults: reviewable, versioned evidence)."""
@@ -519,6 +560,69 @@ def sweep_flash(
     return results
 
 
+def sweep_decode(
+    batch: int,
+    heads: int,
+    kv_len: int,
+    head_dim: int,
+    dtype: Any,
+    interpret: bool,
+    pairs: Optional[Sequence[Tuple[int, int]]] = None,
+    iters: Optional[int] = None,
+) -> Dict[str, Dict[str, Any]]:
+    """Time candidate ``block_k`` values for the single-query flash-decode
+    kernel (ops/flash_attention.py ``flash_decode_attention``); returns
+    ``{"flash_decode": {best, swept}}``.
+
+    ``block_q`` is not tuned — the one query row is replicated to the
+    dtype's sublane tile, a constant — so the grid here is 1-D over
+    ``block_k``: the knob that sets how the KV cache streams through
+    VMEM, which is everything in the bandwidth-bound decode regime.
+    """
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+
+    fa = importlib.import_module("tpu_pipelines.ops.flash_attention")
+
+    jdt = jnp.dtype(dtype)
+    itemsize = jdt.itemsize
+    qrows = fa._DECODE_QROWS.get(int(itemsize), 8)
+    if pairs is None:
+        env = os.environ.get(ENV_BLOCKS)
+        if env:
+            pairs = [(qrows, bk) for _, bk in candidate_pairs(
+                kv_len, head_dim, itemsize
+            )]
+        else:
+            pairs = [(qrows, bk) for bk in valid_blocks(kv_len, itemsize)]
+    iters = iters if iters is not None else _sweep_iters()
+
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(kq, (batch, 1, heads, head_dim), jdt)
+    k = jax.random.normal(kk, (batch, kv_len, heads, head_dim), jdt)
+    v = jax.random.normal(kv, (batch, kv_len, heads, head_dim), jdt)
+
+    swept: List[Dict[str, Any]] = []
+    for _, bk in pairs:
+        row: Dict[str, Any] = {"block_q": qrows, "block_k": bk}
+        try:
+            def f(q, k, v, _bk=bk):
+                return fa.flash_decode_attention(
+                    q, k, v, block_k=_bk, interpret=interpret
+                )
+
+            compiled = jax.jit(f).lower(q, k, v).compile()
+            row["ms"] = round(time_compiled(compiled, (q, k, v), iters), 4)
+        except Exception as e:  # invalid tiling for this backend
+            row["error"] = str(e).splitlines()[0][:160]
+        swept.append(row)
+    timed = [r for r in swept if "ms" in r]
+    best = min(timed, key=lambda r: r["ms"]) if timed else None
+    return {"flash_decode": {"best": best, "swept": swept}}
+
+
 # ---------------------------------------------------------------- dispatch
 
 
@@ -555,9 +659,14 @@ def get_block_config(
     if mode != MODE_SWEEP or not allow_sweep:
         return None
     t0 = time.perf_counter()
-    swept = sweep_flash(
-        batch, heads, seq_len, head_dim, dtype, causal, interpret
-    )
+    if op == "flash_decode":
+        swept = sweep_decode(
+            batch, heads, seq_len, head_dim, dtype, interpret
+        )
+    else:
+        swept = sweep_flash(
+            batch, heads, seq_len, head_dim, dtype, causal, interpret
+        )
     elapsed = time.perf_counter() - t0
     out: Optional[Tuple[int, int]] = None
     for swept_op, res in swept.items():

@@ -312,6 +312,141 @@ def _flash_backward(q, k, v, kv_mask, o, lse, g, *, causal, block_q, block_k,
     return unfold(dq), unfold(dk), unfold(dv)
 
 
+# ----------------------------------------------------------------- decode
+
+# The single query row is replicated to a full sublane tile so the [q, d]
+# operand satisfies TPU tiling; all rows compute identical values and row 0
+# is returned.  The waste is on the tiny q dimension only — the decode
+# regime is bandwidth-bound on streaming the KV cache, which this kernel
+# reads exactly once (that is the point; real flash-decode does the same).
+_DECODE_QROWS = {4: 8, 2: 16, 1: 32}
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, mask_ref, bias_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, block_k, scale):
+    kj = pl.program_id(1)
+    n_kv = pl.num_programs(1)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0].astype(jnp.float32) * scale            # [qrows, d]
+    k = k_ref[0].astype(jnp.float32)                    # [bk, d]
+    v = v_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(                            # [qrows, bk] on MXU
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    s = s + bias_ref[0]                                 # [1, bk] broadcast
+    allowed = mask_ref[0, 0] > 0                        # [bk]
+    s = jnp.where(allowed[None, :], s, NEG_INF)
+    m_prev = m_ref[:, 0:1]
+    s_max = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, s_max)
+    p = jnp.where(allowed[None, :], jnp.exp(s - m_new), 0.0)
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_ref[:, 0:1] * corr + jnp.sum(p, axis=1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(kj == n_kv - 1)
+    def _final():
+        o_ref[0] = (
+            acc_ref[...] / jnp.maximum(l_ref[:, 0:1], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def flash_decode_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    kv_mask: Optional[jnp.ndarray] = None,
+    bias: Optional[jnp.ndarray] = None,
+    block_k: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Single-query attention against a KV cache (the decode regime).
+
+    ``q``: [batch, 1, heads, head_dim] — this step's one token per row.
+    ``k``/``v``: [batch, kv_len, heads, head_dim] — the (padded) cache.
+    ``kv_mask``: [batch, kv_len] validity (<= each row's decode position).
+    ``bias``: additive [1|batch, heads, 1, kv_len] score term (T5
+    relative positions); broadcast over batch when its leading dim is 1.
+
+    One grid step per KV block streams the cache through VMEM exactly
+    once with the online-softmax recurrence — no [1, L] score tensor in
+    HBM and no O(L) repacking per decode step.  Inference-only (no VJP:
+    nothing differentiates through serving decode).  ``block_k`` defaults
+    to the autotune table's ``flash_decode`` entry for this shape
+    (``TPP_AUTOTUNE`` semantics identical to ``flash_attention``), then
+    to the hard-coded default.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    from tpu_pipelines.ops import autotune
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, l, h, d = k.shape
+    itemsize = jnp.dtype(q.dtype).itemsize
+    concrete = not isinstance(q, jax.core.Tracer)
+    if block_k is None:
+        cfg = autotune.get_block_config(
+            "flash_decode", b, h, l, d, q.dtype, False,
+            interpret=interpret, allow_sweep=concrete,
+        )
+        if cfg is not None:
+            block_k = cfg[1]
+    block_k = autotune.DEFAULT_BLOCK_K if block_k is None else block_k
+    block_k = autotune.clamp_block(l, block_k, itemsize, "block_k")
+    qrows = _DECODE_QROWS.get(int(itemsize), 8)
+    scale = d ** -0.5
+
+    qf = jnp.broadcast_to(
+        q[:, 0].reshape(b * h, 1, d), (b * h, qrows, d)
+    )
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, l, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, l, d)
+    if kv_mask is None:
+        kv_mask = jnp.ones((b, l), jnp.int32)
+    maskf = jnp.repeat(jnp.asarray(kv_mask, jnp.int32), h, axis=0)[:, None, :]
+    if bias is None:
+        biasf = jnp.zeros((b * h, 1, l), jnp.float32)
+    else:
+        biasf = jnp.broadcast_to(
+            bias.astype(jnp.float32)[:, :, 0, :], (b, h, l)
+        ).reshape(b * h, 1, l)
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, block_k=block_k, scale=scale),
+        grid=(b * h, l // block_k),
+        in_specs=[
+            pl.BlockSpec((1, qrows, d), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda bh, j: (bh, 0, j)),
+            pl.BlockSpec((1, 1, block_k), lambda bh, j: (bh, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, qrows, d), lambda bh, j: (bh, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, qrows, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((qrows, d), jnp.float32),
+            pltpu.VMEM((qrows, LANES), jnp.float32),
+            pltpu.VMEM((qrows, LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, maskf, biasf)
+    return out[:, 0].reshape(b, h, d)[:, None]
+
+
 # ------------------------------------------------------------------ custom_vjp
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))

@@ -66,6 +66,25 @@ def main(argv=None) -> int:
                              "2x observed model step time); negative = "
                              "env TPP_SERVING_SLO_P99_MS, 0 = fixed "
                              "--batch-timeout-ms window")
+    parser.add_argument("--model-type", default="",
+                        choices=["", "predict", "generative"],
+                        help='"generative" = continuous-batching decode '
+                             "for :generate (sequences join the running "
+                             "batch per decode step, leave at EOS; "
+                             "docs/SERVING.md); empty = env "
+                             "TPP_SERVING_MODEL_TYPE, else predict")
+    parser.add_argument("--decode-page-size", type=int, default=0,
+                        help="KV-cache bucket granularity for generative "
+                             "decode (0 = one bucket, the whole cache; "
+                             "env TPP_SERVING_PAGE_SIZE)")
+    parser.add_argument("--max-queue-tokens", type=int, default=0,
+                        help="generative admission bound in outstanding "
+                             "decode TOKENS (429 past it); 0 = env "
+                             "TPP_SERVING_MAX_TOKENS, else unbounded")
+    parser.add_argument("--slo-ms-per-token", type=float, default=-1.0,
+                        help="per-token latency budget pricing each "
+                             "generation's deadline; negative = env "
+                             "TPP_SERVING_SLO_MS_PER_TOKEN, 0 = none")
     parser.add_argument("--grpc-port", type=int, default=-1,
                         help="also serve gRPC predict on this port "
                              "(0 = ephemeral; -1 = REST only)")
@@ -89,6 +108,10 @@ def main(argv=None) -> int:
                 replicas=args.replicas,
                 max_versions=args.max_versions,
                 slo_p99_ms=args.slo_p99_ms,
+                model_type=args.model_type,
+                decode_page_size=args.decode_page_size,
+                max_queue_tokens=args.max_queue_tokens,
+                slo_ms_per_token=args.slo_ms_per_token,
             )
             break
         except FileNotFoundError:

@@ -77,6 +77,60 @@ def pad_to_bucket(batch: Batch, n_rows: int, buckets: Sequence[int]) -> Batch:
     return {k: _pad(np.asarray(v)) for k, v in batch.items()}
 
 
+# ------------------------------------------------- generation parameters
+
+# The generate-request parameter surface.  Kept deliberately tiny: every
+# key here is validated at SUBMIT time, so a malformed request is refused
+# with a caller-classified error (HTTP 400 / gRPC INVALID_ARGUMENT)
+# instead of failing inside the model step — where it would drain-fail
+# every sequence co-batched with it.
+GENERATION_PARAM_KEYS = frozenset({"max_new_tokens"})
+
+
+def validate_generation_params(
+    raw: Optional[Dict[str, Any]], *, max_decode_len: int
+) -> Dict[str, int]:
+    """Validate and normalize a generate request's parameters at submit.
+
+    Raises ``ValueError`` (the server's 4xx classification) for unknown
+    keys, non-integer or out-of-range ``max_new_tokens``.  Returns the
+    normalized ``{"max_new_tokens": int}`` with the default (the model's
+    full decode budget) filled in."""
+    raw = dict(raw or {})
+    unknown = sorted(set(raw) - GENERATION_PARAM_KEYS)
+    if unknown:
+        raise ValueError(
+            f"unknown generation parameter(s) {unknown}; "
+            f"supported: {sorted(GENERATION_PARAM_KEYS)}"
+        )
+    m = raw.get("max_new_tokens", max_decode_len)
+    if isinstance(m, bool) or not isinstance(m, (int, np.integer)):
+        raise ValueError(
+            f"max_new_tokens must be an integer, got {type(m).__name__}"
+        )
+    m = int(m)
+    if not 1 <= m <= int(max_decode_len):
+        raise ValueError(
+            f"max_new_tokens must be in [1, {max_decode_len}], got {m}"
+        )
+    return {"max_new_tokens": m}
+
+
+def token_deadline_s(
+    arrival_s: float, max_new_tokens: int, slo_ms_per_token: float
+) -> Optional[float]:
+    """Per-token SLO deadline for one generation.
+
+    A request decoding N tokens earns N x the per-token budget from its
+    arrival instant — the decode analog of the request-level ``slo_p99_s``
+    window: admission control and the engine's eviction policy reason
+    about *tokens*, because that is the unit the hardware spends time on.
+    ``None`` when no per-token SLO is configured."""
+    if slo_ms_per_token <= 0:
+        return None
+    return arrival_s + max_new_tokens * slo_ms_per_token / 1e3
+
+
 class RequestBatcher:
     """Coalesces concurrent ``submit`` calls into padded device batches.
 

@@ -51,13 +51,23 @@ class ServingFleet:
         batch_timeout_s: float = 0.005,
         slo_p99_s: float = 0.0,
         max_versions: int = 2,
+        model_type: str = "predict",
+        decode_page_size: int = 0,
+        max_queue_tokens: int = 0,
+        slo_ms_per_token: float = 0.0,
         registry=None,
         loader: Optional[Callable[[str], Any]] = None,
     ):
+        if model_type not in ("predict", "generative"):
+            raise ValueError(
+                f"model_type must be 'predict' or 'generative', "
+                f"got {model_type!r}"
+            )
         self.model_name = model_name
         self.base_dir = base_dir
         self.raw = raw
         self.slo_p99_s = slo_p99_s
+        self.model_type = model_type
         self._max_batch_size = max_batch_size
         self._canary_batch: Optional[Dict[str, Any]] = None
         self._canary_lock = threading.Lock()
@@ -68,6 +78,19 @@ class ServingFleet:
             canary_fn=self._canary,
             registry=registry,
         )
+        generative_cfg = None
+        if model_type == "generative":
+            # The engine arena is sized by the same max_batch_size the
+            # request batcher uses; page size shapes the KV buckets.
+            generative_cfg = {
+                "versions": self.versions,
+                "engine_kwargs": {
+                    "max_batch_size": max_batch_size,
+                    "page_size": decode_page_size,
+                    "max_queue_tokens": max_queue_tokens,
+                    "slo_ms_per_token": slo_ms_per_token,
+                },
+            }
         devices = _local_devices()
         n = max(1, int(replicas))
         self.pool = ReplicaPool([
@@ -79,9 +102,14 @@ class ServingFleet:
                 slo_p99_s=slo_p99_s,
                 device=devices[i % len(devices)] if devices else None,
                 registry=registry,
+                generative_cfg=generative_cfg,
             )
             for i in range(n)
         ])
+
+    @property
+    def generative(self) -> bool:
+        return self.model_type == "generative"
 
     # ------------------------------------------------------------- predict
 
@@ -109,6 +137,47 @@ class ServingFleet:
                     }
         return self.pool.submit(batch, n_rows, timeout_s=timeout_s)
 
+    # ---------------------------------------------------------- generative
+
+    def generate_submit(
+        self,
+        batch: Dict[str, Any],
+        gen_params: Optional[Dict[str, Any]] = None,
+        timeout_s: float = 300.0,
+    ) -> np.ndarray:
+        """Continuous-batching generate for one request's rows.
+
+        The router picks ONE replica (token-aware routing cost) and every
+        row of the request joins that replica's iteration-level scheduler
+        as its own sequence — rows decode concurrently and each leaves the
+        batch the moment it finishes.  Requires the ``inputs`` feature
+        (token ids); ``input_mask`` optional."""
+        if not self.generative:
+            raise RuntimeError("fleet is not generative")
+        if "inputs" not in batch:
+            raise ValueError(
+                "generative serving requires an 'inputs' feature "
+                "(token ids per row)"
+            )
+        inputs = np.asarray(batch["inputs"])
+        mask = batch.get("input_mask")
+        rows = []
+        for i in range(inputs.shape[0]):
+            row = {"inputs": inputs[i]}
+            if mask is not None:
+                row["input_mask"] = np.asarray(mask)[i]
+            rows.append(row)
+        replica = self.pool.router.pick(self.pool.replicas)
+        return replica.decode_submit(
+            rows, dict(gen_params or {}), timeout_s=timeout_s
+        )
+
+    def outstanding_tokens(self) -> int:
+        """Fleet-wide decode work owed (token-level admission input)."""
+        return sum(
+            r.decode_outstanding_tokens() for r in self.pool.replicas
+        )
+
     # -------------------------------------------------------------- canary
 
     def set_canary_batch(self, batch: Optional[Dict[str, Any]]) -> None:
@@ -121,6 +190,18 @@ class ServingFleet:
     def _canary(self, loaded, version: str) -> str:
         from tpu_pipelines.components.infra_validator import canary_check
 
+        if self.generative:
+            # Generative gate: the payload must carry the decode contract,
+            # and every replica's engine compiles its full
+            # (batch_bucket, kv_bucket) program set HERE — before the
+            # version becomes eligible — so post-swap decode steps never
+            # pay an XLA compile mid-traffic (engine.warm, the decode
+            # analog of the predict bucket warmup below).
+            try:
+                for replica in self.pool.replicas:
+                    replica.prepare_engine(version, loaded)
+            except Exception as e:  # noqa: BLE001 — same verdict as canary
+                return f"generative warmup failed: {type(e).__name__}: {e}"
         with self._canary_lock:
             batch = self._canary_batch
         if batch is None:
@@ -181,14 +262,18 @@ class ServingFleet:
         return self.pool.closed
 
     def health(self) -> Dict[str, Any]:
-        return {
+        health = {
             "replicas": len(self.pool),
             "versions_resident": self.versions.resident_versions(),
             "active_version": self.active_version,
             "slo_p99_ms": (
                 round(self.slo_p99_s * 1e3, 3) if self.slo_p99_s else None
             ),
+            "model_type": self.model_type,
         }
+        if self.generative:
+            health["outstanding_decode_tokens"] = self.outstanding_tokens()
+        return health
 
     def close(self, timeout_s: float = 5.0) -> None:
         self.pool.close(timeout_s=timeout_s)

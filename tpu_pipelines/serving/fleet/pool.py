@@ -57,3 +57,7 @@ class ReplicaPool:
         deadline = time.monotonic() + timeout_s
         for r in self.replicas:
             r.batcher.join_close(max(0.0, deadline - time.monotonic()))
+        # Generative engines share the deadline: anything still decoding
+        # at shutdown is failed (GenerationEvicted), not left hanging.
+        for r in self.replicas:
+            r.close_engines(max(0.0, deadline - time.monotonic()))

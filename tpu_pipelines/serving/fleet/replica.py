@@ -85,6 +85,7 @@ class Replica:
         slo_p99_s: float = 0.0,
         device: Any = None,
         registry=None,
+        generative_cfg: Optional[Dict[str, Any]] = None,
     ):
         self.index = index
         self.name = str(index)
@@ -92,6 +93,19 @@ class Replica:
         self.latency = LatencyTracker()
         self._inflight = 0
         self._inflight_lock = threading.Lock()
+        # Generative (continuous-batching) side: one GenerativeEngine per
+        # RESIDENT version, created + warmed by the fleet's canary gate
+        # and drained across hot-swaps (engines for evicted versions are
+        # pruned once idle).  ``generative_cfg`` carries the version
+        # manager (lease source) and the engine constructor kwargs.
+        self._generative_cfg = generative_cfg
+        self._engines: Dict[str, Any] = {}
+        self._engines_lock = threading.Lock()
+        self._decode_telemetry = None
+        if generative_cfg is not None:
+            from tpu_pipelines.serving.generative import DecodeTelemetry
+
+            self._decode_telemetry = DecodeTelemetry(registry, self.name)
         if device is not None:
             inner = predict_fn
 
@@ -161,7 +175,23 @@ class Replica:
         already queued (plus this one) pays ~the replica's observed
         latency.  Queue depth carries the instantaneous load, EWMA p99 the
         replica's demonstrated speed — a slow replica's cost rises even at
-        equal depth, so the router redirects before its queue grows."""
+        equal depth, so the router redirects before its queue grows.
+
+        Generative replicas cost in TOKENS x per-step latency instead:
+        requests overlap inside the continuous batch, so request-level
+        (depth x p99) wildly overestimates an engine mid-generation —
+        what a new sequence actually waits on is the outstanding token
+        work ahead of it, each token costing ~one observed decode step."""
+        if self._generative_cfg is not None:
+            tokens = 0
+            step = None
+            with self._engines_lock:
+                engines = list(self._engines.values())
+            for eng in engines:
+                tokens += eng.outstanding_tokens()
+                if eng.step_ewma_s is not None:
+                    step = max(step or 0.0, eng.step_ewma_s)
+            return (tokens + 1) * (step or DEFAULT_LATENCY_S)
         return (self.queue_depth() + 1) * self.ewma_p99_s()
 
     # ------------------------------------------------------------- serving
@@ -189,3 +219,130 @@ class Replica:
                 self._m_depth.set(self.queue_depth())
                 self._m_deadline.set(self.batcher.gather_window_s())
                 self._m_step.set(self.batcher._step_ewma_s or 0.0)
+
+    # --------------------------------------------------------- generative
+
+    def prepare_engine(self, version: str, loaded) -> Any:
+        """Build (and warm) this replica's continuous-batching engine for
+        one model version.  Called by the fleet's canary gate BEFORE the
+        version becomes eligible: every (batch_bucket, kv_bucket) decode
+        program compiles here, off the request path, so a hot-swap never
+        pays an XLA compile mid-traffic.  Raises ``ValueError`` when the
+        payload carries no decode contract (``decode_fns``) — the same
+        verdict class as a failed canary."""
+        if self._generative_cfg is None:
+            raise RuntimeError("replica is not generative")
+        with self._engines_lock:
+            engine = self._engines.get(version)
+        if engine is not None:
+            return engine
+        fns = getattr(loaded, "decode_fns", None)
+        if fns is None:
+            raise ValueError(
+                "payload does not support generative serving (exported "
+                "module defines no make_decode_fns)"
+            )
+        from tpu_pipelines.serving.generative import GenerativeEngine
+
+        engine = GenerativeEngine(
+            fns,
+            loaded.params,
+            device=self.device,
+            telemetry=self._decode_telemetry,
+            **self._generative_cfg.get("engine_kwargs", {}),
+        )
+        engine.warm()
+        with self._engines_lock:
+            # Two loads racing the same version: keep the first engine.
+            existing = self._engines.setdefault(version, engine)
+        if existing is not engine:
+            engine.close(timeout_s=1.0)
+            return existing
+        return engine
+
+    def decode_submit(
+        self, rows, gen_params: Dict[str, Any], timeout_s: float = 300.0
+    ) -> np.ndarray:
+        """Run one request's sequences through this replica's engine.
+
+        The version LEASE is held for the whole generation: sequences
+        admitted before a hot-swap finish on the version they started on
+        (the engine keyed by that version keeps stepping until it drains),
+        while new requests lease — and decode on — the new active
+        version.  Rows of one request stream concurrently through the
+        iteration-level scheduler; the reply pads them to the longest
+        emitted stream."""
+        import time as _time
+
+        cfg = self._generative_cfg
+        if cfg is None:
+            raise RuntimeError("replica is not generative")
+        versions = cfg["versions"]
+        with self._inflight_lock:
+            self._inflight += 1
+        if self._m_requests is not None:
+            self._m_requests.inc()
+        t0 = _time.perf_counter()
+        try:
+            with versions.lease() as (version, loaded):
+                engine = self.prepare_engine(version, loaded)
+                # Submit-time validation: a malformed request is ITS
+                # caller's 4xx here, before any sequence joins the engine
+                # — never a failure inside a decode step shared with
+                # other requests.
+                from tpu_pipelines.serving.batching import (
+                    validate_generation_params,
+                )
+
+                gp = validate_generation_params(
+                    gen_params, max_decode_len=engine.max_decode_len
+                )
+                handles = [
+                    engine.submit_nowait(
+                        row["inputs"],
+                        input_mask=row.get("input_mask"),
+                        max_new_tokens=gp["max_new_tokens"],
+                    )
+                    for row in rows
+                ]
+                outs = [h.wait(timeout_s) for h in handles]
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+            self.latency.observe(_time.perf_counter() - t0)
+            self._prune_engines()
+        pad_id = engine.pad_id
+        width = max(len(o) for o in outs)
+        return np.stack([
+            np.pad(o, (0, width - len(o)), constant_values=pad_id)
+            for o in outs
+        ])
+
+    def _prune_engines(self) -> None:
+        """Drop idle engines whose version is no longer resident — the
+        engine half of drain-then-evict.  An engine with live sequences
+        is left stepping regardless of residency."""
+        cfg = self._generative_cfg
+        if cfg is None:
+            return
+        resident = set(cfg["versions"].resident_versions())
+        with self._engines_lock:
+            stale = [
+                v for v, e in self._engines.items()
+                if v not in resident and e.idle()
+            ]
+            engines = [self._engines.pop(v) for v in stale]
+        for e in engines:
+            e.close(timeout_s=1.0)
+
+    def decode_outstanding_tokens(self) -> int:
+        with self._engines_lock:
+            engines = list(self._engines.values())
+        return sum(e.outstanding_tokens() for e in engines)
+
+    def close_engines(self, timeout_s: float = 5.0) -> None:
+        with self._engines_lock:
+            engines = list(self._engines.values())
+            self._engines.clear()
+        for e in engines:
+            e.close(timeout_s=timeout_s)

@@ -1,0 +1,731 @@
+"""Continuous batching for autoregressive decode — the generative engine.
+
+The PR 10 fleet batches *whole requests*: an autoregressive request owns
+its replica for its entire decode, so one long generation stalls every
+request co-batched behind it, and a replica decoding a 4-token reply and
+one decoding a 500-token reply cost the router the same.  This engine
+batches at the *decode-step* level instead (iteration-level scheduling,
+Orca OSDI '22): sequences join the running batch the moment a slot is
+free and leave the moment they emit EOS or hit ``max_new_tokens`` —
+every device step serves exactly the sequences that still need tokens.
+
+Mechanics (the vLLM/PagedAttention shape of the idea, on the repo's
+static-shape substrate):
+
+  * **Arena.**  One device-resident state pool sized ``max_batch_size``:
+    the flax decode cache (self-attention K/V at ``max_decode_len``,
+    cross-attention K/V at the encoder length), per-slot last token,
+    position, live flag, encoder output and mask.  Live sequences occupy
+    the compacted prefix ``[0, n_live)``; a departure moves the last live
+    row into the hole (one scatter), an arrival lands at ``n_live`` (one
+    scatter) — no host-side repacking of the cache, ever.
+  * **Bucketed steps.**  Each decode step runs one pre-compiled program
+    keyed ``(batch_bucket, kv_bucket)``: the batch bucket is the smallest
+    power-of-two >= the live count (serving/batching.py's bucket rule),
+    the KV bucket the smallest page multiple covering the deepest live
+    position.  ``warm()`` compiles every combination up front — the
+    fleet's canary gate calls it BEFORE a version becomes eligible, so no
+    decode step pays an XLA compile mid-traffic (``compiles_after_warm``
+    is the auditable contract).  Pages are an allocation/accounting unit:
+    ``serving_decode_cache_pages_in_use`` is what capacity planning reads.
+  * **Identity.**  The per-row decode math is exactly the scalar-position
+    math greedy/beam run (models/transformer.py vector ``decode_pos``;
+    the batch dimension is bitwise row-independent), so a sequence's
+    token stream is bit-identical to an isolated single-request greedy
+    decode regardless of who it shared steps with.  KV bucketing keeps
+    masked positions at exact zero contribution, but XLA tiles a
+    contraction differently per length, so *across different KV buckets*
+    logits can drift by ~1 ulp — the same property every paged-attention
+    kernel has.  ``page_size=0`` (one bucket = the whole cache) makes the
+    stream bitwise under any schedule; the identity test pins that mode.
+  * **Per-token SLO.**  Admission control counts outstanding *tokens*
+    (``max_queue_tokens``), not requests: a queued 500-token generation
+    is 125x the work of a 4-token one and the door should know.  With
+    ``slo_ms_per_token`` each sequence carries a token-proportional
+    deadline (serving/batching.py ``token_deadline_s``); ``hard_deadline``
+    evicts a sequence that blows it (``GenerationEvicted``), freeing its
+    slot for work that can still meet SLO.
+
+Metrics (``serving_decode_*``, labeled per replica; catalog in
+docs/SERVING.md): steps/s, tokens/s, batch occupancy, cache pages in
+use, active/queued sequences + outstanding tokens, per-token latency
+histogram, evictions, step-time EWMA (what the router reads).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tpu_pipelines.serving.batching import (
+    bucket_sizes,
+    token_deadline_s,
+    validate_generation_params,
+)
+
+log = logging.getLogger("tpu_pipelines.serving")
+
+
+class EngineOverloaded(RuntimeError):
+    """Token-level admission control refused the sequence: outstanding
+    decode work (live + queued tokens) already exceeds the configured
+    bound.  Maps to HTTP 429 + Retry-After, like ``ServerOverloaded`` —
+    shed at the door, counted, never dropped mid-decode."""
+
+    retry_after_s = 1
+
+
+class GenerationEvicted(RuntimeError):
+    """The sequence was evicted before finishing — its per-token SLO
+    deadline passed under ``hard_deadline=True``, or the engine closed.
+    Maps to a retriable 503: the server is healthy, this generation lost
+    its latency race."""
+
+
+@dataclass
+class _Sequence:
+    """Host-side bookkeeping for one generation (the engine's unit of
+    scheduling).  ``tokens`` mirrors the device state: its length IS the
+    sequence's next decode position."""
+
+    inputs: np.ndarray              # [max_input_len] padded token ids
+    input_mask: np.ndarray          # [max_input_len] 1/0 validity
+    max_new_tokens: int
+    arrival_s: float
+    deadline_s: Optional[float]
+    tokens: List[int] = field(default_factory=list)
+    _done: threading.Event = field(default_factory=threading.Event)
+    result: Optional[np.ndarray] = None
+    error: Optional[BaseException] = None
+
+    def finish(self, error: Optional[BaseException] = None) -> None:
+        if self._done.is_set():
+            return
+        if error is not None:
+            self.error = error
+        else:
+            self.result = np.asarray(self.tokens, np.int32)
+        self._done.set()
+
+    def wait(self, timeout_s: float) -> np.ndarray:
+        if not self._done.wait(timeout_s):
+            raise TimeoutError("generation did not complete in time")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+def kv_bucket_sizes(max_decode_len: int, page_size: int) -> List[int]:
+    """KV-cache length buckets: page, 2*page, 4*page, ... capped at the
+    full cache.  ``page_size <= 0`` means one bucket — the whole cache —
+    which is also the bitwise-exact mode (see module docstring)."""
+    max_decode_len = int(max_decode_len)
+    if page_size <= 0 or page_size >= max_decode_len:
+        return [max_decode_len]
+    out = []
+    k = int(page_size)
+    while k < max_decode_len:
+        out.append(k)
+        k *= 2
+    out.append(max_decode_len)
+    return sorted(set(out))
+
+
+def _is_enc_leaf(path) -> bool:
+    """Cross-attention K/V leaves keep the ENCODER length on axis 1 (not
+    the decode cache length) and are never written by a decode step."""
+    return any("cached_enc" in str(getattr(p, "key", p)) for p in path)
+
+
+class GenerativeEngine:
+    """One continuous-batching decode engine over one (model, params).
+
+    ``fns`` is the duck-typed decode contract (see
+    ``models/t5.py make_continuous_decode_fns``): ``prefill``/``step``
+    plus geometry constants.  The engine owns a single worker thread; all
+    device work — prefill, bucketed steps, arena scatters — happens
+    there, so the jit-compiled programs never race.  ``submit`` blocks
+    like ``RequestBatcher.submit``; ``submit_nowait`` returns a handle
+    the fleet uses to run one request's rows concurrently.
+    """
+
+    # EWMA smoothing for the observed decode-step wall time (the router's
+    # cost signal); same constant family as RequestBatcher.
+    STEP_EWMA_ALPHA = 0.25
+
+    def __init__(
+        self,
+        fns,
+        params,
+        *,
+        max_batch_size: int = 8,
+        page_size: int = 0,
+        max_queue_tokens: int = 0,
+        slo_ms_per_token: float = 0.0,
+        hard_deadline: bool = False,
+        device: Any = None,
+        telemetry: Optional["DecodeTelemetry"] = None,
+        registry=None,
+        replica: str = "0",
+    ):
+        self.fns = fns
+        self.params = params
+        self.max_decode_len = int(fns.max_decode_len)
+        self.eos_id = int(fns.eos_id)
+        self.pad_id = int(fns.pad_id)
+        self.max_input_len = int(getattr(fns, "max_input_len", 64))
+        self.max_batch_size = max(1, int(max_batch_size))
+        self.page_size = int(page_size)
+        self.max_queue_tokens = max(0, int(max_queue_tokens))
+        self.slo_ms_per_token = max(0.0, float(slo_ms_per_token))
+        self.hard_deadline = bool(hard_deadline)
+        self.device = device
+        self.batch_buckets = bucket_sizes(self.max_batch_size)
+        self.kv_buckets = kv_bucket_sizes(self.max_decode_len, self.page_size)
+        self._page = (
+            self.page_size if 0 < self.page_size < self.max_decode_len
+            else self.max_decode_len
+        )
+        self.telemetry = telemetry or DecodeTelemetry(registry, replica)
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: "collections.deque[_Sequence]" = collections.deque()
+        self._slots: List[Optional[_Sequence]] = (
+            [None] * self.max_batch_size
+        )
+        self._n_live = 0
+        self._closed = False
+        self._arena = None
+        self._warmed = False
+        self.compiles_after_warm = 0
+        self.steps_run = 0
+        self.step_ewma_s: Optional[float] = None
+
+        self._step_fns: Dict[Tuple[int, int], Any] = {}
+        self._jit_prefill = None
+        self._jit_insert = None
+        self._jit_move = None
+        self._jit_clear = None
+
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    # ---------------------------------------------------------- device ctx
+
+    def _dev(self):
+        if self.device is None:
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.default_device(self.device)
+
+    # ------------------------------------------------------- compiled fns
+
+    def _build_jits(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        fns = self.fns
+
+        def prefill(params, inputs, input_mask):
+            cache, encoded, logits = fns.prefill(params, inputs, input_mask)
+            return cache, encoded, jnp.argmax(logits[0], -1).astype(jnp.int32)
+
+        def insert(state, pcache, encoded, enc_mask, tok0, slot):
+            cache, tok, pos, live, enc, mask = state
+            cache = jax.tree_util.tree_map(
+                lambda a, p: a.at[slot].set(p[0].astype(a.dtype)),
+                cache, pcache,
+            )
+            return (
+                cache,
+                tok.at[slot].set(tok0),
+                pos.at[slot].set(1),
+                live.at[slot].set(True),
+                enc.at[slot].set(encoded[0].astype(enc.dtype)),
+                mask.at[slot].set(jnp.asarray(enc_mask[0], mask.dtype)),
+            )
+
+        def move(state, src, dst):
+            return tuple(
+                jax.tree_util.tree_map(lambda a: a.at[dst].set(a[src]), part)
+                for part in state
+            )
+
+        def clear(state, slot):
+            cache, tok, pos, live, enc, mask = state
+            return (
+                cache,
+                tok.at[slot].set(self.pad_id),
+                pos.at[slot].set(0),
+                live.at[slot].set(False),
+                enc,
+                mask,
+            )
+
+        self._jit_prefill = jax.jit(prefill)
+        self._jit_insert = jax.jit(insert)
+        self._jit_move = jax.jit(move)
+        self._jit_clear = jax.jit(clear)
+
+    def _build_step(self, b: int, kv: int):
+        import jax
+        import jax.numpy as jnp
+
+        fns = self.fns
+        pad = self.pad_id
+
+        def run(params, state):
+            cache, tok, pos, live, encoded, enc_mask = state
+            sub = jax.tree_util.tree_map_with_path(
+                lambda p, x: x[:b] if _is_enc_leaf(p) else x[:b, :kv], cache
+            )
+            new_sub, logits = fns.step(
+                params, sub, tok[:b], pos[:b], encoded[:b], enc_mask[:b], kv
+            )
+            nxt = jnp.where(
+                live[:b], jnp.argmax(logits, -1).astype(jnp.int32), pad
+            )
+            cache = jax.tree_util.tree_map_with_path(
+                lambda p, a, n: a if _is_enc_leaf(p) else a.at[:b, :kv].set(n),
+                cache, new_sub,
+            )
+            tok = tok.at[:b].set(nxt)
+            pos = pos.at[:b].set(pos[:b] + live[:b].astype(jnp.int32))
+            return (cache, tok, pos, live, encoded, enc_mask), nxt
+
+        return jax.jit(run)
+
+    def _step_for(self, b: int, kv: int):
+        fn = self._step_fns.get((b, kv))
+        if fn is None:
+            if self._warmed:
+                # The warmup contract: every (batch, kv) bucket program is
+                # compiled before traffic.  A post-warm build means a
+                # bucket the warmup missed — counted, loud, and the
+                # warmup-contract test's assertion.
+                self.compiles_after_warm += 1
+                log.warning(
+                    "generative engine: compiling step (%d, %d) AFTER "
+                    "warmup — bucket missed by warm()", b, kv,
+                )
+            fn = self._build_step(b, kv)
+            self._step_fns[(b, kv)] = fn
+        return fn
+
+    # ------------------------------------------------------------- arena
+
+    def _ensure_arena(self) -> None:
+        if self._arena is not None:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        if self._jit_prefill is None:
+            self._build_jits()
+        with self._dev():
+            # Commit params AND the arena to one device up front.  The
+            # jit program cache keys on each argument's placement, not
+            # just its shape: an exported payload's params arrive
+            # COMMITTED (orbax restore), so step outputs — the next
+            # step's arena — are committed too, and a warmup that ran on
+            # an uncommitted pristine arena would silently recompile
+            # every bucket program on its first real-traffic step (~1 s
+            # stalls that defeat the whole warm() contract).  One
+            # explicit placement makes warm and traffic byte-identical
+            # cache keys — the warmup-contract test pins this.
+            dev = self.device
+            if dev is None:
+                dev = jax.local_devices()[0]
+            self.params = jax.device_put(self.params, dev)
+            zin = jnp.full((1, self.max_input_len), self.pad_id, jnp.int32)
+            zmask = jnp.zeros((1, self.max_input_len), jnp.int32)
+            cache1, encoded1, _ = self._jit_prefill(self.params, zin, zmask)
+            B = self.max_batch_size
+            cache = jax.tree_util.tree_map(
+                lambda x: jnp.zeros((B,) + x.shape[1:], x.dtype), cache1
+            )
+            # Free rows keep an all-ONES encoder mask: cross-attention over
+            # their zero K/V then averages zeros instead of softmaxing an
+            # all-masked row into NaN.  Live rows overwrite it on insert.
+            self._arena = jax.device_put((
+                cache,
+                jnp.full((B,), self.pad_id, jnp.int32),
+                jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B,), bool),
+                jnp.zeros((B,) + encoded1.shape[1:], encoded1.dtype),
+                jnp.ones((B, self.max_input_len), jnp.int32),
+            ), dev)
+
+    def warm(self) -> None:
+        """Pre-compile every program traffic can pose: prefill, insert /
+        move / clear, and one step per ``(batch_bucket, kv_bucket)``.
+        The fleet's canary gate runs this BEFORE a version becomes
+        eligible — the decode analog of the predict-bucket warmup — so a
+        hot-swap never pays an XLA compile mid-traffic.  Results are
+        discarded; the arena is untouched (jax arrays are immutable).
+        Arguments mirror the traffic paths exactly — host numpy inputs,
+        the committed arena — so every call lands on the SAME program
+        cache key traffic will use (see _ensure_arena on placement)."""
+        with self._dev():
+            self._ensure_arena()
+            zin = np.full((1, self.max_input_len), self.pad_id, np.int32)
+            zmask = np.zeros((1, self.max_input_len), np.int32)
+            cache1, encoded1, tok0 = self._jit_prefill(
+                self.params, zin, zmask
+            )
+            self._jit_insert(
+                self._arena, cache1, encoded1, zmask, tok0, np.int32(0)
+            )
+            self._jit_move(self._arena, np.int32(0), np.int32(0))
+            self._jit_clear(self._arena, np.int32(0))
+            for b in self.batch_buckets:
+                for kv in self.kv_buckets:
+                    self._step_for(b, kv)(self.params, self._arena)
+        self._warmed = True
+
+    # ------------------------------------------------------------- client
+
+    def outstanding_tokens(self) -> int:
+        """Decode work still owed: remaining tokens of live sequences plus
+        every queued sequence's full budget — the admission-control and
+        routing unit."""
+        with self._lock:
+            live = sum(
+                max(0, s.max_new_tokens - len(s.tokens))
+                for s in self._slots[: self._n_live] if s is not None
+            )
+            queued = sum(s.max_new_tokens for s in self._queue)
+        return live + queued
+
+    def active_sequences(self) -> int:
+        with self._lock:
+            return self._n_live + len(self._queue)
+
+    def idle(self) -> bool:
+        with self._lock:
+            return self._n_live == 0 and not self._queue
+
+    def submit_nowait(
+        self,
+        inputs,
+        *,
+        max_new_tokens: Optional[int] = None,
+        input_mask=None,
+    ) -> _Sequence:
+        params = validate_generation_params(
+            {} if max_new_tokens is None
+            else {"max_new_tokens": max_new_tokens},
+            max_decode_len=self.max_decode_len,
+        )
+        m = params["max_new_tokens"]
+        inputs = np.asarray(inputs, np.int32).reshape(-1)
+        if inputs.size == 0 or inputs.size > self.max_input_len:
+            raise ValueError(
+                f"input length must be in [1, {self.max_input_len}], "
+                f"got {inputs.size}"
+            )
+        if input_mask is None:
+            mask = np.ones(inputs.shape, np.int32)
+        else:
+            mask = np.asarray(input_mask, np.int32).reshape(-1)
+        pad = self.max_input_len - inputs.size
+        inputs = np.pad(inputs, (0, pad), constant_values=self.pad_id)
+        mask = np.pad(mask, (0, pad))
+        if self.max_queue_tokens > 0:
+            owed = self.outstanding_tokens()
+            if owed + m > self.max_queue_tokens:
+                self.telemetry.on_shed()
+                raise EngineOverloaded(
+                    f"outstanding decode tokens {owed} + {m} exceed the "
+                    f"bound {self.max_queue_tokens}"
+                )
+        now = time.monotonic()
+        seq = _Sequence(
+            inputs=inputs,
+            input_mask=mask,
+            max_new_tokens=m,
+            arrival_s=now,
+            deadline_s=token_deadline_s(now, m, self.slo_ms_per_token),
+        )
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("engine is closed")
+            self._queue.append(seq)
+            self.telemetry.on_queue(self.outstanding_tokens_locked())
+            self._cond.notify_all()
+        return seq
+
+    def outstanding_tokens_locked(self) -> int:
+        # Caller holds self._lock (the condition's underlying lock).
+        live = sum(
+            max(0, s.max_new_tokens - len(s.tokens))
+            for s in self._slots[: self._n_live] if s is not None
+        )
+        return live + sum(s.max_new_tokens for s in self._queue)
+
+    def submit(
+        self,
+        inputs,
+        *,
+        max_new_tokens: Optional[int] = None,
+        input_mask=None,
+        timeout_s: float = 300.0,
+    ) -> np.ndarray:
+        """Blocking generate for one sequence; returns the emitted token
+        ids (EOS included when hit within budget)."""
+        return self.submit_nowait(
+            inputs, max_new_tokens=max_new_tokens, input_mask=input_mask
+        ).wait(timeout_s)
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Reject new submits and fail everything unfinished.  Sequences
+        mid-decode get ``GenerationEvicted`` (the zero-drop contract is
+        the fleet's: it only closes engines after the drain)."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._worker.join(timeout=timeout_s)
+        with self._lock:
+            pending = list(self._queue) + [
+                s for s in self._slots[: self._n_live] if s is not None
+            ]
+            self._queue.clear()
+            self._n_live = 0
+            self._slots = [None] * self.max_batch_size
+        for seq in pending:
+            seq.finish(GenerationEvicted("engine closed"))
+
+    # ------------------------------------------------------------- worker
+
+    def _run(self) -> None:
+        try:
+            while True:
+                with self._cond:
+                    while (
+                        not self._closed
+                        and not self._queue
+                        and self._n_live == 0
+                    ):
+                        self._cond.wait()
+                    if self._closed:
+                        return
+                self._admit()
+                if self._n_live:
+                    self._step_once()
+        except Exception as e:  # noqa: BLE001 — device fault: fail loudly
+            log.exception("generative engine worker died")
+            with self._lock:
+                pending = list(self._queue) + [
+                    s for s in self._slots[: self._n_live] if s is not None
+                ]
+                self._queue.clear()
+                self._n_live = 0
+            for seq in pending:
+                seq.finish(e)
+
+    def _admit(self) -> None:
+        """Iteration-level admission: fill free slots from the queue NOW —
+        between two decode steps — instead of waiting for the batch to
+        drain.  One prefill (encoder + step-0 decode, the greedy math)
+        per admitted sequence, then one scatter into the arena."""
+        while True:
+            with self._lock:
+                if not self._queue or self._n_live >= self.max_batch_size:
+                    return
+                seq = self._queue.popleft()
+            with self._dev():
+                self._ensure_arena()
+                cache1, enc1, tok0 = self._jit_prefill(
+                    self.params, seq.inputs[None], seq.input_mask[None]
+                )
+                t0 = int(tok0)
+                seq.tokens.append(t0)
+                if t0 == self.eos_id or seq.max_new_tokens <= 1:
+                    self._complete(seq)
+                    continue
+                slot = self._n_live
+                self._arena = self._jit_insert(
+                    self._arena, cache1, enc1, seq.input_mask[None], tok0,
+                    np.int32(slot),
+                )
+            with self._lock:
+                self._slots[slot] = seq
+                self._n_live += 1
+
+    def _step_once(self) -> None:
+        n = self._n_live
+        b = next(bk for bk in self.batch_buckets if bk >= n)
+        deepest = max(
+            len(s.tokens) for s in self._slots[:n] if s is not None
+        )
+        kv = next(k for k in self.kv_buckets if k >= deepest + 1)
+        fn = self._step_for(b, kv)
+        t0 = time.perf_counter()
+        with self._dev():
+            self._arena, nxt = fn(self.params, self._arena)
+            toks = np.asarray(nxt)  # the one device->host sync per step
+        dt = time.perf_counter() - t0
+        if self.step_ewma_s is None:
+            self.step_ewma_s = dt
+        else:
+            a = self.STEP_EWMA_ALPHA
+            self.step_ewma_s = (1 - a) * self.step_ewma_s + a * dt
+        self.steps_run += 1
+        pages = sum(
+            -(-(len(s.tokens) + 1) // self._page)
+            for s in self._slots[:n] if s is not None
+        )
+        self.telemetry.on_step(dt, self.step_ewma_s, n, b, pages, int(n))
+        now = time.monotonic()
+        for slot in range(n - 1, -1, -1):
+            seq = self._slots[slot]
+            t = int(toks[slot])
+            seq.tokens.append(t)
+            self.telemetry.on_token()
+            done = (
+                t == self.eos_id or len(seq.tokens) >= seq.max_new_tokens
+            )
+            # Retire the slot BEFORE waking the waiter: the client thread
+            # resumes to consistent accounting (outstanding_tokens of a
+            # finished sequence is already 0, its slot already free).
+            if done:
+                self._retire(slot)
+                self._complete(seq)
+            elif (
+                self.hard_deadline
+                and seq.deadline_s is not None
+                and now > seq.deadline_s
+            ):
+                self.telemetry.on_evicted()
+                self._retire(slot)
+                seq.finish(GenerationEvicted(
+                    f"per-token SLO deadline exceeded after "
+                    f"{len(seq.tokens)}/{seq.max_new_tokens} tokens"
+                ))
+
+    def _retire(self, slot: int) -> None:
+        with self._dev():
+            last = self._n_live - 1
+            if slot != last:
+                self._arena = self._jit_move(
+                    self._arena, np.int32(last), np.int32(slot)
+                )
+            self._arena = self._jit_clear(self._arena, np.int32(last))
+        with self._lock:
+            if slot != self._n_live - 1:
+                self._slots[slot] = self._slots[self._n_live - 1]
+            self._slots[self._n_live - 1] = None
+            self._n_live -= 1
+
+    def _complete(self, seq: _Sequence) -> None:
+        latency = time.monotonic() - seq.arrival_s
+        self.telemetry.on_done(latency, len(seq.tokens))
+        seq.finish()
+
+
+class DecodeTelemetry:
+    """The ``serving_decode_*`` family, shared by every engine of one
+    replica (one label set per replica, however many versions are
+    resident mid-drain).  All methods are no-ops without a registry."""
+
+    def __init__(self, registry=None, replica: str = "0"):
+        self.replica = str(replica)
+        self._steps = self._tokens = self._seqs = self._evicted = None
+        self._shed = self._occ = self._pages = self._active = None
+        self._queue_tokens = self._step_s = self._per_token = None
+        if registry is None:
+            return
+        lab = ("replica",)
+        self._steps = registry.counter(
+            "serving_decode_steps_total",
+            "Continuous-batch decode steps executed.", labels=lab,
+        ).labels(self.replica)
+        self._tokens = registry.counter(
+            "serving_decode_tokens_total",
+            "Tokens emitted by the continuous-batch engine.", labels=lab,
+        ).labels(self.replica)
+        self._seqs = registry.counter(
+            "serving_decode_sequences_total",
+            "Generations completed (EOS or max_new_tokens).", labels=lab,
+        ).labels(self.replica)
+        self._evicted = registry.counter(
+            "serving_decode_evicted_total",
+            "Sequences evicted before finishing (per-token SLO deadline "
+            "or engine shutdown).", labels=lab,
+        ).labels(self.replica)
+        self._shed = registry.counter(
+            "serving_decode_shed_total",
+            "Sequences refused by token-level admission control.",
+            labels=lab,
+        ).labels(self.replica)
+        self._occ = registry.gauge(
+            "serving_decode_batch_occupancy",
+            "Live sequences / batch bucket of the most recent step.",
+            labels=lab,
+        ).labels(self.replica)
+        self._pages = registry.gauge(
+            "serving_decode_cache_pages_in_use",
+            "KV-cache pages covering every live sequence's positions.",
+            labels=lab,
+        ).labels(self.replica)
+        self._active = registry.gauge(
+            "serving_decode_sequences_active",
+            "Sequences live in the decode arena.", labels=lab,
+        ).labels(self.replica)
+        self._queue_tokens = registry.gauge(
+            "serving_decode_queue_tokens",
+            "Outstanding decode tokens (live remainder + queued budgets).",
+            labels=lab,
+        ).labels(self.replica)
+        self._step_s = registry.gauge(
+            "serving_decode_step_seconds",
+            "EWMA wall time of one continuous-batch decode step.",
+            labels=lab,
+        ).labels(self.replica)
+        self._per_token = registry.histogram(
+            "serving_decode_per_token_latency_seconds",
+            "Completed-generation latency divided by tokens emitted — "
+            "the per-token SLO judge.", labels=lab,
+        ).labels(self.replica)
+
+    def on_step(self, dt, ewma, live, bucket, pages, active) -> None:
+        if self._steps is None:
+            return
+        self._steps.inc()
+        self._occ.set(live / max(1, bucket))
+        self._pages.set(pages)
+        self._active.set(active)
+        self._step_s.set(ewma)
+
+    def on_token(self) -> None:
+        if self._tokens is not None:
+            self._tokens.inc()
+
+    def on_done(self, latency_s: float, n_tokens: int) -> None:
+        if self._seqs is None:
+            return
+        self._seqs.inc()
+        self._per_token.observe(latency_s / max(1, n_tokens))
+
+    def on_evicted(self) -> None:
+        if self._evicted is not None:
+            self._evicted.inc()
+
+    def on_shed(self) -> None:
+        if self._shed is not None:
+            self._shed.inc()
+
+    def on_queue(self, outstanding_tokens: int) -> None:
+        if self._queue_tokens is not None:
+            self._queue_tokens.set(outstanding_tokens)

@@ -125,6 +125,11 @@ class GrpcPredictionService:
 
         from tpu_pipelines.serving.server import GenerateUnsupported
 
+        from tpu_pipelines.serving.generative import (
+            EngineOverloaded,
+            GenerationEvicted,
+        )
+
         try:
             return fn(batch)
         except GenerateUnsupported as e:
@@ -132,6 +137,18 @@ class GrpcPredictionService:
             # this RPC at all — not retryable, not the request's fault.
             context.abort(
                 grpc.StatusCode.FAILED_PRECONDITION, f"{type(e).__name__}: {e}"
+            )
+        except EngineOverloaded as e:
+            # Token-level admission shed — the gRPC twin of HTTP 429:
+            # back off and retry.
+            context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED, f"{type(e).__name__}: {e}"
+            )
+        except GenerationEvicted as e:
+            # The generation lost its per-token SLO race; the server is
+            # healthy and a retry may land in budget.
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE, f"{type(e).__name__}: {e}"
             )
         except (ValueError, KeyError, TypeError) as e:
             # The model rejecting this batch (missing feature, wrong shape)

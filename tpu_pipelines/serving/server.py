@@ -59,6 +59,15 @@ ENV_MAX_QUEUE = "TPP_SERVING_MAX_QUEUE"
 ENV_REPLICAS = "TPP_SERVING_REPLICAS"
 ENV_MAX_VERSIONS = "TPP_SERVING_MAX_VERSIONS"
 ENV_SLO_P99_MS = "TPP_SERVING_SLO_P99_MS"
+# Generative (continuous-batching) knobs: model type selects the fleet's
+# decode engine for :generate, page size shapes the KV-cache buckets, the
+# token bound is generate-endpoint admission control (outstanding decode
+# TOKENS, not requests), and the per-token SLO prices each generation's
+# deadline by its length.
+ENV_MODEL_TYPE = "TPP_SERVING_MODEL_TYPE"
+ENV_PAGE_SIZE = "TPP_SERVING_PAGE_SIZE"
+ENV_MAX_TOKENS = "TPP_SERVING_MAX_TOKENS"
+ENV_SLO_MS_PER_TOKEN = "TPP_SERVING_SLO_MS_PER_TOKEN"
 
 
 def _env_number(name: str, default: float) -> float:
@@ -118,6 +127,10 @@ class ModelServer:
         replicas: int = 0,
         max_versions: int = 0,
         slo_p99_ms: float = -1.0,
+        model_type: str = "",
+        decode_page_size: int = 0,
+        max_queue_tokens: int = 0,
+        slo_ms_per_token: float = -1.0,
     ):
         self.model_name = model_name
         self.base_dir = base_dir
@@ -131,9 +144,23 @@ class ModelServer:
             max_versions = int(_env_number(ENV_MAX_VERSIONS, 1))
         if slo_p99_ms < 0:
             slo_p99_ms = _env_number(ENV_SLO_P99_MS, 0.0)
+        if not model_type:
+            model_type = (
+                os.environ.get(ENV_MODEL_TYPE, "").strip() or "predict"
+            )
+        if decode_page_size <= 0:
+            decode_page_size = int(_env_number(ENV_PAGE_SIZE, 0))
+        if max_queue_tokens <= 0:
+            max_queue_tokens = int(_env_number(ENV_MAX_TOKENS, 0))
+        if slo_ms_per_token < 0:
+            slo_ms_per_token = _env_number(ENV_SLO_MS_PER_TOKEN, 0.0)
         self.replicas = max(1, replicas)
         self.max_versions = max(1, max_versions)
         self.slo_p99_ms = max(0.0, slo_p99_ms)
+        self.model_type = model_type
+        self.decode_page_size = max(0, decode_page_size)
+        self.max_queue_tokens = max(0, max_queue_tokens)
+        self.slo_ms_per_token = max(0.0, slo_ms_per_token)
         self._lock = threading.Lock()
         # Serializes reload(): concurrent version swaps would race the
         # load-outside-lock / swap-under-lock dance.  Never held while
@@ -202,7 +229,14 @@ class ModelServer:
         # gRPC surfaces, admission control, and /metrics stay right here.
         self._batcher = None
         self._fleet = None
-        if self.replicas > 1 or self.max_versions > 1:
+        if (
+            self.replicas > 1
+            or self.max_versions > 1
+            or self.model_type == "generative"
+        ):
+            # Generative serving is a FLEET model type even at one
+            # replica: the continuous-batch engine, per-version drain and
+            # decode-bucket warmup all live behind the version manager.
             from tpu_pipelines.serving.fleet import ServingFleet
 
             self._fleet = ServingFleet(
@@ -214,6 +248,10 @@ class ModelServer:
                 batch_timeout_s=batch_timeout_s,
                 slo_p99_s=self.slo_p99_ms / 1e3,
                 max_versions=self.max_versions,
+                model_type=self.model_type,
+                decode_page_size=self.decode_page_size,
+                max_queue_tokens=self.max_queue_tokens,
+                slo_ms_per_token=self.slo_ms_per_token,
                 registry=self.metrics,
             )
         elif batching:
@@ -291,6 +329,23 @@ class ModelServer:
         client can back off on — shed load is counted, never dropped
         silently."""
         with self._inflight_lock:
+            if (
+                endpoint == "generate"
+                and self.max_queue_tokens > 0
+                and self._fleet is not None
+                and self._fleet.generative
+            ):
+                # Generative admission counts outstanding TOKENS, not
+                # requests: a queued 500-token generation is 125x the
+                # device work of a 4-token one, and the request count
+                # hides exactly that.
+                owed = self._fleet.outstanding_tokens()
+                if owed >= self.max_queue_tokens:
+                    self._m_shed.labels(endpoint).inc()
+                    raise ServerOverloaded(
+                        f"outstanding decode tokens {owed} >= bound "
+                        f"{self.max_queue_tokens}"
+                    )
             if self.max_queue_depth > 0:
                 depth = self._inflight
                 if self._fleet is not None:
@@ -386,22 +441,49 @@ class ModelServer:
             )
         return loaded.generate
 
-    def generate_batch(self, batch: Dict[str, Any]) -> np.ndarray:
-        """Seq2seq decoding (models exported with a make_generate_step hook —
-        trainer/export.py) on a columnar feature batch: the shared entry for
-        REST :generate and gRPC Generate.  Decoding batches whole requests
-        (the beam/greedy fn is itself batched), so this path bypasses the
-        forward-pass micro-batcher."""
+    def generate_batch(
+        self,
+        batch: Dict[str, Any],
+        gen_params: Optional[Dict[str, Any]] = None,
+    ) -> np.ndarray:
+        """Seq2seq decoding on a columnar feature batch: the shared entry
+        for REST :generate and gRPC Generate.
+
+        ``model_type="generative"`` routes through the fleet's continuous-
+        batching engine (serving/generative.py): each row joins the
+        iteration-level scheduler as its own sequence and leaves at EOS —
+        no whole-request batching, no replica pinned for the longest row.
+        Otherwise the exported whole-request decode fn (make_generate_step)
+        runs as before; ``gen_params`` is only meaningful on the engine
+        path and rejected elsewhere (unknown-knob 4xx beats silence)."""
+        if self._fleet is not None and self._fleet.generative:
+            return self._fleet.generate_submit(batch, gen_params)
+        if gen_params:
+            raise ValueError(
+                "generation params require a generative model type "
+                f"(server model_type={self.model_type!r}); "
+                f"got {sorted(gen_params)}"
+            )
         return np.asarray(self._generate_fn()(batch))
 
     def generate(self, payload: Dict[str, Any]) -> Dict[str, Any]:
-        # Capability check BEFORE payload parsing: an empty request against
-        # a server that cannot generate at all must error, not 200 [].
-        generate_fn = self._generate_fn()
+        # Generation params ride next to instances/inputs: top-level
+        # "params" dict ({"max_new_tokens": N}) — validated at SUBMIT time
+        # (batching.validate_generation_params) so a malformed request is
+        # a 400 to its caller, never a failure inside a shared decode step.
+        gen_params = payload.get("params")
+        if gen_params is not None and not isinstance(gen_params, dict):
+            raise ValueError(
+                f"'params' must be an object, got {type(gen_params).__name__}"
+            )
+        if self._fleet is None or not self._fleet.generative:
+            # Capability check BEFORE payload parsing: an empty request
+            # against a server that cannot generate must error, not 200 [].
+            self._generate_fn()
         batch = self._payload_to_batch(payload)
         if batch is None:
             return {"outputs": []}
-        return {"outputs": np.asarray(generate_fn(batch)).tolist()}
+        return {"outputs": self.generate_batch(batch, gen_params).tolist()}
 
     # -------------------------------------------------------------- health
 
@@ -543,6 +625,32 @@ class ModelServer:
                         retry_after_s=ServerOverloaded.retry_after_s,
                     )
                 except Exception as e:
+                    from tpu_pipelines.serving.generative import (
+                        EngineOverloaded,
+                        GenerationEvicted,
+                    )
+
+                    if isinstance(e, EngineOverloaded):
+                        # Token-level admission control (the engine counts
+                        # outstanding decode TOKENS): same shed contract
+                        # as ServerOverloaded — 429 + Retry-After.
+                        self._reply(
+                            429, {"error": f"overloaded: {e}"},
+                            endpoint=endpoint,
+                            retry_after_s=EngineOverloaded.retry_after_s,
+                        )
+                        return
+                    if isinstance(e, GenerationEvicted):
+                        # The sequence lost its per-token SLO race (or the
+                        # engine is shutting down): the server is healthy
+                        # and a retry may land inside budget — retriable
+                        # 503, never a 5xx-counted server fault.
+                        self._reply(
+                            503, {"error": f"evicted: {e}"},
+                            endpoint=endpoint,
+                            retry_after_s=ServerOverloaded.retry_after_s,
+                        )
+                        return
                     # Classified verdicts (the zero-5xx-under-reload
                     # guarantee depends on 5xx meaning SERVER fault, not
                     # "anything went wrong"): caller mistakes are 4xx,

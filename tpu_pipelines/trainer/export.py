@@ -100,6 +100,12 @@ class LoadedModel:
     # ``make_generate_fn(model, params, hyperparameters)``.  ``generate``
     # takes raw batches (host transform applied first); None otherwise.
     generate: Optional[Callable[[Dict[str, np.ndarray]], Any]] = None
+    # Continuous-batching decode contract (serving/generative.py): present
+    # when the exported module defines ``make_decode_fns(model,
+    # hyperparameters)`` (e.g. ``models/t5.py make_continuous_decode_fns``)
+    # — prefill/step + geometry the generative fleet model type builds its
+    # per-replica engines from.  None = whole-request generate only.
+    decode_fns: Any = None
     # The two halves of `predict`, exposed for exporters (serving/
     # saved_model.py): host string stage (numpy, identity when no transform)
     # and the device computation (numeric transform fused with the forward
@@ -341,6 +347,14 @@ def load_exported_model(uri: str) -> LoadedModel:
         else:
             generate = device_generate
 
+    decode_builder = getattr(module, "make_decode_fns", None)
+    decode_fns = None
+    if decode_builder is not None:
+        # Continuous-batching contract for the generative fleet model
+        # type; params stay engine arguments (never closed over), same
+        # discipline as make_generate_step.
+        decode_fns = decode_builder(model, spec.get("hyperparameters", {}))
+
     return LoadedModel(
         params=params,
         model=model,
@@ -353,4 +367,5 @@ def load_exported_model(uri: str) -> LoadedModel:
         forward_step=_forward,
         device_step=device_step,
         generate=generate,
+        decode_fns=decode_fns,
     )
