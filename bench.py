@@ -1363,6 +1363,308 @@ def bench_serving(smoke: bool) -> dict:
     }
 
 
+def _fleet_hammer(url: str, body: bytes, n_threads: int, per_thread: int):
+    """Fire ``n_threads x per_thread`` POSTs; returns (errors, codes)."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    errors = [0]
+    codes: dict = {}
+    lock = threading.Lock()
+
+    def fire(n: int) -> None:
+        for _ in range(n):
+            code = None
+            try:
+                req = urllib.request.Request(url, data=body)
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    r.read()
+                    code = r.status
+            except urllib.error.HTTPError as e:
+                code = e.code
+            except Exception:  # noqa: BLE001 — dropped connection
+                errors[0] += 1
+            with lock:
+                codes[code] = codes.get(code, 0) + 1
+
+    threads = [
+        threading.Thread(target=fire, args=(per_thread,))
+        for _ in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return errors[0], codes
+
+
+def _fleet_traced_pass(
+    model_dir: str,
+    n_threads: int,
+    n_requests: int,
+    slo_p99_ms: float,
+    max_queue_depth: int,
+) -> dict:
+    """ISSUE 12 pass C: the pass-A hammer shape with request tracing
+    sampled on (``sample:4``); mean request latency from the scrape is
+    the traced side of ``trace_overhead_pct`` (pass A's untraced mean is
+    the baseline — same model dir, same box, back to back)."""
+    import urllib.request
+
+    from tpu_pipelines.serving import ModelServer
+
+    server = ModelServer(
+        "fleet", model_dir,
+        replicas=2, max_versions=2, slo_p99_ms=slo_p99_ms,
+        max_batch_size=8, batch_timeout_s=0.002,
+        max_queue_depth=max_queue_depth,
+        request_trace_mode="sample:4",
+    )
+    port = server.start()
+    url = f"http://127.0.0.1:{port}/v1/models/fleet:predict"
+    body = json.dumps({"instances": [{"x": [1.0, 2.0, 3.0]}]}).encode()
+    try:
+        # Same warm-up budget as pass A (XLA compiles, canary capture).
+        _fleet_hammer(url, body, 1, 3)
+        t0 = time.perf_counter()
+        errors, codes = _fleet_hammer(
+            url, body, n_threads, n_requests // n_threads
+        )
+        wall = time.perf_counter() - t0
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as r:
+            scrape = r.read().decode()
+        ring_events = len(
+            server.request_tracer.events()
+        ) if server.request_tracer else 0
+    finally:
+        server.stop()
+    hist = _parse_prom_histogram(
+        scrape, "serving_request_latency_seconds", 'endpoint="predict"'
+    )
+    traced_total = int(_parse_prom_counter(
+        scrape, "serving_traced_requests_total"
+    ))
+    mean_s = (hist["sum"] / hist["count"]) if hist and hist["count"] else None
+    return {
+        "requests": n_requests,
+        "errors": errors,
+        "codes": {str(k): v for k, v in sorted(codes.items(),
+                                               key=lambda kv: str(kv[0]))},
+        "qps": round(n_requests / wall, 1) if wall else None,
+        "mean_latency_ms": (
+            round(mean_s * 1e3, 3) if mean_s is not None else None
+        ),
+        "sample_mode": "sample:4",
+        "traced_requests": traced_total,
+        "ring_events": ring_events,
+    }
+
+
+def _fleet_rollback_drill(td: str, module: str, smoke: bool) -> dict:
+    """ISSUE 12 pass D: inject a post-swap latency regression via a slow
+    stub payload and prove the burn-rate monitor + probation rollback
+    close the loop: breach detected, prior version re-activated, interval
+    p99 recovered under the SLO, the bad version's re-push answers 409,
+    zero 5xx throughout."""
+    import urllib.error
+    import urllib.request
+
+    from tpu_pipelines.observability.metrics import histogram_quantile
+    from tpu_pipelines.serving import ModelServer
+    from tpu_pipelines.trainer.export import export_model
+
+    batch_slo_p99_ms = 250.0        # the batcher's gather-window budget
+    n_threads = 4
+    per_phase = 3 if smoke else 12
+    drill_dir = os.path.join(td, "drill")
+    slow_module = os.path.join(td, "slow_model.py")
+    with open(slow_module, "w") as f:
+        # A genuinely slow payload: the per-call fori_loop matmul chain
+        # costs real device time EVERY call (a sleep would vanish into
+        # the jit trace), so the post-swap regression is the kind a bad
+        # quantization/compile actually produces.  ~1-8 GFLOP per call
+        # keeps it decisively over the drill SLO on any host class.
+        f.write(
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def build_model(hp):\n"
+            "    return None\n"
+            "def apply_fn(model, params, batch):\n"
+            "    x = jnp.asarray(batch['x'], jnp.float32) @ params['w']\n"
+            "    h = jnp.tile(x[:, :1], (1, 256))\n"
+            "    h = jax.lax.fori_loop(\n"
+            "        0, 30000, lambda i, a: jnp.tanh(a @ params['m']), h)\n"
+            "    return h[:, :2]\n"
+        )
+    rng = np.random.default_rng(0)
+    export_model(
+        serving_model_dir=os.path.join(drill_dir, "1"),
+        params={"w": np.eye(3, 2).astype(np.float32)},
+        module_file=module,
+    )
+    export_model(
+        serving_model_dir=os.path.join(drill_dir, "2"),
+        params={
+            "w": np.eye(3, 2).astype(np.float32),
+            "m": (rng.standard_normal((256, 256)) * 0.05).astype(
+                np.float32
+            ),
+        },
+        module_file=slow_module,
+    )
+    v2 = os.path.join(drill_dir, "2")
+    v2_staged = os.path.join(td, "drill-v2-staged")
+    os.rename(v2, v2_staged)
+    server = ModelServer(
+        "drill", drill_dir,
+        replicas=2, max_versions=2, slo_p99_ms=batch_slo_p99_ms,
+        max_batch_size=8, batch_timeout_s=0.002,
+        swap_probation_s=600.0,
+    )
+    port = server.start()
+    url = f"http://127.0.0.1:{port}/v1/models/drill:predict"
+    body = json.dumps({"instances": [{"x": [1.0, 2.0, 3.0]}]}).encode()
+    fleet = server._fleet
+    reload_url = f"http://127.0.0.1:{port}/v1/models/drill:reload"
+    try:
+        # Phase 1 — healthy v1 traffic.  The drill SLO is calibrated to
+        # THIS box (4x the healthy p99, floored/capped): on a loaded
+        # 1-core CI host the healthy tail is tens of ms of scheduler
+        # jitter, on a real serving host single-digit ms — a fixed
+        # target would misfire on one of them.  The slow payload's step
+        # is decisively over the cap on any host class.
+        _fleet_hammer(url, body, 1, 3)
+        err1, _ = _fleet_hammer(url, body, n_threads, per_phase)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as r:
+            scrape_fast = r.read().decode()
+        fast = _parse_prom_histogram(
+            scrape_fast, "serving_request_latency_seconds",
+            'endpoint="predict"',
+        )
+        p99_fast = histogram_quantile(
+            {"buckets": fast["buckets"], "count": fast["count"],
+             "sum": fast["sum"]},
+            0.99, fast["bounds"],
+        ) if fast else None
+        slo_s = min(0.25, max(0.05, 4.0 * (p99_fast or 0.0125)))
+        from tpu_pipelines.observability.slo import SLOMonitor
+
+        monitor = SLOMonitor(
+            server.metrics, slo_p99_s=slo_s,
+            on_breach=fleet.on_slo_breach,
+            min_events=min(8, n_threads * per_phase),
+        )
+        # Baseline snapshot for the burn windows (synthetic clock: the
+        # drill must not wait real minutes between evaluations).
+        monitor.evaluate(now=0.0)
+        pre_breaches = int(_registry_drill_breaches(server))
+        # Phase 2 — the bad push lands and swaps in mid-traffic.
+        os.rename(v2_staged, v2)
+        with urllib.request.urlopen(
+            urllib.request.Request(reload_url, data=b"{}"), timeout=120
+        ) as r:
+            assert json.loads(r.read())["version"] == "2"
+        err2, _ = _fleet_hammer(url, body, n_threads, per_phase)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as r:
+            scrape_bad = r.read().decode()
+        # Phase 3 — the monitor sees the burn and the fleet rolls back.
+        result = monitor.evaluate(now=60.0)
+        breached = [b["slo"] for b in result["breaches"]]
+        rolled_back = fleet.active_version == "1"
+        rollbacks = int(_parse_prom_counter(
+            scrape_bad, "serving_auto_rollbacks_total"
+        ))
+        # Phase 4 — recovered traffic; interval p99 from bucket deltas
+        # (the cumulative histogram still holds the slow phase).
+        err3, _ = _fleet_hammer(url, body, n_threads, per_phase)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as r:
+            scrape_end = r.read().decode()
+        rollbacks = max(rollbacks, int(_parse_prom_counter(
+            scrape_end, "serving_auto_rollbacks_total"
+        )))
+        # Phase 5 — the quarantined version's re-push answers 409.
+        try:
+            with urllib.request.urlopen(
+                urllib.request.Request(reload_url, data=b"{}"), timeout=60
+            ) as r:
+                reload_code = r.status
+        except urllib.error.HTTPError as e:
+            reload_code = e.code
+    finally:
+        server.stop()
+    bad = _parse_prom_histogram(
+        scrape_bad, "serving_request_latency_seconds", 'endpoint="predict"'
+    )
+    end = _parse_prom_histogram(
+        scrape_end, "serving_request_latency_seconds", 'endpoint="predict"'
+    )
+    recovered_p99_ms = None
+    if bad and end and end["count"] > bad["count"]:
+        delta = {
+            "buckets": [
+                b - a for a, b in zip(bad["buckets"], end["buckets"])
+            ],
+            "count": end["count"] - bad["count"],
+            "sum": end["sum"] - bad["sum"],
+        }
+        q = histogram_quantile(delta, 0.99, end["bounds"])
+        recovered_p99_ms = round(q * 1e3, 3) if q is not None else None
+    drill_5xx = int(_parse_prom_counter(
+        scrape_end, "serving_requests_total", 'code="5'
+    ))
+    slo_ms = round(slo_s * 1e3, 3)
+    green = bool(
+        err1 == 0 and err2 == 0 and err3 == 0
+        and "latency_p99" in breached
+        and int(_registry_drill_breaches_text(scrape_end)) > pre_breaches
+        and rolled_back
+        and rollbacks >= 1
+        and reload_code == 409
+        and drill_5xx == 0
+        and recovered_p99_ms is not None
+        and recovered_p99_ms < slo_ms
+    )
+    return {
+        "green": green,
+        "slo_p99_ms": slo_ms,
+        "healthy_p99_ms": (
+            round(p99_fast * 1e3, 3) if p99_fast is not None else None
+        ),
+        "breached_slos": breached,
+        "rolled_back_to": "1" if rolled_back else None,
+        "auto_rollbacks": rollbacks,
+        "quarantined_reload_code": reload_code,
+        "recovered_p99_ms": recovered_p99_ms,
+        "drill_5xx": drill_5xx,
+        "requests_per_phase": n_threads * per_phase,
+    }
+
+
+def _registry_drill_breaches(server) -> float:
+    m = server.metrics.get("serving_slo_breaches_total")
+    if m is None:
+        return 0.0
+    try:
+        return m.labels("latency_p99").get()
+    except Exception:  # noqa: BLE001 — no such series yet
+        return 0.0
+
+
+def _registry_drill_breaches_text(scrape: str) -> float:
+    return _parse_prom_counter(
+        scrape, "serving_slo_breaches_total", 'slo="latency_p99"'
+    )
+
+
 def bench_serving_fleet(smoke: bool) -> dict:
     """Serving-fleet leg (ISSUE 10), judged entirely from the fleet's OWN
     ``/metrics`` scrape, in two passes:
@@ -1374,6 +1676,19 @@ def bench_serving_fleet(smoke: bool) -> dict:
          pushed version hot-swaps via the ``:reload`` surface (the
          Pusher push-URL hook's path); the cumulative scrape must record
          zero 5xx across the whole leg and the swap must complete.
+
+      C. **Traced pass** (ISSUE 12): the same hammer at matched request
+         counts against a fleet with request-scoped tracing sampled on
+         (``sample:4``, in-memory ring); ``trace_overhead_pct`` compares
+         mean request latency traced vs untraced — the cost of the span
+         plumbing at the bench QPS.
+
+      D. **Rollback drill** (ISSUE 12): a slow payload hot-swaps in
+         mid-traffic, the SLO burn-rate monitor detects the post-swap
+         latency regression, the fleet auto-rolls back to the prior
+         version, interval p99 recovers under the SLO, the bad version's
+         re-``:reload`` answers 409, and the whole drill records zero
+         5xx — ``slo_rollback_green``.
 
     Judging p99 from pass A keeps the verdict about the SLO batcher, not
     about CPU contention with the new version's (off-request-path) canary
@@ -1496,6 +1811,17 @@ def bench_serving_fleet(smoke: bool) -> dict:
         finally:
             server.stop()
 
+        # Pass C — traced at matched counts: same model dir, same hammer
+        # shape, request tracing sampled on (ring only: the flush-to-
+        # file path is the CLI's, not the hot path's).
+        traced = _fleet_traced_pass(
+            os.path.join(td, "m"), n_threads, n_requests, slo_p99_ms,
+            max_queue_depth,
+        )
+
+        # Pass D — SLO burn-rate rollback drill (own model dir).
+        drill = _fleet_rollback_drill(td, module, smoke)
+
     hist = _parse_prom_histogram(
         steady_scrape, "serving_request_latency_seconds",
         'endpoint="predict"'
@@ -1520,6 +1846,23 @@ def bench_serving_fleet(smoke: bool) -> dict:
         if m:
             per_replica[m.group(1)] = int(float(m.group(2)))
     swaps = int(_parse_prom_counter(scrape, "serving_version_swaps_total"))
+    # Trace overhead: traced mean vs the pass-A untraced mean at matched
+    # request counts (mean, not p99 — tails on a loaded 1-core CI box are
+    # scheduler noise; the span plumbing's cost is a per-request constant).
+    untraced_mean_ms = (
+        round(hist["sum"] / hist["count"] * 1e3, 3)
+        if hist and hist["count"] else None
+    )
+    trace_overhead_pct = None
+    if untraced_mean_ms and traced.get("mean_latency_ms"):
+        trace_overhead_pct = round(
+            max(
+                0.0,
+                (traced["mean_latency_ms"] - untraced_mean_ms)
+                / untraced_mean_ms * 100.0,
+            ),
+            2,
+        )
     green = bool(
         errors[0] == 0
         and reload_5xx == 0
@@ -1552,6 +1895,11 @@ def bench_serving_fleet(smoke: bool) -> dict:
         "concurrency": n_threads,
         "host_cpus": os.cpu_count(),
         "healthz": health,
+        "traced": traced,
+        "untraced_mean_latency_ms": untraced_mean_ms,
+        "trace_overhead_pct": trace_overhead_pct,
+        "rollback_drill": drill,
+        "slo_rollback_green": bool(drill.get("green")),
     }
 
 
@@ -3022,6 +3370,8 @@ def _compact(report: dict) -> dict:
         compact["fleet_p99_ms"] = fl.get("p99_ms")
         compact["fleet_reload_5xx"] = fl.get("reload_5xx")
         compact["fleet_shed_requests"] = fl.get("shed_requests")
+        compact["trace_overhead_pct"] = fl.get("trace_overhead_pct")
+        compact["slo_rollback_green"] = fl.get("slo_rollback_green")
     # Continuous-batching decode headline (ISSUE 11): tokens/s and
     # p99-per-token off the fleet's own scrape, the A/B speedup over
     # whole-request decode, and the zero-5xx-across-hot-swap count.
@@ -3249,7 +3599,7 @@ def main() -> None:
     leg("serving", bench_serving, est_cost_s=60, retries=1)
     # Serving fleet (ISSUE 10): multi-replica + SLO batching + reload-
     # under-load hammer, judged from the fleet's own scrape.
-    leg("serving_fleet", bench_serving_fleet, est_cost_s=60, retries=1)
+    leg("serving_fleet", bench_serving_fleet, est_cost_s=150, retries=1)
     # Continuous-batching decode (ISSUE 11): generative fleet vs
     # whole-request A/B on identical mixed-length traffic + zero-5xx
     # hot-swap with generations in flight, off the fleet's own scrape.

@@ -451,6 +451,97 @@ def test_tpp109_cli_fail_on_warn(tmp_path):
     assert "TPP109" in report["rules"]
 
 
+def test_tpp110_slo_without_monitor(tmp_path):
+    """A serving config declaring slo_p99_ms with no registry/monitor key
+    next to it: the SLO shapes the batch window yet nothing watches burn
+    rates — WARN, with the offending property path in the message."""
+    gen = _gen(serving={"slo_p99_ms": 250.0, "replicas": 2})
+    sink = _consumer(gen, name="S", outs={})
+    sink.SPEC.outputs.clear()
+    findings = analyze_ir(
+        Compiler().compile(_pipeline([gen, sink], tmp_path))
+    )
+    f110 = [f for f in findings if f.rule == "TPP110"]
+    assert len(f110) == 1
+    (f,) = f110
+    assert f.node_id == "Gen" and f.severity == "warn"
+    assert "slo_p99_ms" in f.message and "serving" in f.message
+    assert "TPP_SLO_MONITOR" in f.fix or "slo_monitor" in f.fix
+
+    # Suppression drops it (an external Prometheus may own the alerting).
+    gen.with_lint_suppressions("TPP110")
+    findings = analyze_ir(
+        Compiler().compile(_pipeline([gen, sink], tmp_path))
+    )
+    assert [f for f in findings if f.rule == "TPP110"] == []
+
+
+def test_tpp110_monitor_wired_is_clean(tmp_path):
+    # Any observability key in the SAME mapping is the wiring.
+    for wired in (
+        {"slo_p99_ms": 250.0, "slo_monitor_interval_s": 5.0},
+        {"slo_p99_ms": 250.0, "metrics_port": 9090},
+        {"slo_p99_s": 0.25, "registry": "default"},
+    ):
+        gen = _gen(serving=wired)
+        sink = _consumer(gen, name="S", outs={})
+        sink.SPEC.outputs.clear()
+        findings = analyze_ir(
+            Compiler().compile(_pipeline([gen, sink], tmp_path))
+        )
+        assert [f for f in findings if f.rule == "TPP110"] == [], wired
+    # No SLO declared at all: silent (predict deployments stay clean).
+    gen = _gen(serving={"replicas": 2, "slo_p99_ms": 0.0})
+    sink = _consumer(gen, name="S", outs={})
+    sink.SPEC.outputs.clear()
+    findings = analyze_ir(
+        Compiler().compile(_pipeline([gen, sink], tmp_path))
+    )
+    assert [f for f in findings if f.rule == "TPP110"] == []
+
+
+def test_tpp110_cli_fail_on_warn(tmp_path):
+    module = tmp_path / "slo_pipeline.py"
+    module.write_text(textwrap.dedent("""
+        import os
+        from tpu_pipelines.dsl.component import Parameter, component
+        from tpu_pipelines.dsl.pipeline import Pipeline
+
+        @component(outputs={"examples": "Examples"},
+                   parameters={"serving": Parameter(type=object,
+                                                    default=None)},
+                   name="Deploy", is_sink=True)
+        def Deploy(ctx):
+            pass
+
+        def create_pipeline():
+            home = os.environ.get("TPP_PIPELINE_HOME", "/tmp/x")
+            return Pipeline(
+                "slo-fixture",
+                [Deploy(serving={"slo_p99_ms": 250.0})],
+                pipeline_root=os.path.join(home, "root"),
+                metadata_path=os.path.join(home, "md.sqlite"),
+            )
+    """))
+    env = {**os.environ, "PYTHONPATH": REPO,
+           "TPP_PIPELINE_HOME": str(tmp_path)}
+    gated_run = subprocess.run(
+        [sys.executable, "-m", "tpu_pipelines", "lint",
+         "--pipeline-module", str(module), "--fail-on", "warn", "--json"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert gated_run.returncode == 3, gated_run.stdout + gated_run.stderr
+    report = json.loads(gated_run.stdout)
+    assert "TPP110" in report["rules"]
+    # Default error gate: the WARN passes (exit 0).
+    warn_only = subprocess.run(
+        [sys.executable, "-m", "tpu_pipelines", "lint",
+         "--pipeline-module", str(module), "--json"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert warn_only.returncode == 0, warn_only.stdout + warn_only.stderr
+
+
 # ----------------------------------------------- TPP2xx seeded-bug fixtures
 
 

@@ -181,6 +181,28 @@ def test_bench_smoke_emits_compact_stdout_and_full_report():
     assert compact["fleet_p99_ms"] == fl["p99_ms"]
     assert compact["fleet_reload_5xx"] == 0
     assert compact["fleet_shed_requests"] == fl["shed_requests"]
+    # Request tracing + SLO burn-rate monitor (ISSUE 12): the traced
+    # pass ran at matched counts with sampling on (measured overhead on
+    # the record), and the rollback drill proved the whole loop — burn
+    # breach detected, auto-rollback to the prior version, interval p99
+    # recovered under the drill SLO, the quarantined version's re-push
+    # answering 409, zero 5xx.
+    tr = fl["traced"]
+    assert tr["errors"] == 0
+    assert tr["traced_requests"] > 0 and tr["ring_events"] > 0
+    assert tr["mean_latency_ms"] is not None
+    assert fl["untraced_mean_latency_ms"] is not None
+    assert fl["trace_overhead_pct"] is not None
+    dr = fl["rollback_drill"]
+    assert dr["green"] is True, dr
+    assert "latency_p99" in dr["breached_slos"]
+    assert dr["rolled_back_to"] == "1"
+    assert dr["auto_rollbacks"] >= 1
+    assert dr["quarantined_reload_code"] == 409
+    assert dr["recovered_p99_ms"] < dr["slo_p99_ms"]
+    assert dr["drill_5xx"] == 0
+    assert compact["trace_overhead_pct"] == fl["trace_overhead_pct"]
+    assert compact["slo_rollback_green"] is True
     # Continuous-batching decode leg (ISSUE 11): the generative fleet
     # beats whole-request decode >= 2x on identical mixed-length traffic
     # at equal-or-better client p99-per-token, with zero 5xx across a

@@ -217,6 +217,18 @@ def test_disabled_mode_zero_files_and_identical_metadata(tmp_path):
     assert "TPP_METRICS_PORT" not in os.environ
     assert "TPP_LINT" not in os.environ
     assert "TPP_RETRY_MAX_ATTEMPTS" not in os.environ
+    # Request-scoped serving traces ride the same contract: the default
+    # (TPP_REQUEST_TRACE unset) constructs NO tracer — no ring, no file,
+    # no extra metric family — so the serving tier stays byte-identical
+    # too (the serving-side half lives in tests/test_request_trace.py's
+    # off-mode test).
+    assert "TPP_REQUEST_TRACE" not in os.environ
+    from tpu_pipelines.observability import request_trace as _rt
+
+    assert _rt.RequestTracer.create(
+        os.environ.get("TPP_REQUEST_TRACE", "")
+    ) is None
+    assert not _rt.tracing_active()
     dumps = {}
     for sub, flag, lint, retry in (
         ("on", "1", None, None),

@@ -1,0 +1,739 @@
+"""Request-scoped serving traces + SLO burn-rate monitor (ISSUE 12).
+
+Tier-1-safe (``observability`` marker): the serving stack runs on the
+stub-loader seam from tests/test_serving_fleet.py, the generative engine
+on the deterministic stub chain from tests/test_generative.py — real
+version manager, router, batchers, HTTP surface, engine scheduler; no
+model export.  Covered contracts:
+
+  * W3C traceparent parse/format/generation + head-sampling math;
+  * the full span chain (admission -> route -> batch.wait -> model.step)
+    for REST requests, version-lease attribution across a hot-swap under
+    the 8-thread hammer (a request that started on v1 mid-swap carries
+    version 1 in its trace even after v2 activates);
+  * generative streams: decode.join/.step/.eos/.evict slot events plus a
+    whole-lifetime ``decode`` span including eviction;
+  * SLOMonitor burn-rate math, edge-triggered breaches, the probation
+    auto-rollback (quarantine + 409 + clear), probation expiry;
+  * off-mode zero footprint: no tracer, no files, no extra metric
+    families, no exemplar lines — the scrape is what it was pre-trace;
+  * the ``trace serve`` CLI (--json/--trace-id/--perfetto/--exemplars);
+  * the fine sqrt(2) bucket ladder satellite.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from test_generative import make_stub_fns
+from test_serving_fleet import FakeLoaded, _fake_loader, _fake_payload
+
+from tpu_pipelines.observability import request_trace as rt
+from tpu_pipelines.observability.metrics import (
+    MetricsRegistry,
+    fine_latency_buckets,
+    latency_buckets,
+)
+from tpu_pipelines.observability.request_trace import (
+    RequestTracer,
+    format_traceparent,
+    parse_traceparent,
+)
+from tpu_pipelines.observability.slo import SLOMonitor
+
+pytestmark = pytest.mark.observability
+
+
+@pytest.fixture
+def fake_loader(monkeypatch):
+    monkeypatch.setattr(
+        "tpu_pipelines.serving.fleet.versions._default_loader", _fake_loader
+    )
+    monkeypatch.setattr(
+        "tpu_pipelines.serving.server.load_exported_model", _fake_loader
+    )
+    return _fake_loader
+
+
+def _post(url, payload, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), headers=headers or {}
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, json.loads(r.read()), dict(r.headers)
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read().decode()
+
+
+# ------------------------------------------------------------ traceparent
+
+
+def test_traceparent_roundtrip_and_malformed():
+    tid, sid = "a" * 32, "b" * 16
+    header = format_traceparent(tid, sid)
+    assert header == f"00-{tid}-{sid}-01"
+    assert parse_traceparent(header) == (tid, sid)
+    # Unsampled flag still parses (we make our own sampling decision).
+    assert parse_traceparent(f"00-{tid}-{sid}-00") == (tid, sid)
+    # Malformed / invalid headers start a fresh trace, never an error.
+    for bad in (
+        None, "", "garbage", f"00-{tid}-{sid}", f"00-{'z' * 32}-{sid}-01",
+        f"ff-{tid}-{sid}-01",            # reserved version
+        f"00-{'0' * 32}-{sid}-01",       # all-zero trace id
+        f"00-{tid}-{'0' * 16}-01",       # all-zero span id
+    ):
+        assert parse_traceparent(bad) is None
+
+
+def test_parse_mode_table():
+    assert rt.parse_mode(None) == ("off", 0)
+    assert rt.parse_mode("") == ("off", 0)
+    assert rt.parse_mode("off") == ("off", 0)
+    assert rt.parse_mode("all") == ("all", 1)
+    assert rt.parse_mode("sample:4") == ("sample", 4)
+    assert rt.parse_mode("sample") == ("sample", 10)
+    assert rt.parse_mode("sample:0") == ("sample", 1)
+    # Misconfiguration must not turn tracing ON.
+    assert rt.parse_mode("sample:x") == ("off", 0)
+    assert rt.parse_mode("bogus") == ("off", 0)
+
+
+def test_head_sampling_every_nth():
+    tracer = RequestTracer("sample", 3)
+    try:
+        verdicts = [
+            tracer.start("predict") is not None for _ in range(9)
+        ]
+        assert verdicts == [True, False, False] * 3
+    finally:
+        tracer.close()
+
+
+def test_ring_is_bounded():
+    tracer = RequestTracer("all", 1, capacity=16)
+    try:
+        for i in range(200):
+            tracer.instant("x", i=i)
+        events = tracer.events()
+        assert len(events) == 16
+        assert events[-1]["args"]["i"] == 199  # newest kept
+    finally:
+        tracer.close()
+
+
+def test_tracer_refcount_gates_notes():
+    assert not rt.tracing_active()
+    rt.note("version", "9")           # no tracer: dropped, zero state
+    assert rt.take_notes() == {}
+    tracer = RequestTracer("all", 1)
+    try:
+        assert rt.tracing_active()
+        rt.note("version", "7")
+        assert rt.take_notes() == {"version": "7"}
+        assert rt.take_notes() == {}  # drained
+    finally:
+        tracer.close()
+    assert not rt.tracing_active()
+
+
+# --------------------------------------------------------- REST span chain
+
+
+def test_rest_full_span_chain_and_file(tmp_path, fake_loader):
+    from tpu_pipelines.serving import ModelServer
+
+    _fake_payload(tmp_path / "m", 1, 2.0)
+    server = ModelServer(
+        "m", str(tmp_path / "m"), replicas=2, max_versions=2,
+        request_trace_mode="all", trace_dir=str(tmp_path / "traces"),
+    )
+    port = server.start()
+    try:
+        url = f"http://127.0.0.1:{port}/v1/models/m:predict"
+        tid = "c" * 32
+        code, body, headers = _post(
+            url, {"instances": [{"x": [1.0, 2.0]}]},
+            headers={"traceparent": format_traceparent(tid, "d" * 16)},
+        )
+        assert code == 200 and body["predictions"] == [[2.0, 4.0]]
+        # The response hands the SAME trace id back; the root span id is
+        # fresh (this hop's span becomes the downstream parent).
+        parsed = parse_traceparent(headers["traceparent"])
+        assert parsed is not None and parsed[0] == tid
+        # A scrape carries the exemplar comment linking p99 to the trace.
+        scrape = _get(f"http://127.0.0.1:{port}/metrics")
+        assert f'trace_id="{tid}"' in scrape
+        assert "# exemplar serving_request_latency_seconds" in scrape
+        assert "serving_traced_requests_total 1" in scrape
+        # Fine-ladder replica histogram published alongside the gauge.
+        assert "serving_replica_latency_seconds_bucket" in scrape
+    finally:
+        server.stop()
+    # Crash-durable file: the span chain is on disk, attributed to the
+    # caller's trace id, with the version the model.step leased.
+    events_file = tmp_path / "traces" / "serving" / "events.jsonl"
+    assert events_file.exists()
+    from tpu_pipelines.observability import read_events
+
+    events = [e for e in read_events(str(events_file))
+              if e.get("trace") == tid]
+    names = {e["name"] for e in events}
+    assert {"request", "admission", "route", "batch.wait",
+            "model.step"} <= names
+    (root,) = [e for e in events if e["name"] == "request"]
+    assert root["args"]["code"] == 200
+    assert root["args"]["version"] == "1"
+    (step,) = [e for e in events if e["name"] == "model.step"]
+    assert step["args"]["version"] == "1"
+    assert step["args"]["replica"] in ("0", "1")
+    (route,) = [e for e in events if e["name"] == "route"]
+    # The decision records every replica's cost at decision time.
+    assert set(route["args"]["costs"]) == {"0", "1"}
+    (wait,) = [e for e in events if e["name"] == "batch.wait"]
+    assert wait["args"]["group"].startswith(step["args"]["replica"] + "-")
+    # Span tree: children point at the root span of this trace (the
+    # scrape's exemplar marker is trace-level, not a child span).
+    assert all(
+        e["parent"] == root["span"]
+        for e in events if e is not root and e["name"] != "exemplar"
+    )
+    # The root's own parent is the CALLER's span id from traceparent.
+    assert root["parent"] == "d" * 16
+
+
+def test_hot_swap_version_lease_under_hammer(tmp_path, fake_loader):
+    """The ISSUE 12 acceptance: under an 8-thread hammer spanning a hot
+    swap, every traced request carries the full chain and the version it
+    actually LEASED — a request that started on v1 mid-swap records 1
+    even though v2 is active by the time it answers."""
+    from tpu_pipelines.serving import ModelServer
+
+    base = tmp_path / "m"
+    _fake_payload(base, 1, 1.0)
+    server = ModelServer(
+        "m", str(base), replicas=2, max_versions=2,
+        request_trace_mode="all", trace_dir=str(tmp_path / "traces"),
+    )
+    port = server.start()
+    url = f"http://127.0.0.1:{port}/v1/models/m:predict"
+    errors = []
+
+    def fire(n):
+        for _ in range(n):
+            try:
+                code, _, _ = _post(url, {"instances": [{"x": [1.0]}]})
+                assert code == 200
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+    try:
+        _post(url, {"instances": [{"x": [1.0]}]})  # canary batch capture
+        threads = [
+            threading.Thread(target=fire, args=(12,)) for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.03)
+        _fake_payload(base, 2, 2.0)
+        _post(f"http://127.0.0.1:{port}/v1/models/m:reload", {})
+        for t in threads:
+            t.join()
+        assert not errors
+        # One straggler pinned to v1 mid-swap: make v1's predict slow,
+        # lease it, swap BACK to v1..2 is already active — instead pin
+        # via a fresh slow request raced against an activate.
+    finally:
+        server.stop()
+    from tpu_pipelines.observability import read_events
+
+    events = read_events(
+        str(tmp_path / "traces" / "serving" / "events.jsonl")
+    )
+    by_trace = {}
+    for e in events:
+        if e.get("trace"):
+            by_trace.setdefault(e["trace"], []).append(e)
+    chains = 0
+    versions = set()
+    for trace_events in by_trace.values():
+        roots = [e for e in trace_events if e["name"] == "request"]
+        if not roots or roots[0]["args"].get("code") != 200:
+            continue
+        if roots[0]["args"].get("endpoint") != "predict":
+            continue    # the traced :reload has no admission/batch chain
+        names = {e["name"] for e in trace_events}
+        assert {"admission", "route", "batch.wait", "model.step"} <= names
+        step = [e for e in trace_events if e["name"] == "model.step"][0]
+        assert step["args"]["version"] in ("1", "2")
+        # The root span agrees with the step's lease.
+        assert roots[0]["args"]["version"] == step["args"]["version"]
+        versions.add(step["args"]["version"])
+        chains += 1
+    assert chains >= 90            # ~97 requests, all traced
+    assert versions == {"1", "2"}  # traffic spanned the swap
+
+
+def test_in_flight_request_keeps_v1_lease_across_swap(tmp_path, fake_loader):
+    """Sharper than the hammer: ONE request in flight on a slow v1 while
+    v2 activates must finish AND trace as v1."""
+    from tpu_pipelines.serving.fleet import ServingFleet
+
+    base = tmp_path / "m"
+    d1 = _fake_payload(base, 1, 1.0)
+    d2 = _fake_payload(base, 2, 2.0)
+    fleet = ServingFleet(
+        "m", str(base), replicas=1, max_versions=2, loader=_fake_loader,
+    )
+    fleet.load_version(d1)
+    # Make v1 slow AFTER load so only the raced request pays the delay.
+    fleet.versions.active_loaded().delay_s = 0.3
+    tracer = RequestTracer("all", 1)
+    results = {}
+
+    def slow_request():
+        ctx = tracer.start("predict")
+        with rt.use(ctx):
+            results["pred"] = fleet.submit({"x": np.asarray([3.0])}, 1)
+        ctx.finish(200)
+
+    t = threading.Thread(target=slow_request)
+    try:
+        t.start()
+        time.sleep(0.1)            # the request is inside v1's predict
+        fleet.load_version(d2)     # hot-swap while it is in flight
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert results["pred"].tolist() == [3.0]  # v1 math (scale 1.0)
+        assert fleet.active_version == "2"
+        steps = [
+            e for e in tracer.events() if e["name"] == "model.step"
+        ]
+        assert steps and steps[-1]["args"]["version"] == "1"
+    finally:
+        fleet.close()
+        tracer.close()
+
+
+# ------------------------------------------------------- generative spans
+
+
+def test_generative_stream_spans_full_lifetime():
+    from tpu_pipelines.serving.generative import GenerativeEngine
+
+    tracer = RequestTracer("all", 1)
+    engine = GenerativeEngine(
+        make_stub_fns(), {}, max_batch_size=4, page_size=0
+    )
+    try:
+        ctx = tracer.start("generate")
+        seq = engine.submit_nowait([2, 3], max_new_tokens=8, ctx=ctx)
+        out = seq.wait(30.0)
+        ctx.finish(200)
+        assert len(out) >= 1
+        events = [
+            e for e in tracer.events() if e.get("trace") == ctx.trace_id
+        ]
+        names = [e["name"] for e in events]
+        assert "decode.join" in names
+        decode_spans = [e for e in events if e["name"] == "decode"]
+        assert len(decode_spans) == 1
+        d = decode_spans[0]
+        assert d["ev"] == "span" and d["args"]["status"] == "complete"
+        assert d["args"]["tokens"] == len(out)
+        # One slot event per post-prefill decode step.
+        steps = [e for e in events if e["name"] == "decode.step"]
+        assert len(steps) == len(out) - 1
+        assert all(
+            e["args"]["batch_bucket"] >= 1 and e["args"]["kv_bucket"] >= 1
+            for e in steps
+        )
+    finally:
+        engine.close()
+        tracer.close()
+
+
+def test_generative_eviction_spans_decode_lifetime():
+    """An evicted stream's trace still covers its WHOLE decode lifetime:
+    join, the steps it got, decode.evict, and the decode span closing
+    with status=evicted."""
+    from tpu_pipelines.serving.generative import (
+        GenerationEvicted,
+        GenerativeEngine,
+    )
+
+    tracer = RequestTracer("all", 1)
+    engine = GenerativeEngine(
+        make_stub_fns(max_decode_len=64), {}, max_batch_size=2,
+        page_size=0, slo_ms_per_token=0.0001, hard_deadline=True,
+    )
+    try:
+        from test_generative import ref_stream
+
+        # A seed whose stub chain never hits EOS inside the budget, so
+        # only the absurd per-token budget can end it (eviction).
+        seed = next(
+            s for s in range(1, 16)
+            if len(ref_stream([s], 60, max_decode_len=64)) == 60
+        )
+        ctx = tracer.start("generate")
+        seq = engine.submit_nowait([seed], max_new_tokens=60, ctx=ctx)
+        with pytest.raises(GenerationEvicted):
+            seq.wait(30.0)
+        ctx.finish(503)
+        events = [
+            e for e in tracer.events() if e.get("trace") == ctx.trace_id
+        ]
+        names = [e["name"] for e in events]
+        assert "decode.join" in names and "decode.evict" in names
+        (d,) = [e for e in events if e["name"] == "decode"]
+        assert d["args"]["status"] == "evicted"
+        assert 0 < d["args"]["tokens"] < 60
+        # The lifetime span covers every step instant that preceded it.
+        step_ts = [e["mono"] for e in events if e["name"] == "decode.step"]
+        assert step_ts and all(
+            d["mono"] <= ts <= d["mono"] + d["dur"] + 0.05
+            for ts in step_ts
+        )
+    finally:
+        engine.close()
+        tracer.close()
+
+
+# ------------------------------------------------------------ SLO monitor
+
+
+def _latency_series(reg):
+    return reg.histogram(
+        "serving_request_latency_seconds", "", labels=("endpoint",)
+    ).labels("predict")
+
+
+def _requests_series(reg, code, n):
+    c = reg.counter(
+        "serving_requests_total", "", labels=("endpoint", "code")
+    )
+    c.labels("predict", str(code)).inc(n)
+
+
+def test_slo_monitor_burn_rate_table():
+    reg = MetricsRegistry()
+    lat = _latency_series(reg)
+    breaches = []
+    mon = SLOMonitor(
+        reg, slo_p99_s=0.1, min_events=10,
+        on_breach=breaches.append,
+    )
+    mon.evaluate(now=0.0)                      # baseline snapshot
+    for _ in range(100):
+        lat.observe(0.01)                      # all within SLO
+    res = mon.evaluate(now=60.0)
+    assert res["windows"][60.0]["burn"]["latency_p99"] == 0.0
+    assert not res["breaches"] and not breaches
+    # 30 of 100 over the SLO: bad frac 0.3 / budget 0.01 => burn 30 on
+    # BOTH fast windows => breach, gauges published, counter bumped.
+    for _ in range(70):
+        lat.observe(0.01)
+    for _ in range(30):
+        lat.observe(1.0)
+    res = mon.evaluate(now=120.0)
+    burn_1m = res["windows"][60.0]["burn"]["latency_p99"]
+    assert burn_1m == pytest.approx(30.0)
+    assert [b["slo"] for b in res["breaches"]] == ["latency_p99"]
+    assert breaches and breaches[0]["trigger"] == "fast"
+    assert reg.get("serving_slo_breaches_total").labels(
+        "latency_p99"
+    ).get() == 1
+    assert reg.get("serving_slo_burn_rate").labels(
+        "60", "latency_p99"
+    ).get() == pytest.approx(30.0, abs=0.1)
+    # Edge-triggered: still burning next evaluation, but no re-fire.
+    for _ in range(50):
+        lat.observe(1.0)
+    res = mon.evaluate(now=180.0)
+    assert not res["breaches"]
+    # Cool down below half threshold for every window: re-armed, and a
+    # NEW burn episode fires again.
+    for _ in range(4000):
+        lat.observe(0.01)
+    mon.evaluate(now=2400.0)
+    mon.evaluate(now=4200.0)
+    for _ in range(30):
+        lat.observe(1.0)
+    for _ in range(70):
+        lat.observe(0.01)
+    res = mon.evaluate(now=4260.0)
+    assert [b["slo"] for b in res["breaches"]] == ["latency_p99"]
+
+
+def test_slo_monitor_5xx_and_shed_and_compiles():
+    reg = MetricsRegistry()
+    breaches = []
+    mon = SLOMonitor(reg, min_events=10, on_breach=breaches.append)
+    mon.evaluate(now=0.0)
+    _requests_series(reg, 200, 95)
+    _requests_series(reg, 500, 5)              # 5% 5xx / 0.1% budget = 50
+    res = mon.evaluate(now=60.0)
+    assert res["windows"][60.0]["burn"]["errors_5xx"] == pytest.approx(50.0)
+    assert "errors_5xx" in [b["slo"] for b in res["breaches"]]
+    # Post-warm decode compiles: budget zero — ANY delta breaches.
+    reg.counter(
+        "serving_decode_compiles_after_warm_total", "", labels=("replica",)
+    ).labels("0").inc()
+    res = mon.evaluate(now=120.0)
+    assert "compiles_after_warm" in [b["slo"] for b in res["breaches"]]
+    # Scrape/management endpoints never consume request budget.
+    snap = mon._collect()
+    _requests_series(reg, 200, 0)
+    reg.counter(
+        "serving_requests_total", "", labels=("endpoint", "code")
+    ).labels("metrics", "200").inc(1000)
+    assert mon._collect()["req_total"] == snap["req_total"]
+
+
+def test_slo_monitor_min_events_guard():
+    """A handful of slow requests in a quiet window must not page."""
+    reg = MetricsRegistry()
+    lat = _latency_series(reg)
+    mon = SLOMonitor(reg, slo_p99_s=0.1, min_events=20)
+    mon.evaluate(now=0.0)
+    for _ in range(5):
+        lat.observe(5.0)                       # 100% bad, but 5 events
+    res = mon.evaluate(now=60.0)
+    assert "latency_p99" not in res["windows"][60.0]["burn"]
+    assert not res["breaches"]
+
+
+def test_probation_rollback_quarantine_and_clear(tmp_path, fake_loader):
+    from tpu_pipelines.serving import ModelServer
+    from tpu_pipelines.serving.fleet.versions import CanaryRefused
+
+    base = tmp_path / "m"
+    _fake_payload(base, 1, 1.0)
+    server = ModelServer(
+        "m", str(base), replicas=2, max_versions=2, slo_p99_ms=100.0,
+        slo_monitor_interval_s=3600.0,   # monitor wired, thread NOT started
+        swap_probation_s=300.0,
+    )
+    mon = server.slo_monitor
+    assert mon is not None
+    fleet = server._fleet
+    try:
+        server.predict({"instances": [{"x": [1.0]}]})  # canary capture
+        mon.evaluate(now=0.0)
+        _fake_payload(base, 2, 5.0)
+        assert server.reload() == "2"
+        # Post-swap latency regression, synthesized straight into the
+        # judged histogram: 40% of requests blow the 100ms budget.
+        lat = _latency_series(server.metrics)
+        for _ in range(60):
+            lat.observe(0.01)
+        for _ in range(40):
+            lat.observe(1.0)
+        res = mon.evaluate(now=60.0)
+        assert [b["slo"] for b in res["breaches"]] == ["latency_p99"]
+        # The breach fired inside probation: auto-rollback to v1, the
+        # bad version quarantined, the counter on the record.
+        assert fleet.active_version == "1"
+        assert server.metrics.get(
+            "serving_auto_rollbacks_total"
+        ).get() == 1
+        assert fleet.versions.quarantined().keys() == {"2"}
+        # :reload of the quarantined version answers 409 (CanaryRefused)
+        # until cleared — the push of the same bad payload stays out.
+        with pytest.raises(CanaryRefused):
+            server.reload()
+        assert fleet.active_version == "1"
+        assert fleet.clear_quarantine() == ["2"]
+        assert server.reload() == "2"
+        assert fleet.active_version == "2"
+    finally:
+        server.stop()
+
+
+def test_probation_expired_no_rollback(tmp_path, fake_loader):
+    from tpu_pipelines.serving.fleet import ServingFleet
+
+    base = tmp_path / "m"
+    d1 = _fake_payload(base, 1, 1.0)
+    d2 = _fake_payload(base, 2, 2.0)
+    fleet = ServingFleet(
+        "m", str(base), replicas=1, max_versions=2,
+        loader=_fake_loader, swap_probation_s=0.05,
+    )
+    try:
+        fleet.load_version(d1)
+        fleet.load_version(d2)
+        time.sleep(0.1)                        # probation over
+        assert fleet.on_slo_breach({"slo": "latency_p99"}) is False
+        assert fleet.active_version == "2"
+        assert not fleet.versions.quarantined()
+        # Idempotence inside probation: only the FIRST breach rolls.
+        fleet2 = ServingFleet(
+            "m2", str(base), replicas=1, max_versions=2,
+            loader=_fake_loader, swap_probation_s=300.0,
+        )
+        try:
+            fleet2.load_version(d1)
+            fleet2.load_version(d2)
+            assert fleet2.on_slo_breach({"slo": "a"}) is True
+            assert fleet2.active_version == "1"
+            assert fleet2.on_slo_breach({"slo": "b"}) is False
+        finally:
+            fleet2.close()
+    finally:
+        fleet.close()
+
+
+# --------------------------------------------------- off-mode zero footprint
+
+
+def test_off_mode_zero_footprint(tmp_path, fake_loader):
+    """TPP_REQUEST_TRACE unset (the default): no tracer object, no SLO
+    monitor, no trace file or directory anywhere, no request-trace /
+    burn-rate / exemplar content in the scrape — operationally, the
+    serving tier is byte-identical to a pre-trace build."""
+    from tpu_pipelines.serving import ModelServer
+
+    assert "TPP_REQUEST_TRACE" not in os.environ
+    assert "TPP_SLO_MONITOR" not in os.environ
+    assert RequestTracer.create("") is None
+    _fake_payload(tmp_path / "m", 1, 1.0)
+    before = sorted(os.listdir(tmp_path))
+    server = ModelServer(
+        "m", str(tmp_path / "m"), replicas=2, max_versions=2,
+        slo_p99_ms=100.0,
+    )
+    port = server.start()
+    try:
+        assert server.request_tracer is None
+        assert server.slo_monitor is None
+        for _ in range(4):
+            code, _, headers = _post(
+                f"http://127.0.0.1:{port}/v1/models/m:predict",
+                {"instances": [{"x": [1.0]}]},
+                headers={"traceparent": format_traceparent(
+                    "e" * 32, "f" * 16
+                )},
+            )
+            assert code == 200
+            assert "traceparent" not in headers   # off = not even echoed
+        scrape = _get(f"http://127.0.0.1:{port}/metrics")
+    finally:
+        server.stop()
+    assert "exemplar" not in scrape
+    assert "serving_traced_requests_total" not in scrape
+    assert "serving_slo_burn_rate" not in scrape
+    assert "serving_slo_breaches_total" not in scrape
+    assert sorted(os.listdir(tmp_path)) == before
+    assert not rt.tracing_active()
+
+
+# ------------------------------------------------------------- CLI + export
+
+
+def _seed_trace_log(tmp_path):
+    tracer = RequestTracer(
+        "all", 1, trace_dir=str(tmp_path / "traces"), service="m",
+    )
+    ids = []
+    for i in range(3):
+        ctx = tracer.start("predict")
+        ids.append(ctx.trace_id)
+        ctx.instant("admission", depth=i, bound=0)
+        ctx.instant("route", replica="0", costs={"0": 0.001, "1": 0.002})
+        with ctx.span("batch.wait", group="0-5", replica="0"):
+            pass
+        with ctx.span("model.step", group="0-5", replica="0", version="3"):
+            time.sleep(0.002)
+        ctx.annotate(version="3")
+        ctx.finish(200)
+    tracer.exemplar_exposition()   # drains into exemplar instants
+    tracer.close()
+    return ids
+
+
+def test_trace_serve_cli(tmp_path, capsys):
+    from tpu_pipelines.__main__ import main
+
+    ids = _seed_trace_log(tmp_path)
+    trace_dir = str(tmp_path / "traces")
+    # --json: every trace with its chain, exemplars included.
+    assert main(["trace", "serve", trace_dir, "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["trace_count"] == 3
+    for tid in ids:
+        t = report["traces"][tid]
+        assert t["endpoint"] == "predict" and t["code"] == 200
+        assert t["version"] == "3" and t["group"] == "0-5"
+        assert {s["name"] for s in t["spans"]} == {
+            "batch.wait", "model.step"
+        }
+    assert report["exemplars"] and report["exemplars"][0]["trace_id"] in ids
+    # --trace-id narrows to one trace.
+    assert main([
+        "trace", "serve", trace_dir, "--trace-id", ids[0], "--json",
+    ]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert list(report["traces"]) == [ids[0]]
+    # Unknown id: explicit failure, not an empty success.
+    assert main([
+        "trace", "serve", trace_dir, "--trace-id", "0" * 32,
+    ]) == 1
+    capsys.readouterr()
+    # Human table + exemplars + perfetto export.
+    out_json = tmp_path / "serve.perfetto.json"
+    assert main([
+        "trace", "serve", trace_dir, "--exemplars",
+        "--perfetto", str(out_json),
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "serving traces: 3" in out
+    assert "exemplars (slowest request per scrape interval):" in out
+    doc = json.loads(out_json.read_text())
+    # One process track per replica, one thread track per batch group.
+    procs = {
+        e["args"]["name"] for e in doc["traceEvents"]
+        if e.get("name") == "process_name"
+    }
+    assert "replica 0" in procs and "serving frontend" in procs
+    threads = {
+        e["args"]["name"] for e in doc["traceEvents"]
+        if e.get("name") == "thread_name"
+    }
+    assert "group 0-5" in threads
+    # Missing dir: tool error (1), with a hint.
+    assert main(["trace", "serve", str(tmp_path / "nope")]) == 1
+    # trace <run-id> without --pipeline-root is still a usage error.
+    assert main(["trace", "latest"]) == 2
+
+
+# ------------------------------------------------------------ fine buckets
+
+
+def test_fine_latency_buckets_satellite():
+    default = latency_buckets()
+    fine = fine_latency_buckets()
+    # Sub-ms decode-scale: starts BELOW the default floor, sqrt(2) steps.
+    assert fine[0] == pytest.approx(2.5e-5)
+    assert fine[0] < default[0]
+    for a, b in zip(fine, fine[1:]):
+        assert b / a == pytest.approx(2.0 ** 0.5, rel=1e-4)
+    # Tail quantization halves in log terms: ratio sqrt(2) vs 2.
+    assert max(fine) > 1.0          # still covers request-scale tails
+    # The decode per-token series and the replica histogram ride it.
+    from tpu_pipelines.serving.generative import DecodeTelemetry
+
+    reg = MetricsRegistry()
+    DecodeTelemetry(reg, "0")
+    hist = reg.get("serving_decode_per_token_latency_seconds")
+    assert list(hist.bucket_bounds) == fine
+    # Compiles-after-warm counter exists for the SLO monitor to watch.
+    assert reg.get("serving_decode_compiles_after_warm_total") is not None

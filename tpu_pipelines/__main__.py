@@ -216,9 +216,14 @@ def cmd_trace(args) -> int:
 
     if args.run_id[0] == "diff":
         return cmd_trace_diff(args)
+    if args.run_id[0] == "serve":
+        return cmd_trace_serve(args)
     if len(args.run_id) != 1:
-        print("trace takes one run id (or: trace diff <a> <b>)",
-              file=sys.stderr)
+        print("trace takes one run id (or: trace diff <a> <b>, "
+              "trace serve <trace_dir>)", file=sys.stderr)
+        return 2
+    if not args.pipeline_root:
+        print("trace <run-id> requires --pipeline-root", file=sys.stderr)
         return 2
     loaded, err = _load_run_metrics(args.pipeline_root, args.run_id[0])
     if err:
@@ -259,6 +264,9 @@ def cmd_trace_diff(args) -> int:
         print("trace diff needs exactly two run ids: trace diff <a> <b>",
               file=sys.stderr)
         return 2
+    if not args.pipeline_root:
+        print("trace diff requires --pipeline-root", file=sys.stderr)
+        return 2
     loaded = []
     for rid in ids:
         got, err = _load_run_metrics(args.pipeline_root, rid)
@@ -277,6 +285,83 @@ def cmd_trace_diff(args) -> int:
         print(f"trace diff: {id_a} (baseline) -> {id_b}")
         print(format_diff(diff))
     return 3 if diff["regressed"] else 0
+
+
+def cmd_trace_serve(args) -> int:
+    """``trace serve <trace_dir>``: read/filter/export the serving tier's
+    request traces (<trace_dir>/serving/events.jsonl, written when
+    TPP_REQUEST_TRACE is on and a trace dir is configured).  ``--trace-id``
+    narrows to one trace (the id a traceparent response header / metrics
+    exemplar carries), ``--perfetto`` writes the replica/batch-group
+    timeline, ``--exemplars`` lists the scrape-interval exemplar links."""
+    import json as _json
+    import os
+
+    from tpu_pipelines.observability import read_events
+    from tpu_pipelines.observability.export import (
+        export_perfetto_requests,
+        format_request_traces,
+        summarize_request_traces,
+    )
+
+    if len(args.run_id) != 2:
+        print("trace serve needs a trace dir: trace serve <trace_dir>",
+              file=sys.stderr)
+        return 2
+    trace_dir = args.run_id[1]
+    events_file = os.path.join(trace_dir, "serving", "events.jsonl")
+    if not os.path.exists(events_file):
+        # Accept the serving/ dir (or the file) directly too.
+        for cand in (
+            os.path.join(trace_dir, "events.jsonl"), trace_dir,
+        ):
+            if os.path.isfile(cand):
+                events_file = cand
+                break
+        else:
+            print(
+                f"no serving trace log at {events_file} (was the server "
+                "started with TPP_REQUEST_TRACE=sample:N|all and a "
+                "TPP_REQUEST_TRACE_DIR?)", file=sys.stderr,
+            )
+            return 1
+    events = read_events(events_file)
+    if args.trace_id:
+        events = [
+            e for e in events
+            if e.get("trace") == args.trace_id
+            or (e.get("args") or {}).get("trace_id") == args.trace_id
+        ]
+        if not events:
+            print(f"no events for trace id {args.trace_id}",
+                  file=sys.stderr)
+            return 1
+    summary = summarize_request_traces(events)
+    if args.json:
+        print(_json.dumps(
+            {"events": len(events), **summary}, indent=1, sort_keys=True,
+            default=str,
+        ))
+    else:
+        print(f"serving traces: {summary['trace_count']} "
+              f"({len(events)} events, {events_file})")
+        print(format_request_traces(summary))
+        if args.exemplars:
+            print("exemplars (slowest request per scrape interval):")
+            for ex in summary["exemplars"]:
+                print(
+                    f"  {ex['endpoint']:<9} "
+                    f"{(ex['latency_s'] or 0.0) * 1e3:>9.2f}ms  "
+                    f"trace {ex['trace_id']}"
+                )
+            if not summary["exemplars"]:
+                print("  <none recorded — /metrics scrapes drain them>")
+    if args.perfetto:
+        path = export_perfetto_requests(events, args.perfetto)
+        if not args.json:
+            print(f"perfetto timeline: {path} "
+                  "(one track per replica and batch group)")
+    return 0
 
 
 def cmd_lineage(store: MetadataStore, artifact_id: int) -> int:
@@ -367,15 +452,18 @@ def main(argv=None) -> int:
 
     p_trace = sub.add_parser(
         "trace",
-        help="summarize/export a run's RunTrace event log, or compare "
-             "two runs: trace diff <a> <b>",
+        help="summarize/export a run's RunTrace event log, compare two "
+             "runs (trace diff <a> <b>), or read the serving tier's "
+             "request traces (trace serve <trace_dir>)",
     )
     p_trace.add_argument(
         "run_id", nargs="+",
-        help="run id or 'latest'; or: diff <run-a> <run-b>",
+        help="run id or 'latest'; or: diff <run-a> <run-b>; or: "
+             "serve <trace_dir>",
     )
-    p_trace.add_argument("--pipeline-root", required=True,
-                         help="pipeline root containing .runs/<run-id>/")
+    p_trace.add_argument("--pipeline-root", default="",
+                         help="pipeline root containing .runs/<run-id>/ "
+                              "(required except for trace serve)")
     p_trace.add_argument("--perfetto", default="", metavar="OUT_JSON",
                          help="write a Chrome/Perfetto trace.json here")
     p_trace.add_argument("--metrics", default="", metavar="OUT_JSON",
@@ -386,6 +474,16 @@ def main(argv=None) -> int:
         "--threshold", type=float, default=0.2,
         help="diff regression threshold as a fraction (default 0.2 = "
              "20%% slower flags; exit code 3 on any flag)",
+    )
+    p_trace.add_argument(
+        "--trace-id", default="",
+        help="trace serve: only this trace id (from a traceparent "
+             "response header or a /metrics exemplar)",
+    )
+    p_trace.add_argument(
+        "--exemplars", action="store_true",
+        help="trace serve: list the slowest-request-per-scrape exemplar "
+             "links next to the trace table",
     )
 
     p_lin = isub.add_parser("lineage", parents=[md_parent],

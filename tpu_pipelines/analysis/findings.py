@@ -80,6 +80,13 @@ RULES: Dict[str, Dict[str, str]] = {
                  "the live serving tier with no canary smoke check before "
                  "the push",
     },
+    "TPP110": {
+        "severity": WARN,
+        "title": "serving SLO declared (slo_p99_ms) with no metrics "
+                 "registry / SLO monitor wired in the same config: the "
+                 "target shapes batching but nothing watches burn rates "
+                 "or triggers the post-swap auto-rollback",
+    },
     # ---- TPP2xx: executor/AST code rules (code_rules.py) ----
     "TPP201": {
         "severity": WARN,

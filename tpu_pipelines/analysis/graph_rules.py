@@ -342,6 +342,73 @@ def check_pusher_without_infra_validator(ir: PipelineIR) -> List[Finding]:
     return out
 
 
+_SLO_DECL_KEYS = ("slo_p99_ms", "slo_p99_s", "slo_ms_per_token")
+_SLO_MONITOR_KEYS = (
+    "slo_monitor", "slo_monitor_interval_s", "metrics_registry",
+    "registry", "metrics_port", "monitor",
+)
+
+
+def check_slo_without_monitor(ir: PipelineIR) -> List[Finding]:
+    """TPP110: a serving config in the exec-property tree declares an SLO
+    target (``slo_p99_ms``/``slo_p99_s``/``slo_ms_per_token`` > 0) but
+    wires no observability next to it.  The target silently shapes the
+    batch gather window (serving/batching.py) — real behavior changes —
+    yet nothing evaluates burn rates against it, so a blown SLO neither
+    alerts nor triggers the fleet's post-swap auto-rollback
+    (``ServingFleet.on_slo_breach``): an SLO declared yet unobservable.
+    Detected structurally on dict literals carried as exec properties
+    (serving configs a Pusher/InfraValidator/custom deploy component
+    forwards); a monitor key in the SAME mapping is the wiring."""
+    out = []
+    for node in ir.nodes:
+        for path, value in _walk_dicts(node.exec_properties):
+            declared = None
+            for key in _SLO_DECL_KEYS:
+                v = value.get(key)
+                if isinstance(v, (int, float)) and not isinstance(
+                    v, bool
+                ) and v > 0:
+                    declared = key
+                    break
+            if declared is None:
+                continue
+            if any(k in value for k in _SLO_MONITOR_KEYS):
+                continue
+            where = f"exec_properties[{path}]" if path else "exec_properties"
+            out.append(Finding(
+                rule="TPP110", severity=WARN, node_id=node.id,
+                message=(
+                    f"{where} declares {declared}="
+                    f"{value[declared]!r} with no metrics registry or "
+                    "SLO monitor in the same config: the target drives "
+                    "the batch window but nothing watches burn rates or "
+                    "arms the post-swap auto-rollback"
+                ),
+                fix=(
+                    "wire the monitor next to the target (e.g. "
+                    "slo_monitor_interval_s=5 / env TPP_SLO_MONITOR, or "
+                    "metrics_registry=...) so SLOMonitor evaluates burn "
+                    "rates and ServingFleet.on_slo_breach can fire "
+                    "(docs/OBSERVABILITY.md), or suppress if an external "
+                    "system scrapes and alerts"
+                ),
+            ))
+    return out
+
+
+def _walk_dicts(obj, prefix=""):
+    """Yield (path, dict) over every mapping in a nested exec-property
+    tree (the dict itself first, then its children)."""
+    if isinstance(obj, dict):
+        yield prefix, obj
+        for k, v in obj.items():
+            yield from _walk_dicts(v, f"{prefix}.{k}" if prefix else str(k))
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            yield from _walk_dicts(v, f"{prefix}[{i}]")
+
+
 def _walk_props(obj, prefix=""):
     """Yield (path, value) over nested dict/list exec-property trees."""
     if isinstance(obj, dict):
@@ -368,4 +435,5 @@ GRAPH_RULES = (
     check_duplicate_node_ids,
     check_retry_policy_under_spmd,
     check_pusher_without_infra_validator,
+    check_slo_without_monitor,
 )

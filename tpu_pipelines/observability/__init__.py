@@ -45,6 +45,7 @@ from tpu_pipelines.observability.export import (  # noqa: F401
 from tpu_pipelines.observability.metrics import (  # noqa: F401
     MetricsRegistry,
     default_registry,
+    fine_latency_buckets,
     histogram_quantile,
     latency_buckets,
     start_http_server,
@@ -52,4 +53,15 @@ from tpu_pipelines.observability.metrics import (  # noqa: F401
 from tpu_pipelines.observability.health import (  # noqa: F401
     HealthMonitor,
     stall_timeout_from_env,
+)
+from tpu_pipelines.observability.request_trace import (  # noqa: F401
+    ENV_REQUEST_TRACE,
+    RequestTracer,
+    format_traceparent,
+    parse_traceparent,
+)
+from tpu_pipelines.observability.slo import SLOMonitor  # noqa: F401
+from tpu_pipelines.observability.export import (  # noqa: F401
+    summarize_request_traces,
+    to_perfetto_requests,
 )

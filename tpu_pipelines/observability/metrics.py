@@ -54,6 +54,7 @@ __all__ = [
     "MetricsRegistry",
     "MetricsServer",
     "default_registry",
+    "fine_latency_buckets",
     "latency_buckets",
     "histogram_quantile",
     "start_http_server",
@@ -71,6 +72,28 @@ def latency_buckets(
     decades — the serving path cares about 1ms as much as 1s — and a
     FIXED ladder means two runs' histograms are always mergeable and
     diffable bucket-by-bucket.
+    """
+    return [round(start_s * factor**i, 10) for i in range(count)]
+
+
+def fine_latency_buckets(
+    start_s: float = 2.5e-5, factor: float = 2.0 ** 0.5, count: int = 32
+) -> List[float]:
+    """Finer ladder for decode-scale latencies: 25µs … ~1.6s at sqrt(2).
+
+    The default x2 ladder floors at 100µs and quantizes a scraped
+    quantile by up to ~2x (an observation lands at its enclosing
+    bucket's upper bound) — tolerable for request latencies in the tens
+    of ms, but a per-decode-token latency lives BELOW the default
+    ladder's first bucket, and a 2x-quantized replica p99 forces the
+    SLO batcher to hold back most of its budget (the 0.35 window
+    fraction in serving/batching.py).  sqrt(2) spacing from 25µs halves
+    the log-step: worst-case quantile read-up drops to ~1.42x, and
+    sub-ms decode steps resolve instead of piling into one bucket.
+    Same fixed-ladder property as :func:`latency_buckets` — histograms
+    on this ladder always merge and diff bucket-by-bucket.  Existing
+    series keep the default ladder; only series that opt in via
+    ``Histogram(buckets=fine_latency_buckets())`` change.
     """
     return [round(start_s * factor**i, 10) for i in range(count)]
 

@@ -76,11 +76,21 @@ class TraceRecorder:
     worker pool, and forked shard-pool processes.
     """
 
-    def __init__(self, run_dir: str, run_id: str):
+    def __init__(
+        self, run_dir: str, run_id: str, *, events_path: Optional[str] = None
+    ):
         self.run_id = run_id
         self.run_dir = run_dir
-        self.trace_dir = os.path.join(run_dir, "trace")
-        self.events_path = os.path.join(self.trace_dir, "events.jsonl")
+        # events_path override: the request-trace layer
+        # (observability/request_trace.py) reuses this recorder's
+        # crash-durable append against its own <trace_dir>/serving/
+        # events.jsonl instead of the run-scoped trace/ layout.
+        if events_path is not None:
+            self.trace_dir = os.path.dirname(events_path)
+            self.events_path = events_path
+        else:
+            self.trace_dir = os.path.join(run_dir, "trace")
+            self.events_path = os.path.join(self.trace_dir, "events.jsonl")
         os.makedirs(self.trace_dir, exist_ok=True)
         self._lock = threading.Lock()
         self._pid = os.getpid()
@@ -124,6 +134,12 @@ class TraceRecorder:
             # that was emitted is on disk before the next statement runs.
             self._fh.write(line + "\n")
             self._fh.flush()
+
+    def emit(self, record: Dict[str, Any]) -> None:
+        """Append a caller-built record (the request-trace layer builds
+        its own schema with trace/span ids); same crash-durable,
+        fork-safe single-line append as the span emitters."""
+        self._write(record)
 
     def _base(self, ev: str, name: str, cat: str, node: str) -> Dict[str, Any]:
         t = threading.current_thread()

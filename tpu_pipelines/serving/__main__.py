@@ -88,6 +88,24 @@ def main(argv=None) -> int:
     parser.add_argument("--grpc-port", type=int, default=-1,
                         help="also serve gRPC predict on this port "
                              "(0 = ephemeral; -1 = REST only)")
+    parser.add_argument("--request-trace", default="",
+                        help="request-scoped tracing: off | sample:N | "
+                             "all (empty = env TPP_REQUEST_TRACE, "
+                             "default off — zero files, byte-identical "
+                             "/metrics)")
+    parser.add_argument("--trace-dir", default="",
+                        help="flush sampled request spans to "
+                             "<dir>/serving/events.jsonl (read back with "
+                             "`python -m tpu_pipelines trace serve "
+                             "<dir>`); empty = env TPP_REQUEST_TRACE_DIR, "
+                             "else in-memory ring only")
+    parser.add_argument("--slo-monitor", type=float, default=-1.0,
+                        help="SLO burn-rate monitor evaluation interval "
+                             "(seconds; fleet mode with --slo-p99-ms): "
+                             "breaches inside the TPP_SWAP_PROBATION_S "
+                             "window auto-roll back to the prior "
+                             "version; negative = env TPP_SLO_MONITOR, "
+                             "0 = off")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -112,6 +130,9 @@ def main(argv=None) -> int:
                 decode_page_size=args.decode_page_size,
                 max_queue_tokens=args.max_queue_tokens,
                 slo_ms_per_token=args.slo_ms_per_token,
+                request_trace_mode=args.request_trace,
+                trace_dir=args.trace_dir,
+                slo_monitor_interval_s=args.slo_monitor,
             )
             break
         except FileNotFoundError:

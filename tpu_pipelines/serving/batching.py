@@ -46,6 +46,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from tpu_pipelines.observability import request_trace
+
 Batch = Dict[str, np.ndarray]
 
 
@@ -154,6 +156,17 @@ class RequestBatcher:
     # window at half the budget would make the MEASURED p99 ride the
     # target even when the true tail is under it.
     SLO_WINDOW_FRAC = 0.35
+    # Re-derivation against the sqrt(2) fine ladder (metrics.
+    # fine_latency_buckets, what serving_replica_latency_seconds and the
+    # decode per-token series observe into): measured p99 <= sqrt(2) x
+    # true, so keeping measured under budget needs true < budget/sqrt(2)
+    # ~= 0.707 x budget; applying the same un-modeled-latency margin
+    # ratio the default frac keeps (0.35/0.5 = 0.7) gives 0.7 x 0.707
+    # ~= 0.5.  Opt in via ``window_frac=RequestBatcher.
+    # SLO_WINDOW_FRAC_FINE`` ONLY where the p99 verdict is read from a
+    # fine-ladder series; the default stays 0.35 because
+    # serving_request_latency_seconds keeps the x2 ladder.
+    SLO_WINDOW_FRAC_FINE = 0.5
     # EWMA smoothing for the observed model step time: heavy enough to
     # ride out one slow batch (GC pause), light enough to track a real
     # drift (hot-swap to a bigger version) within a few batches.
@@ -166,7 +179,9 @@ class RequestBatcher:
         max_batch_size: int = 64,
         batch_timeout_s: float = 0.005,
         slo_p99_s: float = 0.0,
+        window_frac: Optional[float] = None,
         registry=None,
+        name: str = "",
     ):
         if max_batch_size < 1:
             raise ValueError(f"max_batch_size must be >= 1, got {max_batch_size}")
@@ -174,7 +189,14 @@ class RequestBatcher:
         self.max_batch_size = max_batch_size
         self.batch_timeout_s = batch_timeout_s
         self.slo_p99_s = max(0.0, slo_p99_s)
+        self.window_frac = (
+            self.SLO_WINDOW_FRAC if window_frac is None else float(window_frac)
+        )
+        # Identifies this batcher in request-trace spans (the replica
+        # name in fleet mode); group ids are "<name>-<batch index>".
+        self.name = name
         self._step_ewma_s: Optional[float] = None
+        self._last_window_s = batch_timeout_s
         self.buckets = bucket_sizes(max_batch_size)
         self.batches_run = 0          # observability: device calls issued
         self.requests_served = 0
@@ -237,11 +259,12 @@ class RequestBatcher:
         else:
             window = max(
                 0.0,
-                self.slo_p99_s * self.SLO_WINDOW_FRAC
+                self.slo_p99_s * self.window_frac
                 - self.SLO_STEP_BUDGET * self._step_ewma_s,
             )
         if self._m_deadline is not None:
             self._m_deadline.set(window)
+        self._last_window_s = window
         return window
 
     def _observe_step(self, step_s: float) -> None:
@@ -256,12 +279,22 @@ class RequestBatcher:
     # ------------------------------------------------------------- client
 
     def submit(
-        self, batch: Batch, n_rows: int, timeout_s: float = 300.0
+        self,
+        batch: Batch,
+        n_rows: int,
+        timeout_s: float = 300.0,
+        ctx=None,
     ) -> np.ndarray:
         """Blocking predict for one request's feature batch (n_rows rows).
 
         ``timeout_s`` bounds the wait (covers first-bucket XLA compiles with
-        room to spare); a closed batcher raises immediately."""
+        room to spare); a closed batcher raises immediately.  ``ctx`` is
+        the request-trace context riding the queue item (contextvars do
+        not cross into the worker thread); None falls back to the
+        calling thread's current trace, so the single-server path traces
+        without any caller plumbing."""
+        if ctx is None:
+            ctx = request_trace.current()
         fut: "Future[np.ndarray]" = Future()
         with self._close_lock:
             # Checked under the close lock: a submit racing close() must
@@ -272,7 +305,7 @@ class RequestBatcher:
             # The enqueue instant anchors the gather deadline: a request
             # that waited out the PREVIOUS group's gather must not pay a
             # second full window.
-            self._queue.put((batch, n_rows, fut, time.monotonic()))
+            self._queue.put((batch, n_rows, fut, time.monotonic(), ctx))
         return fut.result(timeout=timeout_s)
 
     def close(self, timeout_s: float = 5.0) -> None:
@@ -391,9 +424,13 @@ class RequestBatcher:
         }
         total = sum(n for _, n, *_ in group)
         padded = pad_to_bucket(merged, total, self.buckets)
+        group_id = f"{self.name or 'b'}-{self.batches_run}"
+        t0_wall = time.time()
         t0 = time.monotonic()
         preds = np.asarray(self.predict_fn(padded))[:total]
-        self._observe_step(time.monotonic() - t0)
+        step_s = time.monotonic() - t0
+        self._emit_group_spans(group, group_id, total, t0_wall, t0, step_s)
+        self._observe_step(step_s)
         self.batches_run += 1
         self.requests_served += len(group)
         if self._m_batches is not None:
@@ -408,6 +445,38 @@ class RequestBatcher:
                 except Exception:  # noqa: BLE001 — lost the close race
                     pass
             offset += n
+
+    def _emit_group_spans(
+        self, group, group_id: str, total: int,
+        t0_wall: float, t0_mono: float, step_s: float,
+    ) -> None:
+        """Request-trace spans for one dispatched group: per sampled
+        request, the gather wait it paid (enqueue -> dispatch, which
+        group it rode) and the shared device call (the model step, with
+        the version the fleet leased for it — request_trace.note from
+        inside predict_fn).  No-op for untraced requests."""
+        if not any(entry[4] is not None for entry in group):
+            if request_trace.tracing_active():
+                request_trace.take_notes()  # leased version, now stale
+            return
+        notes = request_trace.take_notes()
+        for _batch, n, _fut, t_enq, ctx in group:
+            if ctx is None:
+                continue
+            wait_s = max(0.0, t0_mono - t_enq)
+            ctx.complete_span(
+                "batch.wait", t0_wall - wait_s, t_enq, wait_s,
+                group=group_id, replica=self.name,
+                window_s=round(self._last_window_s, 6),
+                requests=len(group),
+            )
+            ctx.complete_span(
+                "model.step", t0_wall, t0_mono, step_s,
+                group=group_id, replica=self.name, rows=total,
+                request_rows=n, **notes,
+            )
+            if notes:
+                ctx.annotate(**notes)
 
     def _execute(self, group) -> None:
         try:

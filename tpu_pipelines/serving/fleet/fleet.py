@@ -18,14 +18,27 @@ it with :meth:`set_canary_batch`.
 
 from __future__ import annotations
 
+import logging
+import os
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from tpu_pipelines.observability import request_trace
 from tpu_pipelines.serving.fleet.pool import ReplicaPool
 from tpu_pipelines.serving.fleet.replica import Replica
 from tpu_pipelines.serving.fleet.versions import ModelVersionManager
+
+log = logging.getLogger("tpu_pipelines.serving")
+
+# Post-swap probation window (seconds): an SLO burn-rate breach inside
+# it is attributed to the swap and auto-rolls back to the prior resident
+# version; past it, breaches are the operator's page, not the fleet's
+# trigger (a long-running version degrading is not the new push's fault).
+ENV_SWAP_PROBATION = "TPP_SWAP_PROBATION_S"
+DEFAULT_SWAP_PROBATION_S = 120.0
 
 
 def _local_devices() -> List[Any]:
@@ -55,6 +68,7 @@ class ServingFleet:
         decode_page_size: int = 0,
         max_queue_tokens: int = 0,
         slo_ms_per_token: float = 0.0,
+        swap_probation_s: float = -1.0,
         registry=None,
         loader: Optional[Callable[[str], Any]] = None,
     ):
@@ -68,9 +82,27 @@ class ServingFleet:
         self.raw = raw
         self.slo_p99_s = slo_p99_s
         self.model_type = model_type
+        if swap_probation_s < 0:
+            try:
+                swap_probation_s = float(
+                    os.environ.get(ENV_SWAP_PROBATION, "").strip()
+                    or DEFAULT_SWAP_PROBATION_S
+                )
+            except ValueError:
+                swap_probation_s = DEFAULT_SWAP_PROBATION_S
+        self.swap_probation_s = max(0.0, swap_probation_s)
         self._max_batch_size = max_batch_size
         self._canary_batch: Optional[Dict[str, Any]] = None
         self._canary_lock = threading.Lock()
+        self._rollback_lock = threading.Lock()
+        self._m_rollbacks = None
+        if registry is not None:
+            self._m_rollbacks = registry.counter(
+                "serving_auto_rollbacks_total",
+                "Automatic activations of the prior resident version "
+                "after an SLO burn-rate breach inside the post-swap "
+                "probation window.",
+            )
         self.versions = ModelVersionManager(
             model_name,
             max_versions=max_versions,
@@ -120,22 +152,38 @@ class ServingFleet:
         """Every device call runs under a version lease: a hot-swap during
         the call cannot evict the version mid-predict, and the drain the
         swap contract promises is the lease count hitting zero."""
-        with self.versions.lease() as (_, loaded):
+        with self.versions.lease() as (version, loaded):
+            # Runs in the batcher worker thread, below the span emitter:
+            # the thread-local note surfaces the leased version onto the
+            # model.step span (one global int read when tracing is off).
+            request_trace.note("version", version)
             return np.asarray(self._predict_callable(loaded)(batch))
 
     def submit(
-        self, batch: Dict[str, Any], n_rows: int, timeout_s: float = 300.0
+        self,
+        batch: Dict[str, Any],
+        n_rows: int,
+        timeout_s: float = 300.0,
+        ctx=None,
     ) -> np.ndarray:
+        if ctx is None:
+            ctx = request_trace.current()
+        result = self.pool.submit(batch, n_rows, timeout_s=timeout_s, ctx=ctx)
         if self._canary_batch is None:
             with self._canary_lock:
                 if self._canary_batch is None:
-                    # First served request becomes the canary probe for
-                    # future pushes: by construction it is a batch the
-                    # ACTIVE version answers, i.e. the live request shape.
+                    # First SUCCESSFULLY served request becomes the
+                    # canary probe for future pushes: by construction it
+                    # is a batch the ACTIVE version answers, i.e. the
+                    # live request shape.  Captured only after the
+                    # predict returned — a malformed first request
+                    # (missing feature, bad dtype) must not become the
+                    # probe, or every future push would fail the canary
+                    # on the CALLER's mistake.
                     self._canary_batch = {
                         k: np.asarray(v) for k, v in batch.items()
                     }
-        return self.pool.submit(batch, n_rows, timeout_s=timeout_s)
+        return result
 
     # ---------------------------------------------------------- generative
 
@@ -167,9 +215,16 @@ class ServingFleet:
             if mask is not None:
                 row["input_mask"] = np.asarray(mask)[i]
             rows.append(row)
-        replica = self.pool.router.pick(self.pool.replicas)
+        ctx = request_trace.current()
+        if ctx is None:
+            replica = self.pool.router.pick(self.pool.replicas)
+        else:
+            replica, costs = self.pool.router.pick_with_costs(
+                self.pool.replicas
+            )
+            ctx.instant("route", replica=replica.name, costs=costs)
         return replica.decode_submit(
-            rows, dict(gen_params or {}), timeout_s=timeout_s
+            rows, dict(gen_params or {}), timeout_s=timeout_s, ctx=ctx
         )
 
     def outstanding_tokens(self) -> int:
@@ -231,6 +286,56 @@ class ServingFleet:
             return f"bucket warmup failed: {type(e).__name__}: {e}"
         return ""
 
+    # -------------------------------------------------- SLO auto-rollback
+
+    def on_slo_breach(self, breach: Dict[str, Any]) -> bool:
+        """Default breach policy: canary-style probation rollback.
+
+        An SLO burn-rate breach (observability/slo.py) that fires within
+        ``swap_probation_s`` of the last hot-swap is attributed to the
+        swap: the prior resident version is re-``activate()``\\ d (an
+        instant swap — it never left memory), the bad version is
+        quarantined so a repeat ``:reload`` of it answers 409 until
+        :meth:`clear_quarantine`, and ``serving_auto_rollbacks_total``
+        records the event.  Returns True when a rollback happened —
+        False when no recent swap, probation expired, the prior version
+        is gone, or a rollback already ran (idempotent under the
+        monitor's edge-triggered breaches AND a racing double-fire)."""
+        with self._rollback_lock:
+            swap = self.versions.last_swap()
+            if swap is None or self.swap_probation_s <= 0:
+                return False
+            if swap.get("rollback"):
+                return False    # our own rollback opened no probation
+            age_s = time.monotonic() - swap["mono"]
+            if age_s > self.swap_probation_s:
+                return False
+            bad, prior = swap["version"], swap["prior"]
+            if prior is None or self.versions.active_version != bad:
+                return False
+            if prior not in self.versions.resident_versions():
+                return False
+            self.versions.quarantine(
+                bad,
+                reason=(
+                    f"SLO breach ({breach.get('slo', '?')}) "
+                    f"{age_s:.1f}s after swap"
+                ),
+            )
+            self.versions.activate(prior, rollback=True)
+            if self._m_rollbacks is not None:
+                self._m_rollbacks.inc()
+            log.warning(
+                "fleet: %s auto-rollback %s -> %s (%s burn breach %.1fs "
+                "into the %.0fs probation window)",
+                self.model_name, bad, prior, breach.get("slo", "?"),
+                age_s, self.swap_probation_s,
+            )
+            return True
+
+    def clear_quarantine(self, version: Optional[str] = None) -> List[str]:
+        return self.versions.clear_quarantine(version)
+
     # ----------------------------------------------------------- lifecycle
 
     def load_version(self, version_dir: str) -> str:
@@ -271,6 +376,9 @@ class ServingFleet:
             ),
             "model_type": self.model_type,
         }
+        quarantined = self.versions.quarantined()
+        if quarantined:
+            health["quarantined_versions"] = sorted(quarantined)
         if self.generative:
             health["outstanding_decode_tokens"] = self.outstanding_tokens()
         return health

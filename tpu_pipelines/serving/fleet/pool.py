@@ -37,10 +37,21 @@ class ReplicaPool:
         return sum(r.queue_depth() for r in self.replicas)
 
     def submit(
-        self, batch: Dict[str, Any], n_rows: int, timeout_s: float = 300.0
+        self,
+        batch: Dict[str, Any],
+        n_rows: int,
+        timeout_s: float = 300.0,
+        ctx=None,
     ) -> np.ndarray:
-        replica = self.router.pick(self.replicas)
-        return replica.submit(batch, n_rows, timeout_s=timeout_s)
+        if ctx is None:
+            replica = self.router.pick(self.replicas)
+        else:
+            # Traced request: record the route DECISION, not just the
+            # outcome — the chosen replica plus what every replica cost
+            # at that instant.
+            replica, costs = self.router.pick_with_costs(self.replicas)
+            ctx.instant("route", replica=replica.name, costs=costs)
+        return replica.submit(batch, n_rows, timeout_s=timeout_s, ctx=ctx)
 
     @property
     def closed(self) -> bool:

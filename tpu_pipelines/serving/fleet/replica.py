@@ -122,10 +122,29 @@ class Replica:
             slo_p99_s=slo_p99_s,
             registry=None,  # per-replica series below; shared batcher
             #               gauges would collide across replicas
+            name=self.name,
         )
         self._m_depth = self._m_p99 = self._m_ewma = self._m_requests = None
-        self._m_deadline = self._m_step = None
+        self._m_deadline = self._m_step = self._m_latency_h = None
         if registry is not None:
+            from tpu_pipelines.observability.metrics import (
+                fine_latency_buckets,
+            )
+
+            # Histogram twin of the p99 gauge, on the sqrt(2) fine
+            # ladder: the gauge is an EWMA estimate (smooth, but
+            # unmergeable and un-reaggregatable); this series lets a
+            # scraper derive replica p99 with ~1.42x worst-case
+            # quantization instead of the default ladder's ~2x (the
+            # margin SLO_WINDOW_FRAC exists to absorb — batching.py).
+            self._m_latency_h = registry.histogram(
+                "serving_replica_latency_seconds",
+                "Per-request latency observed on this replica "
+                "(fine sqrt(2) buckets; gauge twin: "
+                "serving_replica_p99_seconds).",
+                labels=("replica",),
+                buckets=fine_latency_buckets(),
+            ).labels(self.name)
             self._m_depth = registry.gauge(
                 "serving_replica_queue_depth",
                 "Requests queued or in flight on this replica.",
@@ -196,7 +215,7 @@ class Replica:
 
     # ------------------------------------------------------------- serving
 
-    def submit(self, batch, n_rows: int, timeout_s: float = 300.0):
+    def submit(self, batch, n_rows: int, timeout_s: float = 300.0, ctx=None):
         import time
 
         with self._inflight_lock:
@@ -207,12 +226,16 @@ class Replica:
             self._m_depth.set(self.queue_depth())
         t0 = time.perf_counter()
         try:
-            return self.batcher.submit(batch, n_rows, timeout_s=timeout_s)
+            return self.batcher.submit(
+                batch, n_rows, timeout_s=timeout_s, ctx=ctx
+            )
         finally:
             dt = time.perf_counter() - t0
             with self._inflight_lock:
                 self._inflight -= 1
             self.latency.observe(dt)
+            if self._m_latency_h is not None:
+                self._m_latency_h.observe(dt)
             if self._m_p99 is not None:
                 self._m_p99.set(self.latency.ewma_p99_s)
                 self._m_ewma.set(self.latency.ewma_mean_s)
@@ -261,7 +284,11 @@ class Replica:
         return engine
 
     def decode_submit(
-        self, rows, gen_params: Dict[str, Any], timeout_s: float = 300.0
+        self,
+        rows,
+        gen_params: Dict[str, Any],
+        timeout_s: float = 300.0,
+        ctx=None,
     ) -> np.ndarray:
         """Run one request's sequences through this replica's engine.
 
@@ -285,6 +312,12 @@ class Replica:
         t0 = _time.perf_counter()
         try:
             with versions.lease() as (version, loaded):
+                if ctx is not None:
+                    # The lease pins this generation to `version` across
+                    # any hot-swap; the trace records the pin so a
+                    # mid-swap stream is attributable to the version
+                    # that actually decoded it.
+                    ctx.annotate(version=version, replica=self.name)
                 engine = self.prepare_engine(version, loaded)
                 # Submit-time validation: a malformed request is ITS
                 # caller's 4xx here, before any sequence joins the engine
@@ -302,6 +335,7 @@ class Replica:
                         row["inputs"],
                         input_mask=row.get("input_mask"),
                         max_new_tokens=gp["max_new_tokens"],
+                        ctx=ctx,
                     )
                     for row in rows
                 ]

@@ -31,17 +31,29 @@ class LatencyAwareRouter:
             raise RuntimeError("replica pool is empty")
         if len(replicas) == 1:
             return replicas[0]
+        return self.pick_with_costs(replicas)[0]
+
+    def pick_with_costs(
+        self, replicas: Sequence[Replica]
+    ) -> "tuple[Replica, dict]":
+        """The pick plus every replica's routing cost at decision time —
+        what a request-scoped trace records so a bad route is explicable
+        after the fact (the costs the router saw, not a reconstruction)."""
+        if not replicas:
+            raise RuntimeError("replica pool is empty")
         with self._lock:
             start = self._rr % len(replicas)
             self._rr += 1
         best = None
         best_cost = float("inf")
+        costs = {}
         # Rotate the scan start so exact-tie costs (cold start, idle
         # fleet) spread round-robin rather than always landing on the
         # lowest index.
         for off in range(len(replicas)):
             r = replicas[(start + off) % len(replicas)]
             cost = r.routing_cost()
+            costs[r.name] = round(cost, 6)
             if cost < best_cost:
                 best, best_cost = r, cost
-        return best
+        return best, costs

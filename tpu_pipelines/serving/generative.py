@@ -104,6 +104,11 @@ class _Sequence:
     _done: threading.Event = field(default_factory=threading.Event)
     result: Optional[np.ndarray] = None
     error: Optional[BaseException] = None
+    # Request-trace context (None = untraced): rides the sequence across
+    # the client->worker thread boundary so decode-step slot events land
+    # on the originating request's trace.
+    ctx: Any = None
+    arrival_wall_s: float = 0.0
 
     def finish(self, error: Optional[BaseException] = None) -> None:
         if self._done.is_set():
@@ -313,6 +318,7 @@ class GenerativeEngine:
                 # bucket the warmup missed — counted, loud, and the
                 # warmup-contract test's assertion.
                 self.compiles_after_warm += 1
+                self.telemetry.on_compile_after_warm()
                 log.warning(
                     "generative engine: compiling step (%d, %d) AFTER "
                     "warmup — bucket missed by warm()", b, kv,
@@ -420,6 +426,7 @@ class GenerativeEngine:
         *,
         max_new_tokens: Optional[int] = None,
         input_mask=None,
+        ctx=None,
     ) -> _Sequence:
         params = validate_generation_params(
             {} if max_new_tokens is None
@@ -455,6 +462,8 @@ class GenerativeEngine:
             max_new_tokens=m,
             arrival_s=now,
             deadline_s=token_deadline_s(now, m, self.slo_ms_per_token),
+            ctx=ctx,
+            arrival_wall_s=time.time(),
         )
         with self._cond:
             if self._closed:
@@ -504,6 +513,7 @@ class GenerativeEngine:
             self._n_live = 0
             self._slots = [None] * self.max_batch_size
         for seq in pending:
+            self._trace_end(seq, "evicted")
             seq.finish(GenerationEvicted("engine closed"))
 
     # ------------------------------------------------------------- worker
@@ -532,6 +542,7 @@ class GenerativeEngine:
                 self._queue.clear()
                 self._n_live = 0
             for seq in pending:
+                self._trace_end(seq, "error")
                 seq.finish(e)
 
     def _admit(self) -> None:
@@ -558,6 +569,13 @@ class GenerativeEngine:
                 self._arena = self._jit_insert(
                     self._arena, cache1, enc1, seq.input_mask[None], tok0,
                     np.int32(slot),
+                )
+            if seq.ctx is not None:
+                # Slot event: the sequence joined the continuous batch —
+                # the wait it paid in the queue is arrival -> now.
+                seq.ctx.span_from_mono(
+                    "decode.join", seq.arrival_s,
+                    slot=slot, budget_tokens=seq.max_new_tokens,
                 )
             with self._lock:
                 self._slots[slot] = seq
@@ -593,6 +611,15 @@ class GenerativeEngine:
             t = int(toks[slot])
             seq.tokens.append(t)
             self.telemetry.on_token()
+            if seq.ctx is not None:
+                # Per decode-step slot event: which step, which program
+                # bucket pair — the trace shows exactly which steps this
+                # sequence rode and with how much co-batched company.
+                seq.ctx.instant(
+                    "decode.step", slot=slot, token=len(seq.tokens),
+                    batch_bucket=b, kv_bucket=kv, live=n,
+                    step_s=round(dt, 6),
+                )
             done = (
                 t == self.eos_id or len(seq.tokens) >= seq.max_new_tokens
             )
@@ -600,6 +627,10 @@ class GenerativeEngine:
             # resumes to consistent accounting (outstanding_tokens of a
             # finished sequence is already 0, its slot already free).
             if done:
+                if seq.ctx is not None and t == self.eos_id:
+                    seq.ctx.instant(
+                        "decode.eos", slot=slot, tokens=len(seq.tokens)
+                    )
                 self._retire(slot)
                 self._complete(seq)
             elif (
@@ -609,10 +640,11 @@ class GenerativeEngine:
             ):
                 self.telemetry.on_evicted()
                 self._retire(slot)
-                seq.finish(GenerationEvicted(
+                self._evict_seq(
+                    seq, slot,
                     f"per-token SLO deadline exceeded after "
-                    f"{len(seq.tokens)}/{seq.max_new_tokens} tokens"
-                ))
+                    f"{len(seq.tokens)}/{seq.max_new_tokens} tokens",
+                )
 
     def _retire(self, slot: int) -> None:
         with self._dev():
@@ -631,7 +663,30 @@ class GenerativeEngine:
     def _complete(self, seq: _Sequence) -> None:
         latency = time.monotonic() - seq.arrival_s
         self.telemetry.on_done(latency, len(seq.tokens))
+        self._trace_end(seq, "complete")
         seq.finish()
+
+    def _evict_seq(self, seq: _Sequence, slot: int, reason: str) -> None:
+        if seq.ctx is not None:
+            seq.ctx.instant(
+                "decode.evict", slot=slot, tokens=len(seq.tokens),
+                reason=reason,
+            )
+        self._trace_end(seq, "evicted")
+        seq.finish(GenerationEvicted(reason))
+
+    def _trace_end(self, seq: _Sequence, status: str) -> None:
+        """The whole-lifetime ``decode`` span (arrival -> end): emitted
+        for EVERY terminal edge — EOS, budget, eviction, engine death —
+        so a stream's trace always covers its full decode lifetime."""
+        if seq.ctx is None:
+            return
+        seq.ctx.complete_span(
+            "decode", seq.arrival_wall_s, seq.arrival_s,
+            time.monotonic() - seq.arrival_s,
+            status=status, tokens=len(seq.tokens),
+            budget_tokens=seq.max_new_tokens,
+        )
 
 
 class DecodeTelemetry:
@@ -644,8 +699,11 @@ class DecodeTelemetry:
         self._steps = self._tokens = self._seqs = self._evicted = None
         self._shed = self._occ = self._pages = self._active = None
         self._queue_tokens = self._step_s = self._per_token = None
+        self._compiles = None
         if registry is None:
             return
+        from tpu_pipelines.observability.metrics import fine_latency_buckets
+
         lab = ("replica",)
         self._steps = registry.counter(
             "serving_decode_steps_total",
@@ -693,10 +751,23 @@ class DecodeTelemetry:
             "EWMA wall time of one continuous-batch decode step.",
             labels=lab,
         ).labels(self.replica)
+        # Fine sqrt(2) ladder (metrics.fine_latency_buckets): a decode
+        # step runs in the tens-to-hundreds of µs, BELOW the default x2
+        # ladder's 100µs floor — on the default ladder every per-token
+        # observation piled into the first two buckets and a scraped
+        # quantile was meaningless.
         self._per_token = registry.histogram(
             "serving_decode_per_token_latency_seconds",
             "Completed-generation latency divided by tokens emitted — "
-            "the per-token SLO judge.", labels=lab,
+            "the per-token SLO judge (fine sqrt(2) buckets).",
+            labels=lab, buckets=fine_latency_buckets(),
+        ).labels(self.replica)
+        self._compiles = registry.counter(
+            "serving_decode_compiles_after_warm_total",
+            "Decode-step programs compiled AFTER warm() — each one is a "
+            "broken warmup contract (an XLA compile paid mid-traffic); "
+            "the SLO monitor treats any increase as a breach.",
+            labels=lab,
         ).labels(self.replica)
 
     def on_step(self, dt, ewma, live, bucket, pages, active) -> None:
@@ -729,3 +800,7 @@ class DecodeTelemetry:
     def on_queue(self, outstanding_tokens: int) -> None:
         if self._queue_tokens is not None:
             self._queue_tokens.set(outstanding_tokens)
+
+    def on_compile_after_warm(self) -> None:
+        if self._compiles is not None:
+            self._compiles.inc()

@@ -21,12 +21,14 @@ four lines of boilerplate per method anyway.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 from concurrent import futures
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from tpu_pipelines.observability import request_trace
 from tpu_pipelines.serving import prediction_service_pb2 as pb
 from tpu_pipelines.serving.server import ModelServer
 
@@ -85,6 +87,42 @@ class GrpcPredictionService:
 
     def __init__(self, server: ModelServer):
         self._server = server
+
+    @contextlib.contextmanager
+    def _traced(self, endpoint: str, context):
+        """Request-trace root for one RPC: the W3C ``traceparent`` rides
+        gRPC metadata (the HTTP header's twin), the trace id is handed
+        back in the trailing metadata, and the root span closes with the
+        RPC verdict — abort paths raise through the with-block, so the
+        finally sees them."""
+        tracer = self._server.request_tracer
+        if tracer is None:
+            yield None
+            return
+        header = None
+        for k, v in (context.invocation_metadata() or ()):
+            if k.lower() == "traceparent":
+                header = v
+        ctx = tracer.start(endpoint, header)
+        if ctx is None:
+            yield None
+            return
+        token = request_trace.push(ctx)
+        code = "OK"
+        try:
+            context.set_trailing_metadata(
+                (("traceparent", ctx.traceparent()),)
+            )
+        except Exception:  # noqa: BLE001 — a test double without trailing
+            pass           # metadata support must not break serving
+        try:
+            yield ctx
+        except BaseException:
+            code = "ERR"
+            raise
+        finally:
+            request_trace.pop(token)
+            ctx.finish(code)
 
     def _decode_inputs(self, request, context) -> Dict[str, Any]:
         import grpc
@@ -165,17 +203,19 @@ class GrpcPredictionService:
             )
 
     def Predict(self, request: "pb.PredictRequest", context):
-        batch = self._decode_inputs(request, context)
-        preds = self._call(self._server.predict_batch, batch, context)
-        return self._encode_response(preds, context)
+        with self._traced("predict", context):
+            batch = self._decode_inputs(request, context)
+            preds = self._call(self._server.predict_batch, batch, context)
+            return self._encode_response(preds, context)
 
     def Generate(self, request: "pb.PredictRequest", context):
         """Seq2seq decoding — same wire messages as Predict (inputs map ->
         token tensor); FAILED_PRECONDITION when the served payload has no
         make_generate_step hook."""
-        batch = self._decode_inputs(request, context)
-        tokens = self._call(self._server.generate_batch, batch, context)
-        return self._encode_response(tokens, context)
+        with self._traced("generate", context):
+            batch = self._decode_inputs(request, context)
+            tokens = self._call(self._server.generate_batch, batch, context)
+            return self._encode_response(tokens, context)
 
     def GetModelStatus(self, request: "pb.ModelStatusRequest", context):
         import grpc
@@ -204,7 +244,8 @@ class GrpcPredictionService:
                 f"unknown model {request.model_name!r}",
             )
         try:
-            version = self._server.reload()
+            with self._traced("reload", context):
+                version = self._server.reload()
         except CanaryRefused as e:
             context.abort(
                 grpc.StatusCode.FAILED_PRECONDITION,

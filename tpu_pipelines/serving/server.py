@@ -41,10 +41,12 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from tpu_pipelines.observability import request_trace
 from tpu_pipelines.observability.metrics import (
     CONTENT_TYPE_LATEST,
     MetricsRegistry,
 )
+from tpu_pipelines.observability.request_trace import RequestTracer
 from tpu_pipelines.testing import faults as _faults
 from tpu_pipelines.trainer.export import LoadedModel, load_exported_model
 
@@ -68,6 +70,15 @@ ENV_MODEL_TYPE = "TPP_SERVING_MODEL_TYPE"
 ENV_PAGE_SIZE = "TPP_SERVING_PAGE_SIZE"
 ENV_MAX_TOKENS = "TPP_SERVING_MAX_TOKENS"
 ENV_SLO_MS_PER_TOKEN = "TPP_SERVING_SLO_MS_PER_TOKEN"
+# Observability knobs (docs/OBSERVABILITY.md "Request tracing & SLO burn
+# rates"): request-scoped tracing mode (off | sample:N | all — default
+# off: zero files, byte-identical /metrics), where sampled spans flush
+# (<dir>/serving/events.jsonl; empty = in-memory ring only), and the SLO
+# burn-rate monitor's evaluation cadence in seconds (unset/0 = no
+# monitor thread, no burn-rate series).
+ENV_REQUEST_TRACE = request_trace.ENV_REQUEST_TRACE
+ENV_REQUEST_TRACE_DIR = request_trace.ENV_REQUEST_TRACE_DIR
+ENV_SLO_MONITOR = "TPP_SLO_MONITOR"
 
 
 def _env_number(name: str, default: float) -> float:
@@ -131,6 +142,10 @@ class ModelServer:
         decode_page_size: int = 0,
         max_queue_tokens: int = 0,
         slo_ms_per_token: float = -1.0,
+        request_trace_mode: str = "",
+        trace_dir: str = "",
+        slo_monitor_interval_s: float = -1.0,
+        swap_probation_s: float = -1.0,
     ):
         self.model_name = model_name
         self.base_dir = base_dir
@@ -221,6 +236,19 @@ class ModelServer:
             "Predict/generate requests currently being served.",
         )
         self._m_inflight.set_function(lambda: self._inflight)
+        # Request-scoped tracing (observability/request_trace.py):
+        # constructor wins, else env; default off — no tracer object, no
+        # file, no extra metric family, byte-identical /metrics.
+        self.request_tracer = RequestTracer.create(
+            request_trace_mode or os.environ.get(ENV_REQUEST_TRACE, ""),
+            trace_dir or os.environ.get(ENV_REQUEST_TRACE_DIR, ""),
+            service=model_name,
+            registry=self.metrics,
+        )
+        if slo_monitor_interval_s < 0:
+            slo_monitor_interval_s = _env_number(ENV_SLO_MONITOR, 0.0)
+        self._slo_interval_s = max(0.0, slo_monitor_interval_s)
+        self.slo_monitor = None
         # Micro-batching (serving/batching.py): coalesce concurrent requests
         # into padded fixed-bucket device calls.  The batcher resolves the
         # current model at call time, so hot-swaps apply to queued requests.
@@ -252,8 +280,23 @@ class ModelServer:
                 decode_page_size=self.decode_page_size,
                 max_queue_tokens=self.max_queue_tokens,
                 slo_ms_per_token=self.slo_ms_per_token,
+                swap_probation_s=swap_probation_s,
                 registry=self.metrics,
             )
+            if self._slo_interval_s > 0:
+                # SLO burn-rate monitor (observability/slo.py), wired to
+                # the fleet's default breach policy: a breach inside the
+                # post-swap probation window auto-rolls back to the
+                # prior resident version.  Opt-in (the burn-rate series
+                # only exist when someone asked for the monitor).
+                from tpu_pipelines.observability.slo import SLOMonitor
+
+                self.slo_monitor = SLOMonitor(
+                    self.metrics,
+                    slo_p99_s=self.slo_p99_ms / 1e3,
+                    on_breach=self._fleet.on_slo_breach,
+                    tracer=self.request_tracer,
+                )
         elif batching:
             from tpu_pipelines.serving.batching import RequestBatcher
 
@@ -263,6 +306,7 @@ class ModelServer:
                 batch_timeout_s=batch_timeout_s,
                 slo_p99_s=self.slo_p99_ms / 1e3,
                 registry=self.metrics,
+                name="server",
             )
         self.reload()
 
@@ -346,19 +390,28 @@ class ModelServer:
                         f"outstanding decode tokens {owed} >= bound "
                         f"{self.max_queue_tokens}"
                     )
-            if self.max_queue_depth > 0:
+            ctx = request_trace.current()
+            depth = None
+            if self.max_queue_depth > 0 or ctx is not None:
                 depth = self._inflight
                 if self._fleet is not None:
                     depth += self._fleet.queue_depth()
                 elif self._batcher is not None:
                     depth += self._batcher._queue.qsize()
-                if depth >= self.max_queue_depth:
-                    self._m_shed.labels(endpoint).inc()
-                    raise ServerOverloaded(
-                        f"queue depth {depth} >= bound "
-                        f"{self.max_queue_depth}"
-                    )
+            if self.max_queue_depth > 0 and depth >= self.max_queue_depth:
+                self._m_shed.labels(endpoint).inc()
+                raise ServerOverloaded(
+                    f"queue depth {depth} >= bound "
+                    f"{self.max_queue_depth}"
+                )
             self._inflight += 1
+        if ctx is not None:
+            # What admission saw when it let the request in: with a bad
+            # p99, depth-at-admit distinguishes "queued behind a storm"
+            # from "slow on an idle box" at a glance.
+            ctx.instant(
+                "admission", depth=depth, bound=self.max_queue_depth
+            )
 
     def _release(self) -> None:
         with self._inflight_lock:
@@ -539,6 +592,13 @@ class ModelServer:
                     # back, so shed load decorrelates instead of
                     # instantly re-stampeding.
                     self.send_header("Retry-After", str(retry_after_s))
+                ctx = getattr(self, "_trace_ctx", None)
+                if ctx is not None:
+                    # The caller gets the trace id back (and can hand it
+                    # to support / grep the span log); this request's
+                    # root span is the downstream parent.
+                    self.send_header("traceparent", ctx.traceparent())
+                    self._trace_code = code
                 self.end_headers()
                 self.wfile.write(body)
                 if endpoint:
@@ -549,8 +609,16 @@ class ModelServer:
                     # Prometheus text exposition of this server's
                     # registry (request latencies, batcher depth, model
                     # info) — the scrape endpoint the cluster runner's
-                    # prometheus.io annotations point at.
-                    body = server.metrics.to_prometheus().encode("utf-8")
+                    # prometheus.io annotations point at.  With request
+                    # tracing on, exemplar comment lines link the
+                    # latency histogram to the slowest request's trace
+                    # id per scrape interval (comments are invisible to
+                    # scrape parsers; with tracing off nothing is
+                    # appended and the exposition is byte-identical).
+                    text = server.metrics.to_prometheus()
+                    if server.request_tracer is not None:
+                        text += server.request_tracer.exemplar_exposition()
+                    body = text.encode("utf-8")
                     self.send_response(200)
                     self.send_header("Content-Type", CONTENT_TYPE_LATEST)
                     self.send_header("Content-Length", str(len(body)))
@@ -606,6 +674,20 @@ class ModelServer:
                 endpoint, handler = route
                 t0 = time.perf_counter()
                 admitted = False
+                # Request trace root: the traceparent header joins an
+                # existing distributed trace, absence starts one; the
+                # head-sampling verdict is made HERE and inherited by
+                # every downstream span.
+                ctx = None
+                trace_token = None
+                if server.request_tracer is not None:
+                    ctx = server.request_tracer.start(
+                        endpoint, self.headers.get("traceparent")
+                    )
+                    if ctx is not None:
+                        self._trace_ctx = ctx
+                        self._trace_code = 0
+                        trace_token = request_trace.push(ctx)
                 try:
                     # Fault hook (RELOAD_DURING_HAMMER): a no-op global
                     # read unless a test plan is active.
@@ -688,6 +770,10 @@ class ModelServer:
                     server._m_latency.labels(endpoint).observe(
                         time.perf_counter() - t0
                     )
+                    if ctx is not None:
+                        request_trace.pop(trace_token)
+                        self._trace_ctx = None
+                        ctx.finish(self._trace_code or 0)
 
         class Httpd(ThreadingHTTPServer):
             # socketserver's default listen backlog is 5; a concurrent-client
@@ -700,10 +786,14 @@ class ModelServer:
             target=self._httpd.serve_forever, daemon=True
         )
         self._thread.start()
+        if self.slo_monitor is not None:
+            self.slo_monitor.start(self._slo_interval_s)
         return self._httpd.server_address[1]
 
     def stop(self) -> None:
         self._stopped = True
+        if self.slo_monitor is not None:
+            self.slo_monitor.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -719,3 +809,6 @@ class ModelServer:
             # bounded by one timeout, not replicas x timeout.
             self._fleet.close()
             self._fleet = None
+        if self.request_tracer is not None:
+            self.request_tracer.close()
+            self.request_tracer = None
