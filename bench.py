@@ -2957,6 +2957,267 @@ def bench_data_plane(smoke: bool) -> dict:
             shutil.rmtree(home, ignore_errors=True)
 
 
+def bench_continuous(smoke: bool) -> dict:
+    """The ``continuous.taxi_spans`` leg (ISSUE 13): three synthetic
+    spans fed to a RUNNING ContinuousController.
+
+    Evidence recorded:
+      - the controller ingests spans 1+2, retrains over the rolling
+        window, and the blessed model deploys through the serving
+        fleet's canary-gated hot-swap (real export, real loader);
+      - span 3 arrives while the loop runs: ONLY the new span's
+        ingest+stats execute (``work_saved_ratio`` = (K-1)/K), and the
+        window's merged statistics are BYTE-IDENTICAL to a cold
+        StatisticsGen full run over the assembled window artifact — the
+        id-free lineage-identity analog for incremental stats;
+      - ``deploy_to_serving_s``: span-3 file landing -> the fleet
+        serving the retrained version (watch poll + ingest + retrain +
+        push + canary + swap), plus the controller's own in-iteration
+        deploy latency.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    from tpu_pipelines.components import (
+        CsvExampleGen,
+        Importer,
+        Pusher,
+        RollingWindowResolver,
+        StatisticsGen,
+    )
+    from tpu_pipelines.continuous import (
+        ContinuousConfig,
+        ContinuousController,
+        SpanWindow,
+        WindowStatisticsMerger,
+    )
+    from tpu_pipelines.dsl.component import component
+    from tpu_pipelines.dsl.pipeline import Pipeline
+    from tpu_pipelines.observability.metrics import MetricsRegistry
+    from tpu_pipelines.orchestration import LocalDagRunner
+    from tpu_pipelines.serving import ModelServer
+    from tpu_pipelines.trainer.export import export_model
+
+    td = tempfile.mkdtemp(prefix="tpp-continuous-")
+    base_rows = 60 if smoke else 2000
+    server = None
+    stop = threading.Event()
+    thread = None
+    try:
+        data = os.path.join(td, "data")
+        pattern = os.path.join(data, "span-{SPAN}", "v-{VERSION}")
+        md = os.path.join(td, "md.sqlite")
+        dest = os.path.join(td, "serving")
+
+        def write_span(span, rows):
+            d = os.path.join(data, f"span-{span}", "v-1")
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "data.csv"), "w") as f:
+                f.write("x,y\n")
+                for i in range(rows):
+                    f.write(f"{i + 1000 * span},{(i * 3 + span) % 7}\n")
+
+        # Toy-but-real serving payload (the bench_serving idiom): the
+        # trainer exports a loadable model, so the fleet's canary LOADS
+        # what the pipeline pushed.
+        module = os.path.join(td, "toy_module.py")
+        with open(module, "w") as f:
+            f.write(
+                "import jax.numpy as jnp\n"
+                "def build_model(hp):\n"
+                "    return None\n"
+                "def apply_fn(model, params, batch):\n"
+                "    return jnp.asarray(batch['x'], jnp.float32) "
+                "* params['w']\n"
+            )
+
+        @component(inputs={"examples": "Examples"},
+                   outputs={"model": "Model"}, name="ToyTrainer")
+        def ToyTrainer(ctx):
+            n = sum(ctx.input("examples").properties.get(
+                "split_counts", {}).values())
+            export_model(
+                serving_model_dir=ctx.output("model").uri,
+                params={"w": np.array([float(n)], np.float32)},
+                module_file=module,
+            )
+            return {"rows_trained": n}
+
+        @component(inputs={"model": "Model",
+                           "statistics": "ExampleStatistics"},
+                   outputs={"blessing": "ModelBlessing"}, is_sink=True,
+                   name="ToyBless")
+        def ToyBless(ctx):
+            with open(os.path.join(
+                    ctx.output("blessing").uri, "BLESSED"), "w") as f:
+                f.write("{}")
+            ctx.output("blessing").properties["blessed"] = True
+            return {"blessed": True}
+
+        # Bootstrap version so the fleet can start before the first push.
+        export_model(
+            serving_model_dir=os.path.join(dest, "1"),
+            params={"w": np.array([1.0], np.float32)},
+            module_file=module,
+        )
+        server = ModelServer("taxi", dest, replicas=2, max_versions=2)
+        port = server.start()
+        serving_url = f"http://127.0.0.1:{port}/v1/models/taxi"
+
+        def make_span_pipeline(span, version):
+            gen = CsvExampleGen(
+                input_path=pattern, span=span, num_shards=2
+            )
+            stats = StatisticsGen(
+                examples=gen.outputs["examples"], save_accumulators=True
+            )
+            return Pipeline(
+                "spans-ingest", [gen, stats],
+                pipeline_root=os.path.join(td, "ingest-root"),
+                metadata_path=md, node_timeout_s=600,
+            )
+
+        def make_window_pipeline():
+            win = RollingWindowResolver(
+                window_spans=3, source_pipeline="spans-ingest",
+                examples_producer="CsvExampleGen",
+                statistics_producer="StatisticsGen",
+            )
+            spanwin = SpanWindow(examples=win.outputs["examples"])
+            merged = WindowStatisticsMerger(
+                statistics=win.outputs["statistics"]
+            )
+            trainer = ToyTrainer(examples=spanwin.outputs["window"])
+            bless = ToyBless(
+                model=trainer.outputs["model"],
+                statistics=merged.outputs["statistics"],
+            )
+            pusher = Pusher(
+                model=trainer.outputs["model"],
+                blessing=bless.outputs["blessing"],
+                push_destination=dest,
+                serving_push_url=serving_url,
+            ).with_lint_suppressions("TPP109")
+            return Pipeline(
+                "window-train",
+                [win, spanwin, merged, trainer, bless, pusher],
+                pipeline_root=os.path.join(td, "window-root"),
+                metadata_path=md, node_timeout_s=600,
+            )
+
+        registry = MetricsRegistry()
+        controller = ContinuousController(ContinuousConfig(
+            input_pattern=pattern,
+            make_span_pipeline=make_span_pipeline,
+            make_window_pipeline=make_window_pipeline,
+            poll_interval_s=0.1,
+            serving_url=serving_url,
+            probation_watch_s=0.0,   # rollback drill lives in tier-1 tests
+            state_dir=os.path.join(td, "state"),
+            registry=registry,
+        ))
+
+        # Feed spans 1+2 to the RUNNING controller: bootstrap deploy.
+        write_span(1, base_rows)
+        write_span(2, base_rows + base_rows // 2)
+        thread = threading.Thread(
+            target=controller.run, kwargs={"stop_event": stop},
+        )
+        thread.start()
+
+        def wait_for(predicate, timeout_s=120.0):
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                if predicate():
+                    return True
+                time.sleep(0.05)
+            return False
+
+        deploys = registry.get("continuous_deploys_total")
+        boot_ok = wait_for(lambda: deploys.get() >= 1)
+
+        # Span 3 lands mid-loop: measure landing -> serving the retrain.
+        t_land = time.monotonic()
+        write_span(3, base_rows * 2)
+        incr_ok = wait_for(
+            lambda: deploys.get() >= 2 and server.version == "3"
+        )
+        deploy_to_serving_s = time.monotonic() - t_land
+        stop.set()
+        thread.join(timeout=60)
+        it = dict(controller.last_iteration)
+
+        # Identity: merged window stats == a cold full run over the
+        # assembled window artifact.
+        from tpu_pipelines.metadata import open_store
+
+        store = open_store(md)
+        try:
+            merged_art = max(
+                (a for a in store.get_artifacts(
+                    type_name="ExampleStatistics")
+                 if a.properties.get("window_spans") == [1, 2, 3]),
+                key=lambda a: a.id, default=None,
+            )
+            window_art = max(
+                (a for a in store.get_artifacts(type_name="Examples")
+                 if a.properties.get("window_spans") == [1, 2, 3]),
+                key=lambda a: a.id, default=None,
+            )
+        finally:
+            store.close()
+        stats_identical = False
+        if merged_art is not None and window_art is not None:
+            imp = Importer(
+                source_uri=window_art.uri, artifact_type="Examples"
+            )
+            cold_sg = StatisticsGen(examples=imp.outputs["result"])
+            rc = LocalDagRunner().run(Pipeline(
+                "cold", [imp, cold_sg],
+                pipeline_root=os.path.join(td, "cold-root"),
+                metadata_path=os.path.join(td, "cold.sqlite"),
+            ))
+            cold_art = rc.outputs_of("StatisticsGen", "statistics")[0]
+            with open(os.path.join(cold_art.uri, "stats.json")) as f:
+                cold = json.load(f)
+            with open(os.path.join(merged_art.uri, "stats.json")) as f:
+                inc = json.load(f)
+            stats_identical = inc == cold
+
+        work_saved = it.get("work_saved_ratio")
+        green = bool(
+            boot_ok and incr_ok and stats_identical
+            and server.version == "3"
+            and work_saved is not None and abs(work_saved - 2 / 3) < 1e-3
+        )
+        return {"taxi_spans": {
+            "green": green,
+            "spans": 3,
+            "rows_per_span": [base_rows, base_rows + base_rows // 2,
+                              base_rows * 2],
+            "bootstrap_deploy_ok": boot_ok,
+            "incremental_deploy_ok": incr_ok,
+            "stats_identical": stats_identical,
+            "work_saved_ratio": work_saved,
+            "deploy_to_serving_s": round(deploy_to_serving_s, 3),
+            "controller_deploy_latency_s": (
+                (it.get("deployed") or {}).get("deploy_latency_s")
+            ),
+            "deploys": deploys.get(),
+            "spans_seen": registry.get("continuous_spans_seen").get(),
+            "serving_version": server.version,
+            "last_iteration": it,
+        }}
+    finally:
+        stop.set()
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=30)
+        if server is not None:
+            server.stop()
+        shutil.rmtree(td, ignore_errors=True)
+
+
 def bench_flash_probe(smoke: bool) -> dict:
     """Flash vs dense attention across a seq-length sweep (ISSUE 9).
 
@@ -3386,6 +3647,10 @@ def _compact(report: dict) -> dict:
             "continuous_vs_request_speedup"
         )
         compact["decode_5xx"] = gs.get("decode_5xx")
+    cont = (report.get("continuous") or {}).get("taxi_spans")
+    if isinstance(cont, dict) and "green" in cont:
+        compact["continuous_green"] = bool(cont.get("green"))
+        compact["incremental_work_saved"] = cont.get("work_saved_ratio")
     td = report.get("trace_diff")
     if isinstance(td, dict):
         # Capped: the compact line must stay under the driver-tail budget
@@ -3618,6 +3883,10 @@ def main() -> None:
     # Sharded data plane: sharded-vs-single ingest+stats+transform
     # wall-clock + identity checks (see bench_data_plane).
     leg("data_plane", bench_data_plane, est_cost_s=120, retries=1)
+    # Continuous pipelines (ISSUE 13): three synthetic spans fed to a
+    # RUNNING controller — incremental stats identity, work-saved ratio,
+    # and span-landing -> fleet-serving deploy latency.
+    leg("continuous", bench_continuous, est_cost_s=90, retries=1)
     leg("mnist", bench_mnist, est_cost_s=60, retries=1)
     leg("resnet", bench_resnet, est_cost_s=150, retries=1)
     # +50 s vs r5: the seq sweep times ~4 candidate block configs per

@@ -542,6 +542,117 @@ def test_tpp110_cli_fail_on_warn(tmp_path):
     assert warn_only.returncode == 0, warn_only.stdout + warn_only.stderr
 
 
+def test_tpp111_unbounded_continuous_nodes(tmp_path):
+    """A pipeline handed to the continuous controller whose node has
+    neither a deadline nor a retry policy: WARN per node — an unbounded
+    incremental run wedges the always-on loop.  Armed only by the
+    continuous execution-context flag (like TPP108's spmd flag)."""
+    gen = _gen()
+    sink = _consumer(gen, name="S", outs={})
+    sink.SPEC.outputs.clear()
+    pipeline = _pipeline([gen, sink], tmp_path)
+    # Ordinary batch context: silent.
+    assert "TPP111" not in _rules(analyze_pipeline(pipeline))
+    findings = analyze_pipeline(pipeline, continuous=True)
+    f111 = [f for f in findings if f.rule == "TPP111"]
+    assert {f.node_id for f in f111} == {"Gen", "S"}
+    assert all(f.severity == "warn" for f in f111)
+    assert "wedges" in f111[0].message
+    assert "with_execution_timeout" in f111[0].fix
+
+    # Either bound silences the node it covers.
+    gen2 = _gen().with_execution_timeout(60)
+    sink2 = _consumer(gen2, name="S", outs={})
+    sink2.SPEC.outputs.clear()
+    sink2.with_retry_policy(max_attempts=2, base_delay_s=0.1)
+    findings = analyze_pipeline(
+        _pipeline([gen2, sink2], tmp_path), continuous=True
+    )
+    assert [f for f in findings if f.rule == "TPP111"] == []
+
+    # A pipeline-wide default (deadline or retry) bounds every node.
+    for kw in (
+        {"node_timeout_s": 120},
+        {"retry_policy": {"max_attempts": 2, "base_delay_s": 0.1}},
+    ):
+        gen3 = _gen()
+        sink3 = _consumer(gen3, name="S", outs={})
+        sink3.SPEC.outputs.clear()
+        findings = analyze_pipeline(
+            _pipeline([gen3, sink3], tmp_path, **kw), continuous=True
+        )
+        assert [f for f in findings if f.rule == "TPP111"] == [], kw
+
+    # Suppression works like every other rule.
+    gen4 = _gen().with_lint_suppressions("TPP111")
+    sink4 = _consumer(gen4, name="S", outs={})
+    sink4.SPEC.outputs.clear()
+    sink4.with_lint_suppressions("TPP111")
+    findings = analyze_pipeline(
+        _pipeline([gen4, sink4], tmp_path), continuous=True
+    )
+    assert [f for f in findings if f.rule == "TPP111"] == []
+
+
+def test_tpp111_resolver_exempt(tmp_path):
+    from tpu_pipelines.components import RollingWindowResolver
+
+    win = RollingWindowResolver(window_spans=2)
+
+    @component(inputs={"examples": "Examples"}, outputs={}, name="S2",
+               is_sink=True)
+    def S2(ctx):
+        pass
+
+    sink = S2(examples=win.outputs["examples"])
+    findings = analyze_pipeline(
+        _pipeline([win, sink], tmp_path), continuous=True
+    )
+    f111 = [f for f in findings if f.rule == "TPP111"]
+    # The resolver (driver-level, store-answered) is exempt; the
+    # unbounded executor node is not.
+    assert {f.node_id for f in f111} == {"S2"}
+
+
+def test_tpp111_cli_continuous_flag(tmp_path):
+    module = tmp_path / "cont_pipeline.py"
+    module.write_text(textwrap.dedent("""
+        import os
+        from tpu_pipelines.dsl.component import component
+        from tpu_pipelines.dsl.pipeline import Pipeline
+
+        @component(outputs={"examples": "Examples"}, name="Gen",
+                   is_sink=True)
+        def Gen(ctx):
+            pass
+
+        def create_pipeline():
+            home = os.environ.get("TPP_PIPELINE_HOME", "/tmp/x")
+            return Pipeline(
+                "cont-fixture", [Gen()],
+                pipeline_root=os.path.join(home, "root"),
+                metadata_path=os.path.join(home, "md.sqlite"),
+            )
+    """))
+    env = {**os.environ, "PYTHONPATH": REPO,
+           "TPP_PIPELINE_HOME": str(tmp_path)}
+    clean = subprocess.run(
+        [sys.executable, "-m", "tpu_pipelines", "lint",
+         "--pipeline-module", str(module), "--fail-on", "warn", "--json"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    gated_run = subprocess.run(
+        [sys.executable, "-m", "tpu_pipelines", "lint",
+         "--pipeline-module", str(module), "--continuous",
+         "--fail-on", "warn", "--json"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert gated_run.returncode == 3, gated_run.stdout + gated_run.stderr
+    report = json.loads(gated_run.stdout)
+    assert "TPP111" in report["rules"]
+
+
 # ----------------------------------------------- TPP2xx seeded-bug fixtures
 
 

@@ -239,6 +239,24 @@ def test_bench_smoke_emits_compact_stdout_and_full_report():
         == gs["continuous_vs_request_speedup"]
     )
     assert compact["decode_5xx"] == 0
+    # Continuous-pipeline leg (ISSUE 13): three synthetic spans fed to a
+    # RUNNING controller — bootstrap deploy, then span 3 lands mid-loop:
+    # only the new span's ingest+stats execute (work saved (K-1)/K), the
+    # incremental merged statistics equal a cold full-window run byte for
+    # byte, and the retrained model reaches the fleet (deploy latency on
+    # the record).
+    cont = report["continuous"]["taxi_spans"]
+    assert cont["green"] is True, cont
+    assert cont["bootstrap_deploy_ok"] is True
+    assert cont["incremental_deploy_ok"] is True
+    assert cont["stats_identical"] is True
+    assert abs(cont["work_saved_ratio"] - 2 / 3) < 1e-3
+    assert cont["deploy_to_serving_s"] > 0
+    assert cont["serving_version"] == "3"
+    assert cont["deploys"] == 2
+    assert cont["spans_seen"] == 3
+    assert compact["continuous_green"] is True
+    assert compact["incremental_work_saved"] == cont["work_saved_ratio"]
     # t5_decode now carries the flash-decode datapoint: per-cache-length
     # dense-vs-tuned-flash timings, the recorded decode crossover, and
     # what "auto" resolves to at each measured length.
@@ -288,8 +306,11 @@ def test_bench_smoke_emits_compact_stdout_and_full_report():
     assert isinstance(compact["regression_flags"], list)
     assert compact["regression_flags"] == td["regression_flags"][:8]
     # The taxi trace carries the per-node profile `trace diff` consumes.
-    assert tr["per_node"] and all(
-        "wall_s" in v for v in tr["per_node"].values()
+    # (Not `tr`: that name was reused for the traced-pass block above —
+    # reading it here checked the wrong dict and KeyError'd the test.)
+    taxi_tr = report["pipeline_e2e"]["taxi"]["trace"]
+    assert taxi_tr["per_node"] and all(
+        "wall_s" in v for v in taxi_tr["per_node"].values()
     )
     # The A100 comparison point is pinned with provenance (auditable ratio).
     ref = report["a100_reference"]

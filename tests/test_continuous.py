@@ -1,0 +1,879 @@
+"""Continuous pipelines (ISSUE 13): span watcher, rolling window,
+incremental stats identity, controller loop, deploy + rollback observation.
+
+Tier-1-safe: CPU-only stub trainers, the serving fleet's stub-loader seam
+(test_serving_fleet idiom), small synthetic CSV spans.  The acceptance
+test drives the REAL chain end to end: span N+1 arrives -> the controller
+runs incrementally (only the new span's ingest+stats execute, merged
+window statistics equal a cold full run bit for bit) -> the blessed model
+deploys through the fleet's canary-gated hot-swap -> an injected SLO
+breach inside the probation window auto-rolls back -> the controller
+observes it and un-blesses the triggering model in the metadata store.
+"""
+
+import json
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_pipelines.dsl.component import component
+from tpu_pipelines.dsl.pipeline import Pipeline
+from tpu_pipelines.orchestration import LocalDagRunner
+
+pytestmark = pytest.mark.continuous
+
+
+# --------------------------------------------------------------- fixtures
+
+
+def _write_span(data_dir, span, rows, version=1):
+    d = os.path.join(str(data_dir), f"span-{span}", f"v-{version}")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "data.csv"), "w") as f:
+        f.write("x,y\n")
+        for i in range(rows):
+            f.write(f"{i + 100 * span},{(i * 3 + span) % 7}\n")
+    return d
+
+
+class FakeLoaded:
+    """Stub serving payload (test_serving_fleet idiom)."""
+
+    def __init__(self, scale):
+        self.scale = scale
+        self.generate = None
+        self.transform = None
+
+    def predict(self, batch):
+        return np.asarray(batch["x"], np.float64) * self.scale
+
+    predict_transformed = predict
+
+
+def _fake_loader(version_dir):
+    with open(os.path.join(version_dir, "scale.txt")) as f:
+        return FakeLoaded(float(f.read()))
+
+
+@pytest.fixture
+def fake_loader(monkeypatch):
+    monkeypatch.setattr(
+        "tpu_pipelines.serving.fleet.versions._default_loader", _fake_loader
+    )
+    return _fake_loader
+
+
+@component(inputs={"examples": "Examples"}, outputs={"model": "Model"})
+def StubTrainer(ctx):
+    n = sum(
+        ctx.input("examples").properties.get("split_counts", {}).values()
+    )
+    with open(os.path.join(ctx.output("model").uri, "scale.txt"), "w") as f:
+        f.write(str(float(n)))
+    return {"rows_trained": n}
+
+
+@component(
+    inputs={
+        "model": "Model",
+        "baseline_model": "Model",
+        "statistics": "ExampleStatistics",
+    },
+    optional_inputs=("baseline_model",),
+    outputs={"blessing": "ModelBlessing"},
+    is_sink=True,
+)
+def StubEvaluator(ctx):
+    with open(os.path.join(ctx.output("blessing").uri, "BLESSED"), "w") as f:
+        json.dump({"reasons": []}, f)
+    ctx.output("blessing").properties["blessed"] = True
+    return {
+        "blessed": True,
+        "had_baseline": bool(ctx.inputs.get("baseline_model")),
+    }
+
+
+class _Harness:
+    """One continuous deployment: shared store, span + window factories,
+    a fleet-mode ModelServer on the stub loader, and a controller."""
+
+    def __init__(self, tmp_path, window_spans=3, serving=True,
+                 probation_watch_s=1.0):
+        from tpu_pipelines.observability.metrics import MetricsRegistry
+
+        self.td = str(tmp_path)
+        self.data = os.path.join(self.td, "data")
+        self.pattern = os.path.join(self.data, "span-{SPAN}", "v-{VERSION}")
+        self.md = os.path.join(self.td, "md.sqlite")
+        self.root = os.path.join(self.td, "root")
+        self.dest = os.path.join(self.td, "serving")
+        self.registry = MetricsRegistry()
+        self.window_spans = window_spans
+        self.server = None
+        self.serving_url = ""
+        if serving:
+            from tpu_pipelines.serving import ModelServer
+
+            # Bootstrap version so the server starts before the first push.
+            os.makedirs(os.path.join(self.dest, "1"))
+            with open(
+                os.path.join(self.dest, "1", "scale.txt"), "w"
+            ) as f:
+                f.write("1.0")
+            self.server = ModelServer(
+                "m", self.dest, replicas=2, max_versions=2,
+                swap_probation_s=300.0,
+            )
+            port = self.server.start()
+            self.serving_url = f"http://127.0.0.1:{port}/v1/models/m"
+        from tpu_pipelines.continuous import (
+            ContinuousConfig,
+            ContinuousController,
+        )
+
+        self.cfg = ContinuousConfig(
+            input_pattern=self.pattern,
+            make_span_pipeline=self.make_span_pipeline,
+            make_window_pipeline=self.make_window_pipeline,
+            poll_interval_s=0.1,
+            serving_url=self.serving_url,
+            probation_watch_s=probation_watch_s,
+            probation_poll_s=0.05,
+            state_dir=os.path.join(self.td, "state"),
+            registry=self.registry,
+        )
+        self.controller = ContinuousController(self.cfg)
+
+    def write_span(self, span, rows, version=1):
+        return _write_span(self.data, span, rows, version=version)
+
+    def make_span_pipeline(self, span, version):
+        from tpu_pipelines.components import CsvExampleGen, StatisticsGen
+
+        gen = CsvExampleGen(
+            input_path=self.pattern, span=span, num_shards=2
+        )
+        stats = StatisticsGen(
+            examples=gen.outputs["examples"], save_accumulators=True
+        )
+        return Pipeline(
+            "spans-ingest", [gen, stats],
+            pipeline_root=os.path.join(self.root, "ingest"),
+            metadata_path=self.md,
+            node_timeout_s=120,
+        )
+
+    def make_window_pipeline(self):
+        from tpu_pipelines.components import Pusher, RollingWindowResolver
+        from tpu_pipelines.continuous import (
+            SpanWindow,
+            WindowStatisticsMerger,
+        )
+
+        win = RollingWindowResolver(
+            window_spans=self.window_spans,
+            source_pipeline="spans-ingest",
+            examples_producer="CsvExampleGen",
+            statistics_producer="StatisticsGen",
+        )
+        spanwin = SpanWindow(examples=win.outputs["examples"])
+        merged = WindowStatisticsMerger(statistics=win.outputs["statistics"])
+        trainer = StubTrainer(examples=spanwin.outputs["window"])
+        evaluator = StubEvaluator(
+            model=trainer.outputs["model"],
+            baseline_model=win.outputs["model"],
+            statistics=merged.outputs["statistics"],
+        )
+        pusher = Pusher(
+            model=trainer.outputs["model"],
+            blessing=evaluator.outputs["blessing"],
+            push_destination=self.dest,
+            serving_push_url=self.serving_url,
+        ).with_lint_suppressions("TPP109")
+        return Pipeline(
+            "window-train",
+            [win, spanwin, merged, trainer, evaluator, pusher],
+            pipeline_root=os.path.join(self.root, "window"),
+            metadata_path=self.md,
+            node_timeout_s=120,
+        )
+
+    def close(self):
+        if self.server is not None:
+            self.server.stop()
+
+
+# -------------------------------------------------- satellite: list_spans
+
+
+def test_list_spans_triples_and_ordering(tmp_path):
+    from tpu_pipelines.utils.span import list_spans
+
+    base = tmp_path / "d"
+    for d in ("span-1/v-1", "span-1/v-2", "span-2/v-1", "span-010/v-1"):
+        (base / d).mkdir(parents=True)
+    pattern = str(base / "span-{SPAN}" / "v-{VERSION}")
+    got = list_spans(pattern)
+    # Ascending (span, version); zero-padded span orders numerically.
+    assert [(s, v) for s, v, _ in got] == [
+        (1, 1), (1, 2), (2, 1), (10, 1),
+    ]
+    assert got[1][2].endswith(os.path.join("span-1", "v-2"))
+    # Version re-delivery ordering: the LAST entry per span is its newest
+    # delivery, even when the re-delivery is zero-padded.
+    (base / "span-2" / "v-010").mkdir()
+    got = list_spans(pattern)
+    assert [(s, v) for s, v, _ in got if s == 2] == [(2, 1), (2, 10)]
+
+    # No {VERSION} token: version is None.
+    (base / "flat-3").mkdir()
+    (base / "flat-7").mkdir()
+    got = list_spans(str(base / "flat-{SPAN}"))
+    assert [(s, v) for s, v, _ in got] == [(3, None), (7, None)]
+
+    # Empty is a valid watcher answer; a token-less pattern is an error.
+    assert list_spans(str(base / "nope-{SPAN}")) == []
+    with pytest.raises(ValueError, match="SPAN"):
+        list_spans(str(base / "no-token"))
+    # A span dir with no version delivered yet is omitted, not an error.
+    (base / "span-9").mkdir()
+    got = list_spans(pattern)
+    assert 9 not in {s for s, _, _ in got}
+
+
+def test_span_watcher_ack_redelivery_and_persistence(tmp_path):
+    from tpu_pipelines.continuous import SpanWatcher
+
+    base = tmp_path / "d"
+    pattern = str(base / "span-{SPAN}" / "v-{VERSION}")
+    state = str(tmp_path / "watcher.json")
+    (base / "span-1" / "v-1").mkdir(parents=True)
+    (base / "span-1" / "v-2").mkdir()
+    (base / "span-2" / "v-1").mkdir(parents=True)
+
+    w = SpanWatcher(pattern, state_path=state)
+    got = w.poll()
+    # One delivery per span: the newest version, superseded ones skipped.
+    assert [(d.span, d.version) for d in got] == [(1, 2), (2, 1)]
+    w.ack(got)
+    assert w.poll() == []
+    assert w.seen_spans() == [1, 2]
+
+    # A version RE-delivery of an acked span is fresh work.
+    (base / "span-2" / "v-2").mkdir()
+    got = w.poll()
+    assert [(d.span, d.version) for d in got] == [(2, 2)]
+
+    # State survives a restart (un-acked re-delivery still reported).
+    w2 = SpanWatcher(pattern, state_path=state)
+    assert w2.seen_spans() == [1, 2]
+    assert [(d.span, d.version) for d in w2.poll()] == [(2, 2)]
+
+    # Corrupt state degrades to from-scratch (at-least-once), not a crash.
+    with open(state, "w") as f:
+        f.write("{torn")
+    w3 = SpanWatcher(pattern, state_path=state)
+    assert len(w3.poll()) == 2
+
+
+# ------------------------------------- satellite: VERSION re-delivery cache
+
+
+def test_example_gen_version_redelivery_invalidates_cache(tmp_path):
+    """A new {VERSION} re-delivering an existing span is a CHANGED span:
+    even a byte-identical re-delivery re-executes (the artifact must be
+    re-stamped with the new version), never a cache hit."""
+    from tpu_pipelines.components import CsvExampleGen
+
+    data = tmp_path / "data"
+    _write_span(data, 1, 10, version=1)
+    pattern = str(data / "span-{SPAN}" / "v-{VERSION}")
+
+    def pipeline():
+        gen = CsvExampleGen(input_path=pattern)
+        return Pipeline(
+            "redelivery", [gen],
+            pipeline_root=str(tmp_path / "root"),
+            metadata_path=str(tmp_path / "md.sqlite"),
+        )
+
+    r1 = LocalDagRunner().run(pipeline())
+    assert r1.nodes["CsvExampleGen"].status == "COMPLETE"
+    assert r1.outputs_of("CsvExampleGen", "examples")[0].properties[
+        "version"
+    ] == 1
+
+    # Unchanged delivery: cache hit.
+    assert LocalDagRunner().run(pipeline()).nodes[
+        "CsvExampleGen"
+    ].status == "CACHED"
+
+    # Byte-identical payload under a NEW version: changed span, re-run.
+    shutil.copytree(
+        str(data / "span-1" / "v-1"), str(data / "span-1" / "v-2")
+    )
+    r3 = LocalDagRunner().run(pipeline())
+    assert r3.nodes["CsvExampleGen"].status == "COMPLETE"
+    assert r3.outputs_of("CsvExampleGen", "examples")[0].properties[
+        "version"
+    ] == 2
+
+    # And the new identity is itself cache-stable.
+    assert LocalDagRunner().run(pipeline()).nodes[
+        "CsvExampleGen"
+    ].status == "CACHED"
+
+
+# ------------------------------------------- rolling window + merge pieces
+
+
+def test_rolling_window_resolver_selection(tmp_path):
+    """Window selection: last-K spans, newest version per span, producer
+    filter, span-ascending output, bootstrap-empty model."""
+    from tpu_pipelines.components.resolver import resolve_artifacts
+    from tpu_pipelines.metadata import open_store
+    from tpu_pipelines.metadata.types import (
+        Artifact,
+        Context,
+        Execution,
+        ExecutionState,
+    )
+
+    store = open_store(str(tmp_path / "md.sqlite"))
+    ctx = Context("pipeline", "ingest")
+    store.put_context(ctx)
+
+    def publish(span, version, producer, type_name="Examples"):
+        art = Artifact(
+            type_name=type_name, uri=f"/x/{producer}/{span}/{version}",
+            properties={"span": span, "version": version},
+        )
+        ex = Execution(
+            type_name="T", node_id=producer,
+            state=ExecutionState.COMPLETE,
+        )
+        store.publish_execution(ex, {}, {"out": [art]}, [ctx])
+        return art
+
+    for span in (1, 2, 3, 4):
+        publish(span, 1, "Gen")
+        publish(span, 1, "Stats", type_name="ExampleStatistics")
+    publish(2, 3, "Gen")          # re-delivery: v3 of span 2
+    publish(2, 2, "Gen")          # out-of-order lower version: must lose
+    publish(9, 1, "Other")        # different producer: filtered out
+
+    out = resolve_artifacts(
+        store, strategy="rolling_window", pipeline_name="train",
+        within_pipeline=False,
+        extra={
+            "window_spans": 3, "source_pipeline": "ingest",
+            "examples_producer": "Gen", "statistics_producer": "Stats",
+        },
+    )
+    assert [a.properties["span"] for a in out["examples"]] == [2, 3, 4]
+    # Span 2 resolves to its NEWEST delivery (v3), not the late v2.
+    assert out["examples"][0].properties["version"] == 3
+    assert [a.properties["span"] for a in out["statistics"]] == [2, 3, 4]
+    assert out["model"] == []     # no blessed model anywhere yet
+
+    # Window wider than history: everything, still span-ascending.
+    out = resolve_artifacts(
+        store, strategy="rolling_window", pipeline_name="train",
+        within_pipeline=False,
+        extra={
+            "window_spans": 99, "source_pipeline": "ingest",
+            "examples_producer": "Gen",
+        },
+    )
+    assert [a.properties["span"] for a in out["examples"]] == [1, 2, 3, 4]
+
+    # Unknown source pipeline: empty window, not an error (bootstrap).
+    out = resolve_artifacts(
+        store, strategy="rolling_window", pipeline_name="train",
+        within_pipeline=False,
+        extra={"window_spans": 3, "source_pipeline": "nope"},
+    )
+    assert out["examples"] == [] and out["statistics"] == []
+    store.close()
+
+
+def test_window_union_and_merged_stats_identity(tmp_path):
+    """SpanWindow + WindowStatisticsMerger vs a cold full run over the
+    SAME window artifact: row multiset identical, merged statistics
+    byte-identical (the incremental contract)."""
+    from tpu_pipelines.components import (
+        CsvExampleGen,
+        Importer,
+        StatisticsGen,
+    )
+    from tpu_pipelines.continuous import SpanWindow, WindowStatisticsMerger
+    from tpu_pipelines.components.resolver import RollingWindowResolver
+
+    data = tmp_path / "data"
+    for span, rows in ((1, 30), (2, 50), (3, 20)):
+        _write_span(data, span, rows)
+    pattern = str(data / "span-{SPAN}" / "v-{VERSION}")
+    md = str(tmp_path / "md.sqlite")
+
+    for span in (1, 2, 3):
+        gen = CsvExampleGen(input_path=pattern, span=span, num_shards=2)
+        stats = StatisticsGen(
+            examples=gen.outputs["examples"], save_accumulators=True
+        )
+        LocalDagRunner().run(Pipeline(
+            "ingest", [gen, stats],
+            pipeline_root=str(tmp_path / "root"), metadata_path=md,
+        ))
+
+    win = RollingWindowResolver(
+        window_spans=3, source_pipeline="ingest",
+        examples_producer="CsvExampleGen",
+        statistics_producer="StatisticsGen",
+    )
+    spanwin = SpanWindow(examples=win.outputs["examples"])
+    merged = WindowStatisticsMerger(statistics=win.outputs["statistics"])
+    r = LocalDagRunner().run(Pipeline(
+        "window", [win, spanwin, merged],
+        pipeline_root=str(tmp_path / "wroot"), metadata_path=md,
+    ))
+    assert r.succeeded
+    window_art = r.outputs_of("SpanWindow", "window")[0]
+    merged_art = r.outputs_of("WindowStatisticsMerger", "statistics")[0]
+    assert window_art.properties["window_spans"] == [1, 2, 3]
+
+    # Cold full run over the very same window artifact.
+    imp = Importer(source_uri=window_art.uri, artifact_type="Examples")
+    cold_stats = StatisticsGen(examples=imp.outputs["result"])
+    rc = LocalDagRunner().run(Pipeline(
+        "cold", [imp, cold_stats],
+        pipeline_root=str(tmp_path / "croot"),
+        metadata_path=str(tmp_path / "cold.sqlite"),
+    ))
+    cold_art = rc.outputs_of("StatisticsGen", "statistics")[0]
+    with open(os.path.join(cold_art.uri, "stats.json")) as f:
+        cold = json.load(f)
+    with open(os.path.join(merged_art.uri, "stats.json")) as f:
+        inc = json.load(f)
+    assert inc == cold
+    assert sum(s["num_examples"] for s in cold.values()) == 100
+
+    # Row multiset: the union holds every span's rows exactly once.
+    from tpu_pipelines.data import examples_io
+
+    n = sum(
+        examples_io.num_rows(window_art.uri, s)
+        for s in examples_io.split_names(window_art.uri)
+    )
+    assert n == 100
+
+
+def test_window_merger_requires_mergeable_stats(tmp_path):
+    """Statistics produced WITHOUT save_accumulators are refused with a
+    pointed error, never silently approximated."""
+    from tpu_pipelines.components import CsvExampleGen, StatisticsGen
+    from tpu_pipelines.components.resolver import RollingWindowResolver
+    from tpu_pipelines.continuous import WindowStatisticsMerger
+    from tpu_pipelines.orchestration import PipelineRunError
+
+    data = tmp_path / "data"
+    _write_span(data, 1, 10)
+    pattern = str(data / "span-{SPAN}" / "v-{VERSION}")
+    md = str(tmp_path / "md.sqlite")
+    gen = CsvExampleGen(input_path=pattern, span=1)
+    stats = StatisticsGen(examples=gen.outputs["examples"])  # no accs
+    LocalDagRunner().run(Pipeline(
+        "ingest", [gen, stats],
+        pipeline_root=str(tmp_path / "root"), metadata_path=md,
+    ))
+    win = RollingWindowResolver(
+        window_spans=2, source_pipeline="ingest",
+        statistics_producer="StatisticsGen",
+    )
+    merged = WindowStatisticsMerger(statistics=win.outputs["statistics"])
+    with pytest.raises(PipelineRunError, match="save_accumulators"):
+        LocalDagRunner().run(Pipeline(
+            "window", [win, merged],
+            pipeline_root=str(tmp_path / "wroot"), metadata_path=md,
+        ))
+
+
+# ----------------------------------------------------- controller behavior
+
+
+def test_controller_incremental_iterations(tmp_path, fake_loader):
+    """Spans 1+2 bootstrap; span 3 arrives -> ONLY span 3's ingest/stats
+    execute (work_saved 2/3), the window retrains and redeploys; an idle
+    tick runs nothing and deploys nothing."""
+    h = _Harness(tmp_path, probation_watch_s=0.0)
+    try:
+        h.write_span(1, 40)
+        h.write_span(2, 60)
+        it1 = h.controller.run_once()
+        assert it1["spans_processed"] == 2
+        assert it1["work_saved_ratio"] == 0.0            # cold bootstrap
+        assert it1["deployed"]["version"] == "2"
+        assert it1["deployed"]["reload_notified"] is True
+        assert h.server.version == "2"
+
+        idle = h.controller.run_once()
+        assert idle["spans_processed"] == 0
+        assert idle["deployed"] is None
+        assert idle["nodes_executed"] == 0
+
+        h.write_span(3, 80)
+        it3 = h.controller.run_once()
+        assert it3["spans_processed"] == 1
+        assert it3["work_saved_ratio"] == pytest.approx(2 / 3, abs=1e-3)
+        assert it3["deployed"]["version"] == "3"
+        assert h.server.version == "3"
+
+        # Incremental in the store too: exactly one StatisticsGen
+        # execution per span, ever.
+        from tpu_pipelines.metadata import open_store
+
+        store = open_store(h.md)
+        stats_runs = [
+            e for e in store.get_executions(node_id="StatisticsGen")
+            if e.state.value in ("COMPLETE", "CACHED")
+        ]
+        store.close()
+        assert len(stats_runs) == 3
+        assert h.registry.get("continuous_deploys_total").get() == 2
+        assert h.registry.get("continuous_spans_seen").get() == 3
+    finally:
+        h.close()
+
+
+def test_controller_restart_does_not_reprocess(tmp_path, fake_loader):
+    """Watcher acks persist: a restarted controller ignores processed
+    spans but picks up a version re-delivery of one of them."""
+    h = _Harness(tmp_path, probation_watch_s=0.0)
+    try:
+        h.write_span(1, 40)
+        assert h.controller.run_once()["spans_processed"] == 1
+
+        from tpu_pipelines.continuous import ContinuousController
+
+        c2 = ContinuousController(h.cfg)
+        idle = c2.run_once()
+        assert idle["spans_processed"] == 0 and idle["deployed"] is None
+
+        h.write_span(1, 45, version=2)  # re-delivery
+        it = c2.run_once()
+        assert it["deliveries"] == ["1:2"]
+        assert it["spans_processed"] == 1
+        assert it["deployed"] is not None  # retrained on the re-delivery
+    finally:
+        h.close()
+
+
+def test_controller_crash_marker_resumes_window_without_redeploy(
+    tmp_path, fake_loader
+):
+    """A controller that died mid-window-run restarts DIRTY: the pending
+    marker re-arms the window on the first tick, the resumed run adopts
+    the already-published executions, and an adopted Pusher is NOT
+    counted as a fresh deploy (no double hot-swap observation)."""
+    h = _Harness(tmp_path, probation_watch_s=0.0)
+    try:
+        h.write_span(1, 40)
+        it1 = h.controller.run_once()
+        assert it1["deployed"]["version"] == "2"
+
+        # Simulate death mid-window-run: the pending marker survives.
+        from tpu_pipelines.robustness import atomic_write_json
+
+        atomic_write_json(
+            os.path.join(h.cfg.state_dir, "pending.json"),
+            {"pipeline": "window-train", "kind": "window"},
+        )
+        from tpu_pipelines.continuous import ContinuousController
+
+        c2 = ContinuousController(h.cfg)
+        it = c2.run_once()
+        # The window work re-ran (resume adopted everything), but the
+        # adopted push is not a new deploy.
+        assert it["deployed"] is None
+        assert h.registry.get("continuous_deploys_total").get() == 1
+        assert h.server.version == "2"
+        # The marker cleared: the next tick is a plain idle tick.
+        idle = c2.run_once()
+        assert idle["nodes_executed"] == 0 and idle["deployed"] is None
+    finally:
+        h.close()
+
+
+def test_controller_drain_and_stop(tmp_path, fake_loader):
+    """run(stop_event) drains: the loop exits promptly once signalled and
+    starts no further iterations."""
+    h = _Harness(tmp_path, serving=False)
+    try:
+        h.write_span(1, 10)
+        stop = threading.Event()
+        t = threading.Thread(
+            target=h.controller.run, kwargs={"stop_event": stop}
+        )
+        t.start()
+        deadline = time.monotonic() + 30
+        while (
+            not h.controller.watcher.seen_spans()
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+        stop.set()
+        t.join(timeout=30)
+        assert not t.is_alive()
+        iterations = h.controller.status()["iterations"]
+        time.sleep(0.3)
+        assert h.controller.status()["iterations"] == iterations
+    finally:
+        h.close()
+
+
+def test_controller_refuses_split_metadata_stores(tmp_path):
+    from tpu_pipelines.continuous import (
+        ContinuousConfig,
+        ContinuousController,
+    )
+
+    data = tmp_path / "data"
+    _write_span(data, 1, 5)
+
+    def span_p(span, version):
+        from tpu_pipelines.components import CsvExampleGen
+
+        gen = CsvExampleGen(
+            input_path=str(data / "span-{SPAN}" / "v-{VERSION}"), span=span
+        )
+        return Pipeline(
+            "a", [gen], pipeline_root=str(tmp_path / "r1"),
+            metadata_path=str(tmp_path / "md1.sqlite"),
+        )
+
+    def window_p():
+        @component(outputs={"model": "Model"}, name="Never")
+        def Never(ctx):  # never reached: the store check refuses first
+            pass
+
+        return Pipeline(
+            "b", [Never()], pipeline_root=str(tmp_path / "r2"),
+            metadata_path=str(tmp_path / "md2.sqlite"),
+        )
+
+    from tpu_pipelines.observability.metrics import MetricsRegistry
+
+    c = ContinuousController(ContinuousConfig(
+        input_pattern=str(data / "span-{SPAN}" / "v-{VERSION}"),
+        make_span_pipeline=span_p,
+        make_window_pipeline=window_p,
+        registry=MetricsRegistry(),
+    ))
+    with pytest.raises(ValueError, match="share one metadata store"):
+        c.run_once()
+
+
+# ------------------------------------------------------- acceptance (e2e)
+
+
+def test_e2e_incremental_deploy_rollback_unblessing(tmp_path, fake_loader):
+    """ISSUE 13 acceptance: span N+1 arrival -> incremental run (stats
+    recompute only the new span; merged stats == cold full run) ->
+    blessed model deploys through the fleet canary -> injected SLO
+    breach inside probation rolls back -> the controller records the
+    un-blessing in the metadata store."""
+    h = _Harness(tmp_path, probation_watch_s=8.0)
+    try:
+        # Bootstrap: two spans, first deploy (no breach: probation watch
+        # sees a healthy fleet and returns after its window... keep the
+        # first watch short by breaching only the SECOND deploy).
+        h.cfg.probation_watch_s = 0.0
+        h.write_span(1, 40)
+        h.write_span(2, 60)
+        it1 = h.controller.run_once()
+        assert it1["deployed"]["version"] == "2"
+        assert h.server._fleet.active_version == "2"
+
+        # Span 3 arrives.  Inject a post-deploy SLO breach the moment v3
+        # serves — inside the 300 s probation window the fleet opened at
+        # the swap (the SLOMonitor's on_breach path, fired directly).
+        h.cfg.probation_watch_s = 8.0
+        h.write_span(3, 80)
+        fleet = h.server._fleet
+
+        def breach_when_v3():
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if h.server.version == "3":
+                    fleet.on_slo_breach({"slo": "latency_p99"})
+                    return
+                time.sleep(0.01)
+
+        t = threading.Thread(target=breach_when_v3)
+        t.start()
+        it3 = h.controller.run_once()
+        t.join(timeout=30)
+
+        # Incremental: only the new span was processed.
+        assert it3["spans_processed"] == 1
+        assert it3["work_saved_ratio"] == pytest.approx(2 / 3, abs=1e-3)
+        # Deploy happened, rollback observed inside probation.
+        assert it3["deployed"]["version"] == "3"
+        assert it3["rollback_observed"] is True
+        assert fleet.active_version == "2"
+        assert "3" in fleet.versions.quarantined()
+        assert h.registry.get(
+            "continuous_rollbacks_observed_total"
+        ).get() == 1
+
+        # The metadata store records the un-blessing: the triggering
+        # run's blessing is blessed=False (markers rewritten), its model
+        # quarantined, and the resolver baselines the PRIOR model.
+        from tpu_pipelines.components.resolver import resolve_artifacts
+        from tpu_pipelines.metadata import open_store
+
+        store = open_store(h.md)
+        try:
+            unblessed = [
+                b for b in store.get_artifacts(type_name="ModelBlessing")
+                if b.properties.get("blessed") is False
+            ]
+            assert len(unblessed) == 1
+            assert "auto-rollback" in unblessed[0].properties[
+                "unblessed_reason"
+            ]
+            assert os.path.exists(
+                os.path.join(unblessed[0].uri, "NOT_BLESSED")
+            )
+            assert not os.path.exists(
+                os.path.join(unblessed[0].uri, "BLESSED")
+            )
+            bad_models = [
+                m for m in store.get_artifacts(type_name="Model")
+                if m.properties.get("rollback_quarantined")
+            ]
+            assert len(bad_models) == 1
+            baseline = resolve_artifacts(
+                store, strategy="latest_blessed_model",
+                pipeline_name="window-train",
+            )["model"]
+            assert baseline and baseline[0].id != bad_models[0].id
+        finally:
+            store.close()
+
+        # Merged window statistics == a cold full run over the window
+        # artifact (bit-identical JSON).
+        from tpu_pipelines.components import Importer, StatisticsGen
+
+        store = open_store(h.md)
+        merged_art = max(
+            (a for a in store.get_artifacts(type_name="ExampleStatistics")
+             if a.properties.get("window_spans") == [1, 2, 3]),
+            key=lambda a: a.id,
+        )
+        window_art = max(
+            (a for a in store.get_artifacts(type_name="Examples")
+             if a.properties.get("window_spans") == [1, 2, 3]),
+            key=lambda a: a.id,
+        )
+        store.close()
+        imp = Importer(source_uri=window_art.uri, artifact_type="Examples")
+        cold_sg = StatisticsGen(examples=imp.outputs["result"])
+        rc = LocalDagRunner().run(Pipeline(
+            "cold", [imp, cold_sg],
+            pipeline_root=str(tmp_path / "croot"),
+            metadata_path=str(tmp_path / "cold.sqlite"),
+        ))
+        cold_art = rc.outputs_of("StatisticsGen", "statistics")[0]
+        with open(os.path.join(cold_art.uri, "stats.json")) as f:
+            cold = json.load(f)
+        with open(os.path.join(merged_art.uri, "stats.json")) as f:
+            inc = json.load(f)
+        assert inc == cold
+    finally:
+        h.close()
+
+
+# ----------------------------------------------------------------- CLI
+
+
+def test_cli_continuous_once(tmp_path, capsys):
+    """``tpp continuous --once``: loads create_continuous(), runs one
+    iteration, prints the drained summary, exits 0."""
+    from tpu_pipelines.__main__ import main
+
+    data = tmp_path / "data"
+    _write_span(data, 1, 8)
+    module = tmp_path / "cont_module.py"
+    module.write_text(f"""
+import os
+
+TD = {str(tmp_path)!r}
+
+
+def _span_pipeline(span, version):
+    from tpu_pipelines.components import CsvExampleGen, StatisticsGen
+    from tpu_pipelines.dsl.pipeline import Pipeline
+
+    gen = CsvExampleGen(
+        input_path=os.path.join(TD, "data", "span-{{SPAN}}", "v-{{VERSION}}"),
+        span=span,
+    )
+    stats = StatisticsGen(
+        examples=gen.outputs["examples"], save_accumulators=True
+    )
+    return Pipeline(
+        "cli-ingest", [gen, stats],
+        pipeline_root=os.path.join(TD, "root"),
+        metadata_path=os.path.join(TD, "md.sqlite"),
+        node_timeout_s=60,
+    )
+
+
+def _window_pipeline():
+    from tpu_pipelines.components import RollingWindowResolver
+    from tpu_pipelines.continuous import SpanWindow, WindowStatisticsMerger
+    from tpu_pipelines.dsl.pipeline import Pipeline
+
+    win = RollingWindowResolver(
+        window_spans=2, source_pipeline="cli-ingest",
+        examples_producer="CsvExampleGen",
+        statistics_producer="StatisticsGen",
+    )
+    sw = SpanWindow(
+        examples=win.outputs["examples"]
+    ).with_lint_suppressions("TPP101")
+    merged = WindowStatisticsMerger(
+        statistics=win.outputs["statistics"]
+    ).with_lint_suppressions("TPP101")
+    return Pipeline(
+        "cli-window", [win, sw, merged],
+        pipeline_root=os.path.join(TD, "wroot"),
+        metadata_path=os.path.join(TD, "md.sqlite"),
+        node_timeout_s=60,
+    )
+
+
+def create_continuous():
+    from tpu_pipelines.continuous import ContinuousConfig
+
+    return ContinuousConfig(
+        input_pattern=os.path.join(
+            TD, "data", "span-{{SPAN}}", "v-{{VERSION}}"
+        ),
+        make_span_pipeline=_span_pipeline,
+        make_window_pipeline=_window_pipeline,
+        poll_interval_s=0.1,
+        state_dir=os.path.join(TD, "state"),
+    )
+""")
+    rc = main([
+        "continuous", "--pipeline-module", str(module), "--once",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "stopped after 1 iteration(s)" in out
+    assert "spans seen: [1]" in out

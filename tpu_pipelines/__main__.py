@@ -423,12 +423,46 @@ def main(argv=None) -> int:
                         help="lint as if running under the multi-host "
                              "spmd runner (arms TPP108: in-runner retry "
                              "policies are refused there)")
+    p_lint.add_argument("--continuous", action="store_true",
+                        help="lint as if handed to the continuous "
+                             "controller (arms TPP111: nodes with no "
+                             "deadline and no retry policy wedge the "
+                             "always-on loop)")
     p_lint.add_argument("--json", action="store_true",
                         help="machine-readable output (one JSON object)")
     p_lint.add_argument("--fail-on", default="error",
                         choices=["error", "warn"],
                         help="findings at/above this severity exit 3 "
                              "(default: error)")
+
+    p_cont = sub.add_parser(
+        "continuous",
+        help="run the continuous controller: watch a {SPAN} pattern, "
+             "ingest new spans incrementally, retrain over a rolling "
+             "window, deploy blessed models into the serving fleet "
+             "(docs/CONTINUOUS.md)",
+    )
+    p_cont.add_argument("--pipeline-module", required=True,
+                        help="file defining create_continuous() -> "
+                             "ContinuousConfig")
+    p_cont.add_argument("--poll-interval", type=float, default=None,
+                        metavar="SECONDS",
+                        help="override the config's watcher poll interval")
+    p_cont.add_argument("--state-dir", default=None,
+                        help="override the config's controller state dir "
+                             "(watcher acks + in-flight run marker; "
+                             "enables resume across restarts)")
+    p_cont.add_argument("--max-iterations", type=int, default=0,
+                        help="stop after N loop iterations (0 = run until "
+                             "signalled)")
+    p_cont.add_argument("--once", action="store_true",
+                        help="run exactly one iteration and exit "
+                             "(cron-style operation)")
+    p_cont.add_argument("--lint", default=None,
+                        choices=["error", "warn", "off"],
+                        help="lint gate level for handed pipelines "
+                             "(default: config, then env TPP_LINT); "
+                             "TPP111 is armed either way")
 
     inspect = sub.add_parser("inspect", help="read the metadata store")
     # On the parent AND each leaf, so both argument orders work:
@@ -501,6 +535,8 @@ def main(argv=None) -> int:
         return cmd_lint(args)
     if args.cmd == "trace":
         return cmd_trace(args)
+    if args.cmd == "continuous":
+        return cmd_continuous(args)
     if not args.metadata:
         inspect.error("the following arguments are required: --metadata")
     store = MetadataStore(args.metadata)
@@ -533,7 +569,9 @@ def cmd_lint(args) -> int:
     try:
         pipeline = load_fn(args.pipeline_module, "create_pipeline")()
         findings = analyze_pipeline(
-            pipeline, spmd_sync=getattr(args, "spmd_sync", False)
+            pipeline,
+            spmd_sync=getattr(args, "spmd_sync", False),
+            continuous=getattr(args, "continuous", False),
         )
     except Exception as e:
         # The module failing to load/compile is a tool error (1), not a
@@ -554,6 +592,74 @@ def cmd_lint(args) -> int:
             print(f"lint: {len(blocking)} finding(s) at/above "
                   f"--fail-on={args.fail_on}; refusing (exit {EXIT_GATED})")
     return EXIT_GATED if blocking else 0
+
+
+def cmd_continuous(args) -> int:
+    """``continuous --pipeline-module M``: the long-lived controller loop
+    with drain-and-stop signal handling — the first SIGINT/SIGTERM lets
+    the in-flight pipeline run finish and persists state before exiting
+    (no half-acked span, no orphaned pending marker); a second signal
+    aborts hard via the default handler."""
+    import dataclasses
+    import logging
+    import signal
+    import threading
+
+    from tpu_pipelines.analysis import EXIT_GATED, LintGateError
+    from tpu_pipelines.continuous import ContinuousController
+    from tpu_pipelines.utils.module_loader import load_fn
+
+    logging.basicConfig(level=logging.INFO)
+    try:
+        cfg = load_fn(args.pipeline_module, "create_continuous")()
+    except Exception as e:  # noqa: BLE001 — tool error, not a verdict
+        print(f"continuous: cannot load {args.pipeline_module}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    overrides = {}
+    if args.poll_interval is not None:
+        overrides["poll_interval_s"] = args.poll_interval
+    if args.state_dir is not None:
+        overrides["state_dir"] = args.state_dir
+    if args.lint is not None:
+        overrides["lint"] = args.lint
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+
+    stop = threading.Event()
+    default_handlers = {}
+
+    def on_signal(signum, frame):  # noqa: ARG001
+        print(
+            f"continuous: signal {signum} — draining (in-flight run "
+            "finishes, state persists; signal again to abort hard)",
+            file=sys.stderr,
+        )
+        stop.set()
+        # Re-arm the default handler: the SECOND signal kills us.
+        for sig, handler in default_handlers.items():
+            signal.signal(sig, handler)
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        default_handlers[sig] = signal.getsignal(sig)
+        signal.signal(sig, on_signal)
+
+    try:
+        controller = ContinuousController(cfg)
+        controller.run(
+            stop_event=stop,
+            max_iterations=1 if args.once else args.max_iterations,
+        )
+    except LintGateError as e:
+        print(str(e), file=sys.stderr)
+        return EXIT_GATED
+    finally:
+        for sig, handler in default_handlers.items():
+            signal.signal(sig, handler)
+    status = controller.status()
+    print(f"continuous: stopped after {status['iterations']} iteration(s); "
+          f"spans seen: {status['spans_seen']}")
+    return 0
 
 
 def cmd_run(args) -> int:

@@ -86,13 +86,17 @@ def analyze_ir(ir) -> List[Finding]:
     )
 
 
-def analyze_pipeline(pipeline, ir=None, spmd_sync: bool = False) -> List[Finding]:
+def analyze_pipeline(
+    pipeline, ir=None, spmd_sync: bool = False, continuous: bool = False,
+) -> List[Finding]:
     """Both layers for a DSL Pipeline: graph rules on its compiled IR plus
     code rules on every component's executor and module-file entries.
 
     ``spmd_sync`` stamps the compiled IR as bound for the multi-host
     spmd runner (distribution degree lives in runner configs, not the
-    DSL), arming the TPP108 in-runner-retry rule.
+    DSL), arming the TPP108 in-runner-retry rule.  ``continuous`` stamps
+    it as driven by the continuous controller, arming TPP111 (unbounded
+    nodes wedge the always-on loop).
     """
     if ir is None:
         from tpu_pipelines.dsl.compiler import Compiler
@@ -100,6 +104,8 @@ def analyze_pipeline(pipeline, ir=None, spmd_sync: bool = False) -> List[Finding
         ir = Compiler().compile(pipeline)
     if spmd_sync:
         ir.spmd_sync = True
+    if continuous:
+        ir.continuous = True
     findings = list(analyze_ir(ir))
     code: List[Finding] = []
     for comp in pipeline.components:

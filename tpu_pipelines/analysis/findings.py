@@ -87,6 +87,12 @@ RULES: Dict[str, Dict[str, str]] = {
                  "target shapes batching but nothing watches burn rates "
                  "or triggers the post-swap auto-rollback",
     },
+    "TPP111": {
+        "severity": WARN,
+        "title": "continuous-controller pipeline node with no "
+                 "execution_timeout_s and no retry policy: an unbounded "
+                 "incremental run wedges the always-on loop",
+    },
     # ---- TPP2xx: executor/AST code rules (code_rules.py) ----
     "TPP201": {
         "severity": WARN,

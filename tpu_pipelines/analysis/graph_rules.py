@@ -397,6 +397,55 @@ def check_slo_without_monitor(ir: PipelineIR) -> List[Finding]:
     return out
 
 
+def check_unbounded_continuous_nodes(ir: PipelineIR) -> List[Finding]:
+    """TPP111: a pipeline handed to the continuous controller whose node
+    carries NO execution deadline and NO retry policy.  The controller is
+    an always-on loop: a batch run that hangs costs one operator page,
+    but an unbounded incremental run wedges the loop — no new span is
+    ingested, no model retrained, no deploy happens, silently, forever.
+    A deadline (node ``execution_timeout_s`` or the pipeline default)
+    bounds the hang; a retry policy (node or pipeline default) bounds
+    the flake; either suffices.  Armed only when the IR is stamped
+    continuous (``lint --continuous`` / the controller's own pre-flight)
+    — ordinary batch pipelines are exempt.  Resolver nodes answer from
+    the store in the driver and are exempt too."""
+    if not getattr(ir, "continuous", False):
+        return []
+    out = []
+    default_deadline = bool(
+        ir.default_node_timeout_s and ir.default_node_timeout_s > 0
+    )
+    default_retry = bool(getattr(ir, "default_retry_policy", None))
+    for node in ir.nodes:
+        if node.is_resolver:
+            continue
+        bounded = (
+            default_deadline
+            or default_retry
+            or (node.execution_timeout_s and node.execution_timeout_s > 0)
+            or getattr(node, "retry_policy", None)
+        )
+        if bounded:
+            continue
+        out.append(Finding(
+            rule="TPP111", severity=WARN, node_id=node.id,
+            message=(
+                "runs under the continuous controller with no "
+                "execution_timeout_s and no retry policy: one hung or "
+                "flaky execution wedges the always-on loop (no new span "
+                "ingests, no retrain, no deploy) with nothing to bound it"
+            ),
+            fix=(
+                "bound the node: .with_execution_timeout(seconds) or "
+                "Pipeline(node_timeout_s=...) for hangs, "
+                ".with_retry_policy(...) or Pipeline(retry_policy=...) "
+                "for flakes (docs/RECOVERY.md precedence), or suppress "
+                "if an external supervisor bounds the run"
+            ),
+        ))
+    return out
+
+
 def _walk_dicts(obj, prefix=""):
     """Yield (path, dict) over every mapping in a nested exec-property
     tree (the dict itself first, then its children)."""
@@ -436,4 +485,5 @@ GRAPH_RULES = (
     check_retry_policy_under_spmd,
     check_pusher_without_infra_validator,
     check_slo_without_monitor,
+    check_unbounded_continuous_nodes,
 )

@@ -18,5 +18,8 @@ from tpu_pipelines.components.evaluator import Evaluator  # noqa: F401
 from tpu_pipelines.components.pusher import Pusher  # noqa: F401
 from tpu_pipelines.components.bulk_inferrer import BulkInferrer  # noqa: F401
 from tpu_pipelines.components.infra_validator import InfraValidator  # noqa: F401
-from tpu_pipelines.components.resolver import Resolver  # noqa: F401
+from tpu_pipelines.components.resolver import (  # noqa: F401
+    Resolver,
+    RollingWindowResolver,
+)
 from tpu_pipelines.components.importer import Importer  # noqa: F401

@@ -120,6 +120,10 @@ def Pusher(ctx):
         if notify["notified"]:
             result["reload_notified"] = True
             result["reload_version"] = notify.get("version")
+            # On the artifact too: the continuous controller's deploy
+            # observation matches THIS id against the fleet's quarantine
+            # list without re-deriving it from the destination path.
+            pushed_art.properties["reload_version"] = notify.get("version")
         else:
             # Best-effort: the push is durable and the server's poll will
             # converge on it; surface the miss, don't fail the node.

@@ -47,6 +47,12 @@ _RESERVOIR_SIZE = 1 << 17
         # degradation is lineage-visible, never silent.  Off by default:
         # a struck-out shard fails the node.
         "salvage_shards": Parameter(type=bool, default=False),
+        # Persist the PRE-MERGE per-shard accumulators (accumulators.pkl)
+        # alongside stats.json, making this artifact mergeable with other
+        # spans' statistics (docs/CONTINUOUS.md): the continuous window
+        # merger folds them in global shard order and finalizes once, so
+        # incremental merged stats reproduce a cold full-window pass.
+        "save_accumulators": Parameter(type=bool, default=False),
     },
 )
 def StatisticsGen(ctx):
@@ -59,7 +65,9 @@ def StatisticsGen(ctx):
     )
     plan = ShardPlan.resolve(ctx.exec_properties.get("num_shards"))
     salvage = bool(ctx.exec_properties.get("salvage_shards", False))
+    keep_accs = bool(ctx.exec_properties.get("save_accumulators", False))
     stats = {}
+    shard_accs = {}
     shard_counts = {}
     quarantined = {}
     for split in splits:
@@ -83,16 +91,40 @@ def StatisticsGen(ctx):
                     res.raise_on_failure()
                 quarantined[split] = res.failure_summary()
             accs = [a for a in res.results if a is not None]
-            acc = merge_accumulators(accs)
+            if keep_accs:
+                # merge_accumulators folds IN PLACE into accs[0]; the
+                # persisted shard accumulators must stay pre-merge, so
+                # the merge runs on copies (identical values — merge is
+                # a pure function of the accumulator state).
+                import copy
+
+                shard_accs[split] = accs
+                acc = merge_accumulators([copy.deepcopy(a) for a in accs])
+            else:
+                acc = merge_accumulators(accs)
         else:
             acc = SplitStatsAccumulator(split)
             for table in examples_io.iter_table_chunks(
                 examples.uri, split, rows=chunk_rows
             ):
                 acc.update(table)
+            if keep_accs:
+                shard_accs[split] = [acc]  # finalize() does not mutate
         stats[split] = acc.finalize()
     out = ctx.output("statistics")
     save_statistics(out.uri, stats)
+    if keep_accs:
+        from tpu_pipelines.data.statistics import save_split_accumulators
+
+        save_split_accumulators(out.uri, shard_accs)
+        out.properties["mergeable"] = True
+    # Span lineage rides through (docs/CONTINUOUS.md): a per-span
+    # statistics artifact must be joinable back to its span without a
+    # store walk, so the rolling-window resolver can pair it with the
+    # span's Examples.
+    for key in ("span", "version"):
+        if key in examples.properties:
+            out.properties[key] = examples.properties[key]
     out.properties["split_names"] = splits
     props = {
         "data_shards": shard_counts,

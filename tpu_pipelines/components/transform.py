@@ -191,6 +191,15 @@ def Transform(ctx):
     total_rows = sum(counts.values())
     transformed_out.properties["split_names"] = sorted(counts)
     transformed_out.properties["split_counts"] = counts
+    # Span lineage rides through (docs/CONTINUOUS.md): per-span transformed
+    # examples keep their span identity so the rolling-window resolver can
+    # window them exactly like raw Examples (output shard layout already
+    # mirrors the input's shard-for-shard).
+    for key in ("span", "version"):
+        if key in ctx.input("examples").properties:
+            transformed_out.properties[key] = (
+                ctx.input("examples").properties[key]
+            )
     return {
         "num_analyzers": sum(
             1 for n in graph.nodes

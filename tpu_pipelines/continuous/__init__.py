@@ -1,0 +1,40 @@
+"""Continuous pipelines: span-driven incremental runs that deploy into
+the live serving fleet (docs/CONTINUOUS.md, ROADMAP item 1).
+
+The subsystem that turns one-shot batch runs into an always-on loop:
+
+  * :class:`SpanWatcher` polls a ``{SPAN}``/``{VERSION}`` input pattern
+    and reports new spans — and version re-deliveries of old spans — as
+    work, with crash-durable acknowledgement state.
+  * :class:`~tpu_pipelines.components.resolver.RollingWindowResolver`
+    (components/resolver.py) selects the last-K-spans Examples window,
+    their per-span statistics, and the latest blessed baseline model.
+  * :class:`SpanWindow` / :class:`WindowStatisticsMerger` give downstream
+    nodes a logically-complete artifact: the window Examples is a
+    hardlink union of the per-span shard files, and the merged statistics
+    fold the per-span PRE-MERGE accumulators in global shard order — so
+    the incremental result reproduces a cold full-window pass exactly
+    (while every shard fits its reservoir), at the cost of only the NEW
+    span's computation.
+  * :class:`ContinuousController` is the long-lived loop: watch, run the
+    per-span ingest pipeline (execution-cache = incremental), run the
+    window pipeline (retrain only when the window changed), deploy
+    through the Pusher push-URL into the fleet's canary-gated hot-swap,
+    and OBSERVE the fleet: a post-deploy rollback inside the probation
+    window un-blesses the triggering model in the metadata store so the
+    rolling resolver never baselines it.
+"""
+
+from tpu_pipelines.continuous.controller import (  # noqa: F401
+    ContinuousConfig,
+    ContinuousController,
+)
+from tpu_pipelines.continuous.watcher import (  # noqa: F401
+    SpanDelivery,
+    SpanWatcher,
+)
+from tpu_pipelines.continuous.window import (  # noqa: F401
+    SpanWindow,
+    WindowStatisticsMerger,
+    assemble_window,
+)

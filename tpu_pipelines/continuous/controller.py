@@ -1,0 +1,572 @@
+"""ContinuousController: the long-lived span -> retrain -> deploy loop.
+
+One controller = one continuously-retrained service (docs/CONTINUOUS.md):
+
+    poll {SPAN}/{VERSION} pattern          (SpanWatcher)
+      -> per-span ingest pipeline          (new/re-delivered spans only;
+                                            the execution cache IS the
+                                            incremental planner — an
+                                            unchanged span costs a
+                                            fingerprint, not a recompute)
+      -> window pipeline                   (RollingWindowResolver ->
+                                            SpanWindow/WindowStatisticsMerger
+                                            -> Trainer -> Evaluator ->
+                                            Pusher(serving_push_url))
+      -> deploy observation                (a fleet auto-rollback inside
+                                            the probation window un-blesses
+                                            the triggering model in the
+                                            metadata store)
+
+Crash safety: every run the controller launches is an ordinary
+LocalDagRunner run — traced (PR 4), metered (PR 5), retried under the
+pipeline's classified policies (PR 7) — and the controller records which
+pipeline it had in flight (``atomic_write_json`` state), so a restart
+resumes the interrupted run via ``resume_from`` (PR 2) instead of
+re-executing settled nodes.  Watcher acks persist AFTER the span run
+succeeds: the loop is at-least-once, idempotent through the cache.
+
+Stopping: ``run(stop_event)`` drains — the current pipeline run finishes
+(its own deadlines/retry policies bound it; the TPP111 lint rule warns
+when a handed pipeline carries neither), no new run starts, state is
+persisted.  The CLI (``tpp continuous``) maps SIGINT/SIGTERM onto the
+stop event; a second signal aborts hard.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import threading
+import time
+import urllib.parse
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional
+
+from tpu_pipelines.continuous.watcher import SpanDelivery, SpanWatcher
+from tpu_pipelines.dsl.pipeline import Pipeline
+from tpu_pipelines.metadata.types import EventType
+from tpu_pipelines.robustness import atomic_write_json, load_json_tolerant
+
+log = logging.getLogger("tpu_pipelines.continuous")
+
+
+@dataclasses.dataclass
+class ContinuousConfig:
+    """Everything a controller needs; built by the user's
+    ``create_continuous()`` (the ``tpp continuous`` module contract).
+
+    The two pipeline factories MUST share one metadata store: the window
+    pipeline's RollingWindowResolver reads the span pipeline's artifacts
+    through it (``source_pipeline`` scoping).  The controller verifies
+    this on first use and refuses otherwise.
+    """
+
+    # {SPAN} (optionally {VERSION}) input pattern the watcher polls.
+    input_pattern: str
+    # Per-span ingest pipeline: ExampleGen(span=..., version=...) ->
+    # StatisticsGen(save_accumulators=True) [-> Transform ...].
+    make_span_pipeline: Callable[[int, Optional[int]], Pipeline]
+    # Window pipeline: RollingWindowResolver -> SpanWindow/
+    # WindowStatisticsMerger -> Trainer -> Evaluator -> Pusher.
+    make_window_pipeline: Callable[[], Pipeline]
+    poll_interval_s: float = 10.0
+    # Serving base URL for deploy observation, e.g.
+    # "http://127.0.0.1:8501/v1/models/taxi" (the Pusher push-URL).  ""
+    # disables rollback observation.
+    serving_url: str = ""
+    # How long after a deploy to watch for the fleet's auto-rollback; <0 =
+    # the fleet's own probation default (TPP_SWAP_PROBATION_S, 120 s).
+    probation_watch_s: float = -1.0
+    probation_poll_s: float = 1.0
+    # Directory for controller state (watcher acks, in-flight run marker);
+    # "" = in-memory only (no resume across controller restarts).
+    state_dir: str = ""
+    # Lint gate level for handed pipelines ("error"/"warn"/None=env
+    # TPP_LINT).  Pipelines are analyzed with the continuous flag, arming
+    # TPP111 (unbounded nodes wedge the loop).
+    lint: Optional[str] = None
+    # Metrics registry for the controller gauges (None = process default).
+    registry: Any = None
+
+
+class ContinuousController:
+    def __init__(self, cfg: ContinuousConfig):
+        import os
+
+        self.cfg = cfg
+        state_path = ""
+        self._pending_path = ""
+        if cfg.state_dir:
+            os.makedirs(cfg.state_dir, exist_ok=True)
+            state_path = os.path.join(cfg.state_dir, "watcher.json")
+            self._pending_path = os.path.join(cfg.state_dir, "pending.json")
+        self.watcher = SpanWatcher(cfg.input_pattern, state_path=state_path)
+        self._linted: set = set()
+        # A failed window run retries next tick.  A persisted pending
+        # marker means the prior controller died mid-run (or left a
+        # failed window behind): start dirty so the interrupted retrain
+        # resumes on the first tick instead of waiting for the next span.
+        self._window_dirty = bool(self._load_pending())
+        self._metadata_path: Optional[str] = None
+        self.last_deploy: Optional[Dict[str, Any]] = None
+        self.last_iteration: Dict[str, Any] = {}
+        self._iterations = 0
+        self._init_metrics(cfg.registry)
+
+    # ------------------------------------------------------------- metrics
+
+    def _init_metrics(self, registry) -> None:
+        if registry is None:
+            from tpu_pipelines.observability.metrics import default_registry
+
+            registry = default_registry()
+        self.registry = registry
+        self._g_seen = registry.gauge(
+            "continuous_spans_seen",
+            "Spans the watcher has acknowledged (processed at least once).",
+        )
+        self._c_processed = registry.counter(
+            "continuous_spans_processed_total",
+            "Span deliveries whose ingest pipeline run succeeded "
+            "(version re-deliveries re-count).",
+        )
+        self._c_runs = registry.counter(
+            "continuous_runs_total",
+            "Pipeline runs launched by the controller, by kind and "
+            "outcome.",
+            labels=("kind", "outcome"),
+        )
+        self._g_work_saved = registry.gauge(
+            "continuous_incremental_work_saved",
+            "Last active iteration's cache-satisfied fraction of node "
+            "executions (1.0 = nothing recomputed).",
+        )
+        self._c_deploys = registry.counter(
+            "continuous_deploys_total",
+            "Blessed models deployed into the serving fleet (push-URL "
+            "reload notified).",
+        )
+        self._c_rollbacks = registry.counter(
+            "continuous_rollbacks_observed_total",
+            "Fleet auto-rollbacks observed inside the probation window; "
+            "each un-blessed the triggering model in the metadata store.",
+        )
+        self._c_iterations = registry.counter(
+            "continuous_iterations_total",
+            "Controller loop iterations, by activity.",
+            labels=("activity",),
+        )
+
+    # ---------------------------------------------------------------- lint
+
+    def _lint_once(self, pipeline: Pipeline) -> None:
+        """Analyze a handed pipeline (continuous flag armed -> TPP111);
+        gate at cfg.lint / env TPP_LINT level, log findings otherwise.
+        Once per pipeline name — factories return fresh equivalent
+        objects each call."""
+        if pipeline.name in self._linted:
+            return
+        from tpu_pipelines.analysis import (
+            analyze_pipeline,
+            gate_or_raise,
+            resolve_lint_level,
+        )
+
+        findings = analyze_pipeline(pipeline, continuous=True)
+        level = resolve_lint_level(self.cfg.lint)
+        if level:
+            gate_or_raise(
+                findings, level,
+                f"continuous controller ({pipeline.name})",
+            )
+        for f in findings:
+            log.warning("lint: %s", f.format())
+        self._linted.add(pipeline.name)
+
+    # ------------------------------------------------------------ run loop
+
+    def run(
+        self,
+        stop_event: Optional[threading.Event] = None,
+        max_iterations: int = 0,
+    ) -> None:
+        """The controller loop; returns when ``stop_event`` is set (after
+        draining the in-flight iteration) or ``max_iterations`` elapsed."""
+        stop = stop_event if stop_event is not None else threading.Event()
+        done = 0
+        while not stop.is_set():
+            self.run_once(stop)
+            done += 1
+            if max_iterations and done >= max_iterations:
+                break
+            if stop.wait(self.cfg.poll_interval_s):
+                break
+        log.info(
+            "continuous controller stopped after %d iteration(s) "
+            "(drained)", done,
+        )
+
+    def run_once(self, stop: Optional[threading.Event] = None) -> Dict:
+        """One watch -> ingest -> retrain -> deploy-observe iteration."""
+        stop = stop if stop is not None else threading.Event()
+        self._iterations += 1
+        t0 = time.monotonic()
+        deliveries = self.watcher.poll()
+        statuses: List[str] = []
+        processed = 0
+        for d in deliveries:
+            if stop.is_set():
+                break  # drain: no new run starts after the stop signal
+            result = self._run_pipeline(
+                self.cfg.make_span_pipeline(d.span, d.version),
+                kind="span", delivery=d,
+            )
+            if result is not None and result.succeeded:
+                self.watcher.ack([d])
+                processed += 1
+                self._c_processed.inc()
+                statuses.extend(
+                    nr.status for nr in result.nodes.values()
+                )
+                self._window_dirty = True
+        self._g_seen.set(len(self.watcher.seen_spans()))
+
+        deployed: Optional[Dict[str, Any]] = None
+        window_size = 0
+        if (
+            self._window_dirty
+            and not stop.is_set()
+            and self.watcher.seen_spans()
+        ):
+            result = self._run_pipeline(
+                self.cfg.make_window_pipeline(), kind="window"
+            )
+            if result is not None and result.succeeded:
+                self._window_dirty = False
+                statuses.extend(
+                    nr.status for nr in result.nodes.values()
+                )
+                deployed = self._detect_deploy(result)
+                window_size = self._window_span_count(result)
+            else:
+                # Survive a controller restart too: the marker re-arms
+                # _window_dirty in __init__ (resume/caching make the
+                # retried run cheap).
+                self._store_pending({"window_dirty": True})
+
+        active = bool(processed or deployed or statuses)
+        self._c_iterations.labels("active" if active else "idle").inc()
+        executed = statuses.count("COMPLETE")
+        cached = statuses.count("CACHED")
+        # Incremental work saved: of the spans the window retrained over,
+        # the fraction whose ingest+stats were REUSED (no run launched, or
+        # the run cache-hit) rather than recomputed this iteration.  A
+        # cold bootstrap reads 0.0; steady state with window K reads
+        # (K-1)/K.  Falls back to the cache-satisfied node fraction when
+        # no window ran.
+        if window_size:
+            work_saved = max(0.0, 1.0 - processed / window_size)
+        elif cached + executed:
+            work_saved = cached / (cached + executed)
+        else:
+            work_saved = None
+        if work_saved is not None:
+            self._g_work_saved.set(work_saved)
+        summary = {
+            "iteration": self._iterations,
+            "deliveries": [d.key for d in deliveries],
+            "spans_processed": processed,
+            "nodes_executed": executed,
+            "nodes_cached": cached,
+            "work_saved_ratio": (
+                round(work_saved, 4) if work_saved is not None else None
+            ),
+            "deployed": deployed,
+            "wall_s": round(time.monotonic() - t0, 3),
+        }
+        if deployed is not None:
+            self._c_deploys.inc()
+            deployed["deploy_latency_s"] = summary["wall_s"]
+            self.last_deploy = deployed
+            rolled = self._observe_probation(deployed, stop)
+            summary["rollback_observed"] = rolled
+        self.last_iteration = summary
+        log.info("continuous iteration: %s", json.dumps(summary))
+        return summary
+
+    # ----------------------------------------------------------- pipelines
+
+    def _run_pipeline(self, pipeline: Pipeline, kind: str,
+                      delivery: Optional[SpanDelivery] = None):
+        """Run one pipeline with lint, crash-resume, and outcome metrics.
+
+        A pipeline name found in the persisted pending marker resumes via
+        ``resume_from="latest"`` — the restart-after-crash path; a refused
+        resume (changed DAG, no prior run) falls back to a fresh run."""
+        from tpu_pipelines.orchestration import LocalDagRunner
+
+        if self._metadata_path is None:
+            self._metadata_path = pipeline.metadata_path
+        elif pipeline.metadata_path != self._metadata_path:
+            raise ValueError(
+                "continuous pipelines must share one metadata store "
+                f"(window resolver reads span artifacts through it): "
+                f"{pipeline.metadata_path!r} != {self._metadata_path!r}"
+            )
+        self._lint_once(pipeline)
+        resume = None
+        pending = self._load_pending()
+        if pending.get("pipeline") == pipeline.name:
+            resume = "latest"
+        self._store_pending({
+            "pipeline": pipeline.name, "kind": kind,
+            "delivery": delivery.key if delivery else None,
+        })
+        runner = LocalDagRunner()
+        try:
+            try:
+                result = runner.run(
+                    pipeline, resume_from=resume, raise_on_failure=False
+                )
+            except ValueError as e:
+                if resume is None:
+                    raise
+                log.info(
+                    "resume of %s refused (%s); running fresh",
+                    pipeline.name, e,
+                )
+                result = runner.run(pipeline, raise_on_failure=False)
+        except Exception:  # noqa: BLE001 — the loop must survive a bad run
+            log.exception("%s pipeline %s crashed", kind, pipeline.name)
+            self._c_runs.labels(kind, "error").inc()
+            return None
+        finally:
+            self._store_pending({})
+        self._c_runs.labels(
+            kind, "succeeded" if result.succeeded else "failed"
+        ).inc()
+        if not result.succeeded:
+            failed = [
+                f"{nr.node_id}: {nr.error.splitlines()[-1] if nr.error else ''}"
+                for nr in result.nodes.values() if nr.status == "FAILED"
+            ]
+            log.warning(
+                "%s pipeline %s run %s failed at %s (will retry on the "
+                "next tick via resume/caching)",
+                kind, pipeline.name, result.run_id, failed,
+            )
+        return result
+
+    def _load_pending(self) -> Dict[str, Any]:
+        if not self._pending_path:
+            return {}
+        return load_json_tolerant(self._pending_path) or {}
+
+    def _store_pending(self, marker: Dict[str, Any]) -> None:
+        if self._pending_path:
+            atomic_write_json(self._pending_path, marker)
+
+    # -------------------------------------------------------------- deploy
+
+    @staticmethod
+    def _window_span_count(result) -> int:
+        """Spans the window run's SpanWindow artifact covered (0 when the
+        run carried no window artifact)."""
+        for nr in result.nodes.values():
+            for arts in nr.outputs.values():
+                for art in arts:
+                    spans = art.properties.get("window_spans")
+                    if art.type_name == "Examples" and isinstance(
+                        spans, list
+                    ):
+                        return len(spans)
+        return 0
+
+    @staticmethod
+    def _detect_deploy(result) -> Optional[Dict[str, Any]]:
+        """Did this window run push AND hot-reload a version into the
+        fleet?  Read off the PushedModel artifact the Pusher published.
+        CACHED pusher executions are prior pushes replayed by the
+        execution cache, and ADOPTED ones are a resumed run's already-
+        published push — neither is a new deploy, nothing to observe."""
+        for nr in result.nodes.values():
+            if nr.status != "COMPLETE" or nr.adopted:
+                continue
+            for arts in nr.outputs.values():
+                for art in arts:
+                    if art.type_name != "PushedModel":
+                        continue
+                    if not art.properties.get("pushed"):
+                        return None  # blessing gate said no
+                    return {
+                        "run_id": result.run_id,
+                        # The fleet-confirmed reload version when the
+                        # notify answered; the push-destination dir name
+                        # otherwise (same string by the Pusher layout).
+                        "version": str(
+                            art.properties.get("reload_version")
+                            or art.properties.get("pushed_version", "")
+                        ),
+                        "reload_notified": bool(
+                            art.properties.get("reload_notified")
+                        ),
+                        "pushed_artifact_id": art.id,
+                    }
+        return None
+
+    def _probation_window_s(self) -> float:
+        if self.cfg.probation_watch_s >= 0:
+            return self.cfg.probation_watch_s
+        import os
+
+        from tpu_pipelines.serving.fleet.fleet import (
+            DEFAULT_SWAP_PROBATION_S,
+            ENV_SWAP_PROBATION,
+        )
+
+        try:
+            return float(
+                os.environ.get(ENV_SWAP_PROBATION, "").strip()
+                or DEFAULT_SWAP_PROBATION_S
+            )
+        except ValueError:
+            return DEFAULT_SWAP_PROBATION_S
+
+    def _health_url(self) -> str:
+        parts = urllib.parse.urlsplit(self.cfg.serving_url)
+        return urllib.parse.urlunsplit(
+            (parts.scheme, parts.netloc, "/healthz", "", "")
+        )
+
+    def _fetch_health(self) -> Optional[Dict[str, Any]]:
+        try:
+            with urllib.request.urlopen(self._health_url(), timeout=5) as r:
+                return json.load(r)
+        except Exception as e:  # noqa: BLE001 — serving briefly unreachable
+            log.debug("healthz poll failed: %s", e)
+            return None
+
+    def _observe_probation(
+        self, deployed: Dict[str, Any], stop: threading.Event
+    ) -> bool:
+        """Watch the fleet for ``probation_watch_s`` after a deploy: a
+        quarantine of the pushed version means the SLO monitor breached
+        and the fleet auto-rolled back (docs/OBSERVABILITY.md) — record
+        it and un-bless the triggering model so the rolling resolver
+        never baselines it.  Returns True when a rollback was observed.
+
+        On stop-drain the watch performs one final check and exits; a
+        rollback happening after that is reconciled by the NEXT deploy's
+        quarantine check (the fleet keeps the version quarantined)."""
+        if not self.cfg.serving_url or not deployed.get("reload_notified"):
+            return False
+        version = deployed.get("version", "")
+        deadline = time.monotonic() + self._probation_window_s()
+        while True:
+            health = self._fetch_health()
+            fleet = (health or {}).get("fleet") or {}
+            if version and version in (
+                fleet.get("quarantined_versions") or []
+            ):
+                self._record_rollback(deployed)
+                return True
+            if stop.is_set() or time.monotonic() >= deadline:
+                return False
+            if stop.wait(self.cfg.probation_poll_s):
+                # Drain: one last look before handing back control.
+                health = self._fetch_health()
+                fleet = (health or {}).get("fleet") or {}
+                if version and version in (
+                    fleet.get("quarantined_versions") or []
+                ):
+                    self._record_rollback(deployed)
+                    return True
+                return False
+
+    def _record_rollback(self, deployed: Dict[str, Any]) -> None:
+        """The fleet rolled the deploy back: un-bless the triggering
+        model in the metadata store (properties AND on-disk markers), so
+        resolver strategies — which walk blessed=True blessings — never
+        pick it as a baseline, and audit trails show why."""
+        import os
+
+        self._c_rollbacks.inc()
+        deployed["rolled_back"] = True
+        reason = (
+            f"serving fleet auto-rollback: version "
+            f"{deployed.get('version')} quarantined inside the post-swap "
+            f"probation window (run {deployed.get('run_id')})"
+        )
+        log.warning("continuous: %s", reason)
+        from tpu_pipelines.components.evaluator import (
+            BLESSING_FILE,
+            NOT_BLESSED_FILE,
+        )
+        from tpu_pipelines.metadata import open_store
+
+        store = open_store(self._metadata_path)
+        try:
+            pushed = store.get_artifact(
+                int(deployed.get("pushed_artifact_id") or 0)
+            )
+            if pushed is None:
+                return
+            # Walk push -> producing execution -> its blessing/model
+            # INPUTs: the artifacts of the run that deployed this version.
+            ex_ids = [
+                ev.execution_id
+                for ev in store.get_events_by_artifact(pushed.id)
+                if ev.type == EventType.OUTPUT
+            ]
+            for ex_id in ex_ids:
+                for ev in store.get_events_by_execution(ex_id):
+                    if ev.type != EventType.INPUT:
+                        continue
+                    art = store.get_artifact(ev.artifact_id)
+                    if art is None:
+                        continue
+                    if art.type_name == "ModelBlessing":
+                        art.properties.update({
+                            "blessed": False,
+                            "unblessed_reason": reason,
+                        })
+                        store.put_artifact(art)
+                        try:
+                            blessed_marker = os.path.join(
+                                art.uri, BLESSING_FILE
+                            )
+                            if os.path.exists(blessed_marker):
+                                os.remove(blessed_marker)
+                            with open(
+                                os.path.join(art.uri, NOT_BLESSED_FILE), "w"
+                            ) as f:
+                                json.dump({"reasons": [reason]}, f)
+                        except OSError as e:
+                            log.warning(
+                                "could not rewrite blessing markers under "
+                                "%s: %s", art.uri, e,
+                            )
+                    elif art.type_name == "Model" and ev.path == "model":
+                        art.properties.update({
+                            "rollback_quarantined": True,
+                            "unblessed_reason": reason,
+                        })
+                        store.put_artifact(art)
+            pushed.properties.update({
+                "rolled_back": True, "rollback_reason": reason,
+            })
+            store.put_artifact(pushed)
+        finally:
+            store.close()
+
+    # -------------------------------------------------------------- status
+
+    def status(self) -> Dict[str, Any]:
+        return {
+            "pattern": self.cfg.input_pattern,
+            "spans_seen": self.watcher.seen_spans(),
+            "iterations": self._iterations,
+            "last_iteration": self.last_iteration,
+            "last_deploy": self.last_deploy,
+        }

@@ -360,6 +360,51 @@ class SplitStatsAccumulator:
         )
 
 
+ACCUMULATORS_FILE = "accumulators.pkl"
+
+
+def save_split_accumulators(
+    uri: str, accs: Dict[str, List["SplitStatsAccumulator"]]
+) -> str:
+    """Persist PRE-MERGE per-shard accumulators next to ``stats.json``.
+
+    The mergeable half of the statistics artifact (docs/CONTINUOUS.md):
+    where the finalized JSON is a dead end (median/histograms cannot be
+    re-merged), the pickled accumulators let a later consumer — the
+    continuous window merger — fold this split's shards with OTHER
+    artifacts' shards in any global order and finalize once, reproducing
+    a cold single-pass run bit for bit while every shard fits its
+    reservoir.  Shard order within each list is the artifact's shard
+    order; consumers must preserve it.
+    """
+    import pickle
+
+    os.makedirs(uri, exist_ok=True)
+    path = os.path.join(uri, ACCUMULATORS_FILE)
+    with open(path, "wb") as f:
+        pickle.dump(accs, f)
+    return path
+
+
+def load_split_accumulators(
+    uri: str,
+) -> Dict[str, List["SplitStatsAccumulator"]]:
+    """Load the per-shard accumulators a ``save_accumulators=True``
+    StatisticsGen persisted.  Raises FileNotFoundError with a pointed
+    message when the artifact was produced without them."""
+    import pickle
+
+    path = os.path.join(uri, ACCUMULATORS_FILE)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"no {ACCUMULATORS_FILE} under {uri!r}: the statistics "
+            "artifact was produced without save_accumulators=True, so "
+            "it cannot participate in an incremental window merge"
+        )
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
 def compute_split_statistics(split: str, table: pa.Table) -> SplitStatistics:
     """Whole-table statistics: one accumulator update (shared code path with
     streaming, so in-memory and chunked runs cannot drift)."""

@@ -140,6 +140,12 @@ class PipelineIR:
     # reads it to catch in-runner retry policies that the spmd runner
     # would refuse at runtime.
     spmd_sync: bool = False
+    # Execution-context flag like spmd_sync, set by callers that KNOW this
+    # IR will be driven by the continuous controller (`lint --continuous`,
+    # ContinuousController's own pre-flight).  Excluded from fingerprint();
+    # the TPP111 analyzer rule reads it: a node with neither a deadline
+    # nor a retry policy can wedge the always-on loop forever.
+    continuous: bool = False
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -154,6 +160,7 @@ class PipelineIR:
                 if self.default_retry_policy else None
             ),
             "spmd_sync": self.spmd_sync,
+            "continuous": self.continuous,
             "nodes": [n.to_json() for n in self.nodes],
         }
 

@@ -1582,11 +1582,22 @@ class LocalDagRunner:
             path = props.get(param)
             if isinstance(path, str) and has_span_pattern(path):
                 try:
-                    path, _, _ = resolve_span_pattern(
+                    path, r_span, r_version = resolve_span_pattern(
                         path, props.get("span"), props.get("version"),
                     )
                 except FileNotFoundError:
                     path = None  # executor will raise with the real error
+                else:
+                    # The delivery's identity joins the cache key alongside
+                    # its content: fingerprint_dir hashes root-RELATIVE
+                    # names + bytes, so a byte-identical re-delivery under
+                    # a new {VERSION} would otherwise cache-hit and keep
+                    # serving the stale version-stamped artifact — the
+                    # continuous watcher treats a re-delivery as a changed
+                    # span, and the cache must agree.
+                    input_fps[f"__span__:{param}"] = [
+                        f"span={r_span}:version={r_version}"
+                    ]
             if isinstance(path, str) and os.path.exists(path):
                 fp = fingerprint_dir(path)
                 input_fps[f"__external__:{param}"] = [fp]
@@ -1961,6 +1972,9 @@ class LocalDagRunner:
                 strategy=props.get("strategy", "latest_blessed_model"),
                 pipeline_name=ir.name,
                 within_pipeline=bool(props.get("within_pipeline", True)),
+                # Strategy-specific knobs (rolling_window's span count and
+                # producer filters) ride the exec properties verbatim.
+                extra=props,
             )
         except Exception:
             error = traceback.format_exc()

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import glob as _glob
 import re
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
 
 SPAN_TOKEN = "{SPAN}"
 VERSION_TOKEN = "{VERSION}"
@@ -64,6 +64,54 @@ def _resolve_token(path: str, token: str, pinned: Optional[int]) -> Tuple[str, i
     if best is None:
         raise FileNotFoundError(f"no spans match pattern {path!r}")
     return best[1] + tail, best[0]
+
+
+def _matches_for(path: str, token: str) -> List[Tuple[int, str, str]]:
+    """All ``(number, concrete_path, remaining_tail)`` for one token level."""
+    head, tail = _prefix_through(path, token)
+    regex = re.compile(
+        re.escape(head).replace(re.escape(token), r"(\d+)") + r"$"
+    )
+    glob_pat = _glob.escape(head).replace(token, "*")
+    out: List[Tuple[int, str, str]] = []
+    for cand in sorted(_glob.glob(glob_pat)):
+        m = regex.match(cand)
+        if m:
+            out.append((int(m.group(1)), cand, tail))
+    return out
+
+
+def list_spans(path: str) -> List[Tuple[int, Optional[int], str]]:
+    """Enumerate every ``(span, version, path)`` a span pattern matches.
+
+    The continuous controller's watcher surface: where
+    :func:`resolve_span_pattern` answers "what is the NEWEST span", this
+    answers "what spans exist at all" — including every re-delivered
+    ``{VERSION}`` of an already-seen span, so a watcher can treat a
+    version re-delivery as a changed span rather than old news.
+
+    Ordering contract: ascending ``(span, version)`` — within one span,
+    versions sort by their numeric value, so the LAST entry for a span is
+    always its newest delivery (zero-padded layouts order numerically,
+    not lexically).  ``version`` is None when the pattern has no
+    ``{VERSION}`` token.  A span directory matching ``{SPAN}`` but
+    containing no ``{VERSION}`` match is omitted: it has delivered
+    nothing yet.  An empty list — the pattern matches nothing — is a
+    valid answer here (the watcher polls before data lands), unlike
+    ``resolve_span_pattern`` which raises.
+    """
+    out: List[Tuple[int, Optional[int], str]] = []
+    if SPAN_TOKEN not in path:
+        raise ValueError(f"pattern {path!r} has no {{SPAN}} token")
+    for span, span_path, tail in _matches_for(path, SPAN_TOKEN):
+        full = span_path + tail
+        if VERSION_TOKEN in full:
+            for version, vpath, vtail in _matches_for(full, VERSION_TOKEN):
+                out.append((span, version, vpath + vtail))
+        else:
+            out.append((span, None, full))
+    out.sort(key=lambda t: (t[0], t[1] if t[1] is not None else -1))
+    return out
 
 
 def resolve_span_pattern(
