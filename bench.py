@@ -1903,6 +1903,305 @@ def bench_serving_fleet(smoke: bool) -> dict:
     }
 
 
+def bench_serving_quantized(smoke: bool) -> dict:
+    """Quantized + AOT serving leg (ISSUE 14), judged from the fleet's
+    OWN ``/metrics`` scrape:
+
+      1. **Rewrite.**  An embedding-retrieval payload (the weight-bytes-
+         bound serving shape where int8 genuinely wins on any host: each
+         request gathers K rows from a table far bigger than cache, so
+         reading int8 rows moves a quarter of the bytes) runs through the
+         Rewriter component: float32/bfloat16/aqt_int8 variants, quality
+         gated on the Evaluator metric surface, int8 selected, AOT
+         bucket executables pre-compiled into the serialized cache at
+         export time.
+      2. **Float pass.**  The fleet serves the float payload to a
+         steady-state hammer (fresh random ids per request — no gather
+         caching); per-request latency read as the scrape-delta mean.
+      3. **Deploy.**  The Pusher (variant="aqt_int8") pushes the
+         quantized payload and its push-URL hook fires the ``:reload``
+         — canary, then AOT warmup that LOADS the export-time
+         executables (cache hits, no compiles).
+      4. **Int8 pass.**  The identical hammer against the quantized
+         version; ``quantized_speedup`` = float mean / int8 mean, and
+         the post-swap scrape must show
+         ``serving_aot_compiles_after_warm_total == 0`` — the PR 12
+         compiles-after-warm contract holding by construction.
+    """
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    from tpu_pipelines.components.pusher import Pusher
+    from tpu_pipelines.components.rewriter import Rewriter
+    from tpu_pipelines.data.examples_io import (
+        table_from_columns,
+        write_split,
+    )
+    from tpu_pipelines.dsl.component import ExecutorContext
+    from tpu_pipelines.metadata.types import Artifact
+    from tpu_pipelines.serving import ModelServer
+    from tpu_pipelines.trainer.export import export_model
+
+    if smoke:
+        vocab, dim, k_ids = 50_000, 384, 192
+        n_requests = 120
+    else:
+        vocab, dim, k_ids = 100_000, 512, 256
+        n_requests = 480
+    n_threads = 4
+    quality_tolerance = 0.05
+    max_batch = 8
+    rng = np.random.default_rng(14)
+
+    prior_cache = os.environ.get("TPP_AOT_CACHE")
+    with tempfile.TemporaryDirectory() as td:
+        # Leg-scoped AOT cache: the cache-hit accounting below must see
+        # exactly the Rewriter's export-time prewarm, not a prior run's.
+        os.environ["TPP_AOT_CACHE"] = os.path.join(td, "aot-cache")
+        module = os.path.join(td, "emb_model.py")
+        with open(module, "w") as f:
+            f.write(
+                "import jax.numpy as jnp\n"
+                "def build_model(hp):\n"
+                "    return None\n"
+                "def apply_fn(model, params, batch):\n"
+                "    ids = jnp.asarray(batch['ids'], jnp.int32)\n"
+                "    rows = params['emb'][ids]\n"
+                "    return (rows.mean(axis=1) @ params['w'])"
+                ".squeeze(-1)\n"
+            )
+        emb = rng.standard_normal((vocab, dim)).astype(np.float32)
+        w = rng.standard_normal((dim, 1)).astype(np.float32) / np.sqrt(dim)
+        model_dir = os.path.join(td, "model")
+        export_model(
+            serving_model_dir=model_dir,
+            params={"emb": emb, "w": w}, module_file=module,
+        )
+        # Eval slice: labels = the float model + noise (regression).
+        n_eval = 512
+        eval_ids = rng.integers(
+            0, vocab, size=(n_eval, k_ids)
+        ).astype(np.int32)
+        labels = (
+            emb[eval_ids].mean(axis=1) @ w
+        ).squeeze(-1) + 0.01 * rng.standard_normal(n_eval)
+        examples_dir = os.path.join(td, "examples")
+        write_split(examples_dir, "eval", table_from_columns({
+            "ids": eval_ids, "label": labels.astype(np.float32),
+        }))
+
+        rewritten = Artifact(
+            type_name="Model", uri=os.path.join(td, "rewritten")
+        )
+        rw_report = Rewriter.EXECUTOR(ExecutorContext(
+            node_id="Rewriter",
+            inputs={
+                "model": [Artifact(type_name="Model", uri=model_dir)],
+                "examples": [
+                    Artifact(type_name="Examples", uri=examples_dir)
+                ],
+            },
+            outputs={"model": [rewritten]},
+            exec_properties={
+                "variants": ["bfloat16", "aqt_int8"],
+                "quality_tolerance": quality_tolerance,
+                "quality_metrics": ["mae", "r2"],
+                "label_key": "label", "problem": "regression",
+                "eval_split": "eval", "batch_size": 128,
+                "max_eval_examples": n_eval,
+                "selection": "aqt_int8", "min_quant_size": 4096,
+                "latency_batch_size": max_batch, "latency_iters": 30,
+                "aot_warm_buckets": max_batch,
+            },
+        ))
+        int8_info = rw_report["variants"]["aqt_int8"]
+        assert int8_info["blessed"], int8_info
+
+        base = os.path.join(td, "serving")
+        os.makedirs(base)
+        shutil.copytree(model_dir, os.path.join(base, "1"))
+        server = ModelServer(
+            "quant", base, replicas=1, max_versions=2,
+            max_batch_size=max_batch, batch_timeout_s=0.002,
+        )
+        port = server.start()
+        url = f"http://127.0.0.1:{port}/v1/models/quant:predict"
+        id_pool = [
+            json.dumps({"instances": [{
+                "ids": rng.integers(0, vocab, size=k_ids).tolist()
+            }]}).encode()
+            for _ in range(64)
+        ]
+        errors = [0]
+        fired = [0]
+        fired_lock = threading.Lock()
+
+        def fire(n: int) -> None:
+            for _ in range(n):
+                with fired_lock:
+                    i = fired[0]
+                    fired[0] += 1
+                try:
+                    req = urllib.request.Request(
+                        url, data=id_pool[i % len(id_pool)]
+                    )
+                    with urllib.request.urlopen(req, timeout=60) as r:
+                        r.read()
+                except Exception:  # noqa: BLE001
+                    errors[0] += 1
+
+        def hammer() -> None:
+            threads = [
+                threading.Thread(
+                    target=fire, args=(n_requests // n_threads,)
+                )
+                for _ in range(n_threads)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+        def scrape() -> str:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ) as r:
+                return r.read().decode()
+
+        def hist_state(text: str):
+            h = _parse_prom_histogram(
+                text, "serving_request_latency_seconds",
+                'endpoint="predict"',
+            )
+            return h or {"count": 0, "sum": 0.0}
+
+        def pass_mean_ms():
+            """Warm the buckets, then measure one hammer pass as the
+            scrape-delta mean latency (compiles excluded by the warm)."""
+            fire(2 * max_batch)
+            before = hist_state(scrape())
+            t0 = time.perf_counter()
+            hammer()
+            wall = time.perf_counter() - t0
+            after = hist_state(scrape())
+            n = after["count"] - before["count"]
+            s = after["sum"] - before["sum"]
+            return (
+                (s / n * 1e3) if n else None,
+                round(n / wall, 1) if wall else None,
+            )
+
+        try:
+            float_mean_ms, float_qps = pass_mean_ms()
+
+            # Deploy the quantized variant through the Pusher's variant
+            # selection + push-URL hook — the production path.
+            pushed = Artifact(
+                type_name="PushedModel", uri=os.path.join(td, "pushed")
+            )
+            push_result = Pusher.EXECUTOR(ExecutorContext(
+                node_id="Pusher",
+                inputs={"model": [
+                    Artifact(type_name="Model", uri=rewritten.uri)
+                ]},
+                outputs={"pushed_model": [pushed]},
+                exec_properties={
+                    "push_destination": base,
+                    "serving_push_url":
+                        f"http://127.0.0.1:{port}/v1/models/quant",
+                    "variant": "aqt_int8",
+                },
+            ))
+            int8_mean_ms, int8_qps = pass_mean_ms()
+            final_scrape = scrape()
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10
+            ) as r:
+                health = json.loads(r.read())
+        finally:
+            server.stop()
+            if prior_cache is None:
+                os.environ.pop("TPP_AOT_CACHE", None)
+            else:
+                os.environ["TPP_AOT_CACHE"] = prior_cache
+
+    warmup_s = _parse_prom_gauge_value(
+        final_scrape, "serving_swap_warmup_seconds"
+    )
+    aot_hits = int(_parse_prom_counter(
+        final_scrape, "serving_aot_cache_hits_total"
+    ))
+    aot_compiles = int(_parse_prom_counter(
+        final_scrape, "serving_aot_compiles_total"
+    ))
+    compiles_after_warm = int(_parse_prom_counter(
+        final_scrape, "serving_aot_compiles_after_warm_total"
+    ))
+    speedup = (
+        round(float_mean_ms / int8_mean_ms, 3)
+        if float_mean_ms and int8_mean_ms else None
+    )
+    quality_delta = int8_info.get("max_quality_delta")
+    green = bool(
+        errors[0] == 0
+        and push_result.get("pushed") is True
+        and push_result.get("reload_notified") is True
+        and str(health.get("version")) == "2"
+        and speedup is not None and speedup > 1.0
+        and quality_delta is not None
+        and quality_delta <= quality_tolerance
+        and compiles_after_warm == 0
+        and aot_hits >= 1
+    )
+    return {
+        "green": green,
+        "model": {
+            "vocab": vocab, "dim": dim, "ids_per_request": k_ids,
+            "table_mb": round(emb.nbytes / 2**20, 1),
+        },
+        "requests_per_pass": n_requests,
+        "request_errors": errors[0],
+        "variants": rw_report["variants"],
+        "selected_variant": rw_report["selected_variant"],
+        "rewriter_speedup_vs_float": rw_report.get("speedup_vs_float"),
+        "float_mean_ms": (
+            round(float_mean_ms, 3) if float_mean_ms else None
+        ),
+        "int8_mean_ms": round(int8_mean_ms, 3) if int8_mean_ms else None,
+        "float_qps": float_qps,
+        "int8_qps": int8_qps,
+        "quantized_speedup": speedup,
+        "quantized_quality_delta": quality_delta,
+        "quality_tolerance": quality_tolerance,
+        "pushed_version": push_result.get("pushed_version"),
+        "reload_notified": push_result.get("reload_notified"),
+        "swap_warmup_seconds": warmup_s,
+        "aot_cache_hits": aot_hits,
+        "aot_compiles": aot_compiles,
+        "aot_compiles_after_warm": compiles_after_warm,
+        "memory_bytes": {
+            "float32": rw_report["variants"]["float32"]["params_bytes"],
+            "aqt_int8": int8_info["params_bytes"],
+        },
+        "host_cpus": os.cpu_count(),
+        "healthz": health,
+    }
+
+
+def _parse_prom_gauge_value(text: str, name: str):
+    """Value of an unlabeled gauge in a Prometheus text scrape."""
+    for line in text.splitlines():
+        parts = line.split()
+        if len(parts) == 2 and parts[0] == name:
+            try:
+                return float(parts[1])
+            except ValueError:
+                return None
+    return None
+
+
 def bench_generative_serving(smoke: bool) -> dict:
     """Continuous-batching decode leg (ISSUE 11), judged from the fleet's
     OWN ``/metrics`` scrape, as an A/B on identical traffic:
@@ -3633,6 +3932,19 @@ def _compact(report: dict) -> dict:
         compact["fleet_shed_requests"] = fl.get("shed_requests")
         compact["trace_overhead_pct"] = fl.get("trace_overhead_pct")
         compact["slo_rollback_green"] = fl.get("slo_rollback_green")
+    # Quantized-serving headline (ISSUE 14): int8-over-float request
+    # latency at matched QPS, the Evaluator-surface quality delta the
+    # gate recorded, and the post-swap compiles-after-warm audit.
+    sq = report.get("serving_quantized")
+    if isinstance(sq, dict) and "green" in sq:
+        compact["quantized_green"] = bool(sq.get("green"))
+        compact["quantized_speedup"] = sq.get("quantized_speedup")
+        compact["quantized_quality_delta"] = sq.get(
+            "quantized_quality_delta"
+        )
+        compact["aot_compiles_after_warm"] = sq.get(
+            "aot_compiles_after_warm"
+        )
     # Continuous-batching decode headline (ISSUE 11): tokens/s and
     # p99-per-token off the fleet's own scrape, the A/B speedup over
     # whole-request decode, and the zero-5xx-across-hot-swap count.
@@ -3865,6 +4177,13 @@ def main() -> None:
     # Serving fleet (ISSUE 10): multi-replica + SLO batching + reload-
     # under-load hammer, judged from the fleet's own scrape.
     leg("serving_fleet", bench_serving_fleet, est_cost_s=150, retries=1)
+    # Quantized + AOT serving payloads (ISSUE 14): Rewriter variants,
+    # quality gate, Pusher variant deploy, int8-vs-float hammer A/B and
+    # the compiles-after-warm == 0 contract, off the fleet's own scrape.
+    leg(
+        "serving_quantized", bench_serving_quantized,
+        est_cost_s=120, retries=1,
+    )
     # Continuous-batching decode (ISSUE 11): generative fleet vs
     # whole-request A/B on identical mixed-length traffic + zero-5xx
     # hot-swap with generations in flight, off the fleet's own scrape.

@@ -1484,3 +1484,133 @@ def test_gated_unknown_level_gates_nothing(tmp_path):
     assert gated(findings, "everything") == []
     assert len(gated(findings, "warn")) == len(findings)
     assert all(f.severity == "error" for f in gated(findings, "error"))
+
+
+# ------------------------------------------------------------- TPP112
+
+
+def _rewriter_like(model_src, name="Rewrite"):
+    """A rewriter-shaped node: Model in through the canonical 'model'
+    key, (optimized) Model out — the TPP112 trigger shape."""
+
+    @component(inputs={"model": "Model"}, outputs={"model": "Model"},
+               name=name)
+    def Rewrite(ctx):
+        pass
+
+    return Rewrite(model=model_src.outputs["model"])
+
+
+def test_tpp112_pusher_bypasses_rewriter(tmp_path):
+    @component(outputs={"model": "Model"}, name="Train")
+    def Train(ctx):
+        pass
+
+    train = Train()
+    rewrite = _rewriter_like(train)
+    push = _pusher_like(train)  # wired to the RAW model: bypass
+    findings = analyze_ir(
+        Compiler().compile(_pipeline([train, rewrite, push], tmp_path))
+    )
+    f112 = [f for f in findings if f.rule == "TPP112"]
+    assert len(f112) == 1
+    (f,) = f112
+    assert f.node_id == "Push" and f.severity == "warn"
+    assert "Rewrite" in f.message and "bypassed" in f.message
+    assert "rewriter.outputs['model']" in f.fix
+
+    # Suppression drops it (pushing the raw model may be intentional).
+    push.with_lint_suppressions("TPP112")
+    findings = analyze_ir(
+        Compiler().compile(_pipeline([train, rewrite, push], tmp_path))
+    )
+    assert [f for f in findings if f.rule == "TPP112"] == []
+
+
+def test_tpp112_pusher_wired_to_rewriter_is_clean(tmp_path):
+    @component(outputs={"model": "Model"}, name="Train")
+    def Train(ctx):
+        pass
+
+    train = Train()
+    rewrite = _rewriter_like(train)
+    push = _pusher_like(rewrite)
+    findings = analyze_ir(
+        Compiler().compile(_pipeline([train, rewrite, push], tmp_path))
+    )
+    assert [f for f in findings if f.rule == "TPP112"] == []
+
+
+def test_tpp112_warm_start_trainer_is_not_a_rewriter(tmp_path):
+    """A warm-start Trainer (baseline Model in via 'base_model', new
+    Model out) must not arm the rule: it produces a NEW model, so a
+    Pusher on its output bypasses nothing."""
+
+    @component(outputs={"model": "Model"}, name="Prev")
+    def Prev(ctx):
+        pass
+
+    @component(inputs={"base_model": "Model"},
+               outputs={"model": "Model"}, name="Train",
+               optional_inputs=("base_model",))
+    def Train(ctx):
+        pass
+
+    prev = Prev()
+    train = Train(base_model=prev.outputs["model"])
+    push = _pusher_like(train)
+    findings = analyze_ir(
+        Compiler().compile(_pipeline([prev, train, push], tmp_path))
+    )
+    assert [f for f in findings if f.rule == "TPP112"] == []
+
+
+def test_tpp112_cli_fail_on_warn(tmp_path):
+    module = tmp_path / "bypass_pipeline.py"
+    module.write_text(textwrap.dedent("""
+        import os
+        from tpu_pipelines.dsl.component import component
+        from tpu_pipelines.dsl.pipeline import Pipeline
+
+        @component(outputs={"model": "Model"}, name="Train")
+        def Train(ctx):
+            pass
+
+        @component(inputs={"model": "Model"}, outputs={"model": "Model"},
+                   name="Rewrite")
+        def Rewrite(ctx):
+            pass
+
+        @component(inputs={"model": "Model"},
+                   outputs={"pushed_model": "PushedModel"},
+                   name="Push", is_sink=True)
+        def Push(ctx):
+            pass
+
+        def create_pipeline():
+            home = os.environ.get("TPP_PIPELINE_HOME", "/tmp/x")
+            train = Train()
+            rewrite = Rewrite(model=train.outputs["model"])
+            return Pipeline(
+                "bypass-fixture",
+                [train, rewrite, Push(model=train.outputs["model"])],
+                pipeline_root=os.path.join(home, "root"),
+                metadata_path=os.path.join(home, "md.sqlite"),
+            )
+    """))
+    env = {**os.environ, "PYTHONPATH": REPO,
+           "TPP_PIPELINE_HOME": str(tmp_path)}
+    warn_only = subprocess.run(
+        [sys.executable, "-m", "tpu_pipelines", "lint",
+         "--pipeline-module", str(module), "--json"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert warn_only.returncode == 0, warn_only.stdout + warn_only.stderr
+    assert "TPP112" in json.loads(warn_only.stdout)["rules"]
+    gated_run = subprocess.run(
+        [sys.executable, "-m", "tpu_pipelines", "lint",
+         "--pipeline-module", str(module), "--fail-on", "warn", "--json"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert gated_run.returncode == 3, gated_run.stdout + gated_run.stderr
+    assert "TPP112" in json.loads(gated_run.stdout)["rules"]

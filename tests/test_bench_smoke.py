@@ -203,6 +203,35 @@ def test_bench_smoke_emits_compact_stdout_and_full_report():
     assert dr["drill_5xx"] == 0
     assert compact["trace_overhead_pct"] == fl["trace_overhead_pct"]
     assert compact["slo_rollback_green"] is True
+    # Quantized + AOT serving leg (ISSUE 14): the Rewriter's int8
+    # variant passes the Evaluator-surface quality gate, deploys through
+    # the Pusher's variant selection + push-URL hook, serves the
+    # identical hammer at lower mean latency than float, and the
+    # post-swap scrape proves the AOT contract — executables
+    # deserialized from the export-time cache (no swap compiles) and
+    # zero compiles after warm.
+    sq = report["serving_quantized"]
+    assert sq["green"] is True, sq
+    assert sq["quantized_speedup"] > 1.0
+    assert sq["quantized_quality_delta"] <= sq["quality_tolerance"]
+    assert sq["aot_compiles_after_warm"] == 0
+    assert sq["aot_cache_hits"] >= 1
+    assert sq["request_errors"] == 0
+    assert sq["reload_notified"] is True
+    assert sq["selected_variant"] == "aqt_int8"
+    assert sq["swap_warmup_seconds"] is not None
+    assert sq["memory_bytes"]["aqt_int8"] < sq["memory_bytes"]["float32"] // 3
+    variants = sq["variants"]
+    assert set(variants) == {"float32", "bfloat16", "aqt_int8"}
+    for name in ("bfloat16", "aqt_int8"):
+        assert variants[name]["blessed"] is True, variants[name]
+        assert variants[name]["latency_ms"] > 0
+    assert compact["quantized_green"] is True
+    assert compact["quantized_speedup"] == sq["quantized_speedup"]
+    assert compact["quantized_quality_delta"] == sq[
+        "quantized_quality_delta"
+    ]
+    assert compact["aot_compiles_after_warm"] == 0
     # Continuous-batching decode leg (ISSUE 11): the generative fleet
     # beats whole-request decode >= 2x on identical mixed-length traffic
     # at equal-or-better client p99-per-token, with zero 5xx across a

@@ -19,10 +19,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DOCS_DIR = os.path.join(REPO, "docs", "components")
 
 EXPECTED_DOCS = {
-    # The 14 node types (SURVEY.md §2a + Resolver/Importer/Cond).
+    # The 15 node types (SURVEY.md §2a + Rewriter/Resolver/Importer/Cond).
     "example_gen", "statistics_gen", "schema_gen", "example_validator",
-    "transform", "trainer", "tuner", "evaluator", "infra_validator",
-    "pusher", "bulk_inferrer", "resolver", "importer", "cond",
+    "transform", "trainer", "tuner", "evaluator", "rewriter",
+    "infra_validator", "pusher", "bulk_inferrer", "resolver", "importer",
+    "cond",
 }
 
 _FENCE = re.compile(r"```python\n(.*?)```", re.DOTALL)

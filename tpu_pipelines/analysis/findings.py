@@ -93,6 +93,13 @@ RULES: Dict[str, Dict[str, str]] = {
                  "execution_timeout_s and no retry policy: an unbounded "
                  "incremental run wedges the always-on loop",
     },
+    "TPP112": {
+        "severity": WARN,
+        "title": "Pusher consumes a Model directly while a Rewriter node "
+                 "exists in the same pipeline: the optimized (quantized/"
+                 "AOT-warmed) variant is bypassed and the float payload "
+                 "ships",
+    },
     # ---- TPP2xx: executor/AST code rules (code_rules.py) ----
     "TPP201": {
         "severity": WARN,

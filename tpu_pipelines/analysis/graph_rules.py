@@ -446,6 +446,63 @@ def check_unbounded_continuous_nodes(ir: PipelineIR) -> List[Finding]:
     return out
 
 
+def check_pusher_bypasses_rewriter(ir: PipelineIR) -> List[Finding]:
+    """TPP112: a push-to-serving node (outputs a ``PushedModel``) whose
+    Model input comes straight from a non-Rewriter producer while a
+    Rewriter-shaped node (Model in -> Model out) exists in the same
+    pipeline.  The Rewriter's whole value — quantized variants, the
+    quality gate, AOT-warmed executables — rides on its OUTPUT being
+    what ships; wiring the Pusher to the Trainer's raw model next to a
+    Rewriter almost always means the float payload reaches serving and
+    the optimized one computes into the void."""
+    producers = {n.id: n for n in ir.nodes}
+    # Rewriter-shaped: a Model flows in through the canonical "model"
+    # input key AND a Model flows out.  The key matters: a warm-start
+    # Trainer consumes its baseline via "base_model" and must not count
+    # (it produces a NEW model; nothing is bypassed by pushing it).
+    rewriter_ids = sorted(
+        n.id for n in ir.nodes
+        if "Model" in n.outputs.values() and any(
+            producers.get(ref.producer) is not None
+            and producers[ref.producer].outputs.get(ref.output_key)
+            == "Model"
+            for ref in n.inputs.get("model", ())
+        )
+    )
+    if not rewriter_ids:
+        return []
+    out = []
+    for node in ir.nodes:
+        if "PushedModel" not in node.outputs.values():
+            continue
+        for key, refs in node.inputs.items():
+            for ref in refs:
+                producer = producers.get(ref.producer)
+                if producer is None:
+                    continue
+                if producer.outputs.get(ref.output_key) != "Model":
+                    continue
+                if ref.producer in rewriter_ids:
+                    continue
+                out.append(Finding(
+                    rule="TPP112", severity=WARN, node_id=node.id,
+                    message=(
+                        f"input {key!r} consumes the Model from "
+                        f"{ref.producer!r} directly while rewriter "
+                        f"node(s) {rewriter_ids} exist in this pipeline "
+                        "— the optimized variant is bypassed and the "
+                        "unoptimized payload is what ships"
+                    ),
+                    fix=(
+                        "wire the pusher to the rewriter's output "
+                        "(model=rewriter.outputs['model'], optionally "
+                        "variant='aqt_int8'), or suppress if pushing "
+                        "the raw model is intentional"
+                    ),
+                ))
+    return out
+
+
 def _walk_dicts(obj, prefix=""):
     """Yield (path, dict) over every mapping in a nested exec-property
     tree (the dict itself first, then its children)."""
@@ -486,4 +543,5 @@ GRAPH_RULES = (
     check_pusher_without_infra_validator,
     check_slo_without_monitor,
     check_unbounded_continuous_nodes,
+    check_pusher_bypasses_rewriter,
 )

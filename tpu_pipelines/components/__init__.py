@@ -1,7 +1,8 @@
 """Built-in pipeline components — the capability surface of SURVEY.md §2a.
 
 ExampleGen → StatisticsGen → SchemaGen → ExampleValidator → Transform →
-Trainer (+Tuner) → Evaluator → InfraValidator → Pusher, plus BulkInferrer.
+Trainer (+Tuner) → Evaluator → Rewriter → InfraValidator → Pusher, plus
+BulkInferrer.
 """
 
 from tpu_pipelines.components.example_gen import (  # noqa: F401
@@ -15,6 +16,7 @@ from tpu_pipelines.components.transform import Transform  # noqa: F401
 from tpu_pipelines.components.trainer import Trainer  # noqa: F401
 from tpu_pipelines.components.tuner import Tuner  # noqa: F401
 from tpu_pipelines.components.evaluator import Evaluator  # noqa: F401
+from tpu_pipelines.components.rewriter import Rewriter  # noqa: F401
 from tpu_pipelines.components.pusher import Pusher  # noqa: F401
 from tpu_pipelines.components.bulk_inferrer import BulkInferrer  # noqa: F401
 from tpu_pipelines.components.infra_validator import InfraValidator  # noqa: F401

@@ -29,7 +29,47 @@ BLESSING_FILE = "BLESSED"
 NOT_BLESSED_FILE = "NOT_BLESSED"
 
 
-def _evaluate(model_uri: str, examples_uri: str, props: Dict) -> EvalOutcome:
+def metric_deltas(
+    base: Dict[str, float],
+    other: Dict[str, float],
+    keys=None,
+) -> Dict[str, float]:
+    """Relative |delta| per shared metric — THE quality-diff surface.
+
+    The Rewriter's per-variant quality gate and any baseline-vs-candidate
+    comparison share this one definition: ``|other - base| / max(|base|,
+    1e-6)`` for every metric present in both (or just ``keys``), so
+    "within quality_tolerance of the float model" means the same thing
+    everywhere it is enforced.
+    """
+    out: Dict[str, float] = {}
+    for k in keys if keys is not None else sorted(set(base) & set(other)):
+        b, o = base.get(k), other.get(k)
+        if b is None or o is None:
+            continue
+        out[k] = abs(float(o) - float(b)) / max(abs(float(b)), 1e-6)
+    return out
+
+
+def max_metric_delta(deltas: Dict[str, float]) -> float:
+    return max(deltas.values()) if deltas else 0.0
+
+
+def _capped_batches(batches, max_examples: int):
+    rows = 0
+    for batch in batches:
+        yield batch
+        rows += len(next(iter(batch.values())))
+        if rows >= max_examples:
+            return
+
+
+def evaluate_payload(
+    model_uri: str, examples_uri: str, props: Dict
+) -> EvalOutcome:
+    """Evaluate one exported payload on an eval split — the Evaluator's
+    metric surface, reusable (the Rewriter re-runs it per variant).
+    ``props["max_eval_examples"]`` (0/absent = all) caps the slice."""
     loaded = load_exported_model(model_uri)
     # Column projection: the model's transformed-feature surface plus the
     # label and slice columns — Parquet never decodes the rest.  None (no
@@ -50,6 +90,9 @@ def _evaluate(model_uri: str, examples_uri: str, props: Dict) -> EvalOutcome:
         ),
         columns=columns,
     )
+    cap = int(props.get("max_eval_examples") or 0)
+    if cap > 0:
+        batches = _capped_batches(batches, cap)
     return evaluate_model(
         # Eval data is transformed examples; the payload's transform was
         # already applied at materialization, so use the direct forward pass.
@@ -63,6 +106,11 @@ def _evaluate(model_uri: str, examples_uri: str, props: Dict) -> EvalOutcome:
             "auc_exact_max_examples", AUC_EXACT_MAX_EXAMPLES
         ),
     )
+
+
+# Internal name the Evaluator executor predates; evaluate_payload is the
+# public, Rewriter-shared surface.
+_evaluate = evaluate_payload
 
 
 @component(

@@ -78,6 +78,13 @@ def notify_serving(push_url: str, timeout: float = 120.0) -> dict:
         "push_destination": Parameter(type=str, required=True),
         # Live-fleet reload hook: "" = env TPP_SERVING_PUSH_URL, else off.
         "serving_push_url": Parameter(type=str, default=""),
+        # Rewriter variant selection: "" pushes the model payload root
+        # (a Rewriter artifact's root IS its selected variant); a
+        # variant name ("aqt_int8" / "bfloat16" / "float32", aliases ok)
+        # pushes that payload from the artifact's variants/ tree — and
+        # honors the Rewriter's quality gate: an unblessed variant is a
+        # skipped push, never a served model.
+        "variant": Parameter(type=str, default=""),
     },
 )
 def Pusher(ctx):
@@ -92,6 +99,31 @@ def Pusher(ctx):
             pushed_art.properties["skip_reason"] = f"{key} = NOT_BLESSED"
             return {"pushed": False, "skip_reason": f"{key} = NOT_BLESSED"}
 
+    model_uri = ctx.input("model").uri
+    variant = str(ctx.exec_properties.get("variant") or "").strip()
+    if variant:
+        from tpu_pipelines.components.rewriter import (
+            canonical_variant,
+            variant_blessed,
+            variant_dirs,
+        )
+
+        variant = canonical_variant(variant)
+        dirs = variant_dirs(model_uri)
+        if variant not in dirs:
+            raise ValueError(
+                f"Pusher: variant {variant!r} not found under "
+                f"{model_uri!r} (have {sorted(dirs) or 'no variants/'}); "
+                "wire the Pusher to a Rewriter output"
+            )
+        if not variant_blessed(dirs[variant]):
+            skip = f"variant {variant} = NOT_BLESSED"
+            pushed_art.properties["pushed"] = False
+            pushed_art.properties["skip_reason"] = skip
+            return {"pushed": False, "skip_reason": skip}
+        model_uri = dirs[variant]
+        pushed_art.properties["variant"] = variant
+
     dest = ctx.exec_properties["push_destination"]
     os.makedirs(dest, exist_ok=True)
     existing = [int(d) for d in os.listdir(dest) if d.isdigit()]
@@ -100,7 +132,7 @@ def Pusher(ctx):
     staging = os.path.join(dest, f".staging-{version}")
     if os.path.exists(staging):
         shutil.rmtree(staging)
-    shutil.copytree(ctx.input("model").uri, staging)
+    shutil.copytree(model_uri, staging)
     final = os.path.join(dest, str(version))
     os.rename(staging, final)  # atomic within a filesystem
 

@@ -1,0 +1,475 @@
+"""Rewriter: quantized serving variants with a self-applied quality gate.
+
+Capability match for the reference architecture's Rewriter / TFLite-
+converter stage (the ModelOptimizer seam between Trainer and Pusher):
+consumes a trained Model payload and emits optimized serving variants of
+it —
+
+  ``float32``   the original payload, hardlinked (the reference and the
+                always-safe fallback)
+  ``bfloat16``  every float leaf cast to bf16 (half the resident bytes;
+                the loader casts once at load, never per request)
+  ``aqt_int8``  AQT-style symmetric int8 weight quantization
+                (trainer/quantize.py): large weight tensors stored as
+                int8 qvalues + per-channel scales, dequantized INSIDE the
+                jitted step so gathers/matmuls read a quarter of the
+                weight bytes
+
+each a fully self-contained payload under ``<uri>/variants/<name>/``,
+with the SELECTED variant's payload hardlinked at the artifact root so
+every existing Model consumer (Pusher, InfraValidator, serving fleet,
+BulkInferrer) loads the optimized model with zero wiring changes.
+
+**Gate 1 — quality (here).**  With an eval ``examples`` input wired, the
+component re-runs the Evaluator metric surface
+(``evaluator.evaluate_payload``) on an eval slice for the float payload
+and every variant; a variant whose worst relative metric delta exceeds
+``quality_tolerance`` is marked NOT_BLESSED — recorded in the variant's
+``model_spec.json`` (``rewriter.blessed = false``) plus a
+``REWRITE_NOT_BLESSED`` marker — and is never selected or pushed.
+Without eval examples the gate fails closed: only ``float32`` is
+blessed.
+
+**Gate 2 — canary (fleet).**  The serving fleet's hot-swap gate refuses
+any payload whose spec carries ``rewriter.blessed = false``
+(HTTP 409 / CanaryRefused), so an unblessed variant cannot reach
+traffic even if pushed by hand — the double-gated deploy.
+
+Per-variant measured device-step latency, resident params bytes, and
+quality deltas are recorded on the execution (and on the output
+artifact), so ``selection="auto"`` picks the fastest *blessed* variant
+on this host's measured numbers, not on dtype folklore.  With
+``aot_warm_buckets > 0`` the selected payload's padded bucket shapes are
+AOT-compiled into the serialized-executable cache at export time
+(serving/aot.py), so the fleet's canary later deserializes instead of
+compiling.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from tpu_pipelines.dsl.component import Parameter, component
+
+log = logging.getLogger("tpu_pipelines.components.rewriter")
+
+VARIANTS_DIR = "variants"
+REPORT_FILE = "rewrite_report.json"
+NOT_BLESSED_MARKER = "REWRITE_NOT_BLESSED"
+
+# Canonical variant names = payload dtype strings; common aliases accepted
+# at the parameter surface.
+_ALIASES = {
+    "bf16": "bfloat16",
+    "int8": "aqt_int8",
+    "f32": "float32",
+    "fp32": "float32",
+}
+KNOWN_VARIANTS = ("float32", "bfloat16", "aqt_int8")
+
+# Spec keys export_model owns; everything else in the source spec is
+# carried over onto each variant payload verbatim.
+_SPEC_OWNED = (
+    "format", "hyperparameters", "has_transform", "dtype", "params_bytes",
+)
+
+
+def canonical_variant(name: str) -> str:
+    name = str(name).strip().lower()
+    name = _ALIASES.get(name, name)
+    if name not in KNOWN_VARIANTS:
+        raise ValueError(
+            f"unknown rewriter variant {name!r}; known: "
+            f"{list(KNOWN_VARIANTS)} (aliases: {sorted(_ALIASES)})"
+        )
+    return name
+
+
+def _copy_payload(src: str, dst: str) -> None:
+    """Hardlink-copy the payload files of ``src`` into ``dst`` (falls back
+    to byte copies across filesystems).  Only payload entries move — a
+    Rewriter artifact root never recursively swallows its own
+    ``variants/`` tree."""
+    from tpu_pipelines.trainer.export import (
+        CHECKPOINT_DIR, MODULE_COPY, SPEC_FILE, TRANSFORM_DIR,
+    )
+
+    os.makedirs(dst, exist_ok=True)
+    for entry in (SPEC_FILE, MODULE_COPY, CHECKPOINT_DIR, TRANSFORM_DIR,
+                  NOT_BLESSED_MARKER):
+        s = os.path.join(src, entry)
+        d = os.path.join(dst, entry)
+        if not os.path.exists(s):
+            continue
+        if os.path.isdir(d):
+            shutil.rmtree(d)
+        elif os.path.exists(d):
+            os.unlink(d)
+        if os.path.isdir(s):
+            shutil.copytree(s, d, copy_function=_link_or_copy)
+        else:
+            _link_or_copy(s, d)
+
+
+def _link_or_copy(src: str, dst: str) -> None:
+    try:
+        os.link(src, dst)
+    except OSError:
+        shutil.copy2(src, dst)
+
+
+def _annotate_spec(payload_dir: str, verdict: Dict[str, Any]) -> None:
+    """Record the rewrite verdict in the payload's own spec — what the
+    fleet's canary gate reads (gate 2), travelling WITH the payload
+    through any Pusher copy."""
+    from tpu_pipelines.trainer.export import SPEC_FILE
+
+    path = os.path.join(payload_dir, SPEC_FILE)
+    with open(path) as f:
+        spec = json.load(f)
+    spec["rewriter"] = verdict
+    with open(path, "w") as f:
+        json.dump(spec, f, indent=2, sort_keys=True, default=str)
+    marker = os.path.join(payload_dir, NOT_BLESSED_MARKER)
+    if verdict.get("blessed") is False:
+        with open(marker, "w") as f:
+            json.dump({"reason": verdict.get("reason", "")}, f)
+    elif os.path.exists(marker):
+        os.unlink(marker)
+
+
+def variant_blessed(payload_dir: str) -> bool:
+    """False only when the payload carries an explicit refused verdict
+    (plain payloads without a rewriter block are not gated here)."""
+    from tpu_pipelines.trainer.export import SPEC_FILE
+
+    if os.path.exists(os.path.join(payload_dir, NOT_BLESSED_MARKER)):
+        return False
+    try:
+        with open(os.path.join(payload_dir, SPEC_FILE)) as f:
+            spec = json.load(f)
+    except (OSError, ValueError):
+        return True
+    rewrite = spec.get("rewriter")
+    return not (isinstance(rewrite, dict) and rewrite.get("blessed") is False)
+
+
+def _measure_latency_ms(
+    predict, batch: Dict[str, np.ndarray], iters: int
+) -> float:
+    """Mean wall of one device step at the measurement batch (host fetch
+    included — that is what a serving request pays)."""
+    np.asarray(predict(batch))  # compile
+    np.asarray(predict(batch))  # and once warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = predict(batch)
+    np.asarray(out)
+    return (time.perf_counter() - t0) / max(1, iters) * 1e3
+
+
+def _emit_variant(
+    name: str,
+    model_uri: str,
+    vdir: str,
+    spec: Dict[str, Any],
+    min_quant_size: int,
+) -> Dict[str, Any]:
+    """Write one variant payload; returns JSON-native emission info."""
+    import jax.numpy as jnp
+
+    from tpu_pipelines.trainer import quantize as qz
+    from tpu_pipelines.trainer.export import (
+        MODULE_COPY, TRANSFORM_DIR, export_model, restore_exported_params,
+    )
+
+    if name == "float32":
+        _copy_payload(model_uri, vdir)
+        return {}
+    params = restore_exported_params(model_uri)
+    quant_report: Dict[str, Any] = {}
+    if name == "bfloat16":
+        params = qz.cast_params(params, jnp.bfloat16)
+    else:  # aqt_int8
+        params, quant_report = qz.quantize_params(
+            params, min_size=min_quant_size
+        )
+    extra = {
+        k: v for k, v in spec.items() if k not in _SPEC_OWNED
+    }
+    export_model(
+        serving_model_dir=vdir,
+        params=params,
+        module_file=os.path.join(model_uri, MODULE_COPY),
+        hyperparameters=spec.get("hyperparameters") or {},
+        transform_graph_uri=(
+            os.path.join(model_uri, TRANSFORM_DIR)
+            if spec.get("has_transform") else ""
+        ),
+        extra_spec=extra,
+        serving_dtype=name,
+    )
+    return quant_report
+
+
+@component(
+    inputs={
+        "model": "Model",
+        "examples": "Examples",
+        "transform_graph": "TransformGraph",
+    },
+    optional_inputs=("examples", "transform_graph"),
+    outputs={"model": "Model"},
+    parameters={
+        # Variants to emit beyond the always-present float32 reference.
+        "variants": Parameter(type=list, default=["bfloat16", "aqt_int8"]),
+        # Gate 1: worst relative metric delta a variant may show vs the
+        # float payload on the eval slice (evaluator.metric_deltas).
+        "quality_tolerance": Parameter(type=float, default=0.02),
+        # None = every metric the problem's surface emits; or a list of
+        # metric names to gate on (e.g. ["accuracy", "auc"]).
+        "quality_metrics": Parameter(type=list, default=None),
+        # Evaluator-surface knobs (required when `examples` is wired).
+        "label_key": Parameter(type=str, default=""),
+        "problem": Parameter(type=str, default="binary_classification"),
+        "eval_split": Parameter(type=str, default="eval"),
+        "batch_size": Parameter(type=int, default=512),
+        # Eval-slice cap: the gate needs a stable metric estimate, not a
+        # full eval pass (0 = whole split).
+        "max_eval_examples": Parameter(type=int, default=4096),
+        # "auto" = fastest blessed variant by measured latency; or pin a
+        # canonical/alias variant name.
+        "selection": Parameter(type=str, default="auto"),
+        "min_quant_size": Parameter(type=int, default=4096),
+        "latency_batch_size": Parameter(type=int, default=8),
+        "latency_iters": Parameter(type=int, default=20),
+        # > 0: AOT-compile the selected payload's padded buckets up to
+        # this max batch size into the serialized-executable cache NOW,
+        # so the fleet's canary deserializes instead of compiling.
+        "aot_warm_buckets": Parameter(type=int, default=0),
+    },
+    resource_class="tpu",
+)
+def Rewriter(ctx):
+    from tpu_pipelines.components.evaluator import (
+        evaluate_payload,
+        max_metric_delta,
+        metric_deltas,
+    )
+    from tpu_pipelines.data.input_pipeline import BatchIterator, InputConfig
+    from tpu_pipelines.trainer.export import load_exported_model
+
+    props = ctx.exec_properties
+    model_uri = ctx.input("model").uri
+    out_art = ctx.output("model")
+    os.makedirs(out_art.uri, exist_ok=True)
+    tolerance = float(props["quality_tolerance"])
+    names = ["float32"]
+    for v in props["variants"] or []:
+        v = canonical_variant(v)
+        if v not in names:
+            names.append(v)
+    selection = str(props["selection"] or "auto").strip().lower()
+    if selection != "auto":
+        selection = canonical_variant(selection)
+        if selection not in names:
+            raise ValueError(
+                f"selection={selection!r} is not among emitted variants "
+                f"{names}"
+            )
+
+    examples = ctx.inputs.get("examples")
+    examples_uri = examples[0].uri if examples else ""
+    if examples_uri and not props["label_key"]:
+        raise ValueError(
+            "Rewriter: label_key is required when examples are wired "
+            "(the quality gate runs the Evaluator metric surface)"
+        )
+    eval_props = {
+        "label_key": props["label_key"],
+        "problem": props["problem"],
+        "eval_split": props["eval_split"],
+        "batch_size": props["batch_size"],
+        "slice_columns": (),
+        "max_eval_examples": props["max_eval_examples"],
+    }
+
+    with open(os.path.join(
+        model_uri, "model_spec.json"
+    )) as f:
+        src_spec = json.load(f)
+
+    # Latency/warmup batch: one eval batch MINUS the label column — the
+    # serving request surface.  Keeping the label out matters beyond
+    # hygiene: the AOT executable table keys on the exact batch
+    # signature, so prewarming with an extra column would compile
+    # programs no serving request can ever dispatch.
+    latency_batch = None
+    if examples_uri:
+        it = BatchIterator(
+            examples_uri, props["eval_split"],
+            InputConfig(
+                batch_size=int(props["latency_batch_size"]),
+                shuffle=False, num_epochs=1, drop_remainder=False,
+            ),
+        )
+        first = next(iter(it), None)
+        if first is not None:
+            latency_batch = {
+                k: v for k, v in first.items() if k != props["label_key"]
+            }
+
+    base_metrics: Optional[Dict[str, float]] = None
+    if examples_uri:
+        base_metrics = evaluate_payload(
+            model_uri, examples_uri, eval_props
+        ).overall().metrics
+
+    variants: Dict[str, Dict[str, Any]] = {}
+    quality_keys = props["quality_metrics"]
+    for name in names:
+        vdir = os.path.join(out_art.uri, VARIANTS_DIR, name)
+        quant_report = _emit_variant(
+            name, model_uri, vdir, src_spec, int(props["min_quant_size"])
+        )
+        loaded = load_exported_model(vdir)
+        info: Dict[str, Any] = {
+            "dtype": loaded.dtype,
+            "params_bytes": int(loaded.params_bytes),
+        }
+        if quant_report:
+            info["num_quantized_leaves"] = quant_report.get(
+                "num_quantized", 0
+            )
+        if latency_batch is not None:
+            info["latency_ms"] = round(_measure_latency_ms(
+                loaded.predict_transformed, latency_batch,
+                int(props["latency_iters"]),
+            ), 4)
+        if name == "float32":
+            blessed, reason, deltas = True, "", {}
+            info["metrics"] = base_metrics
+        elif base_metrics is None:
+            blessed = False
+            reason = (
+                "no eval examples wired: the quality gate fails closed"
+            )
+            deltas = {}
+        else:
+            outcome = evaluate_payload(vdir, examples_uri, eval_props)
+            metrics = outcome.overall().metrics
+            deltas = metric_deltas(base_metrics, metrics, quality_keys)
+            worst = max_metric_delta(deltas)
+            blessed = worst <= tolerance
+            reason = (
+                "" if blessed else
+                f"max metric delta {worst:.4f} > quality_tolerance "
+                f"{tolerance}"
+            )
+            info["metrics"] = metrics
+        info.update({
+            "blessed": blessed,
+            "quality_deltas": {
+                k: round(v, 6) for k, v in sorted(deltas.items())
+            },
+            "max_quality_delta": round(max_metric_delta(deltas), 6),
+        })
+        if reason:
+            info["reason"] = reason
+        _annotate_spec(vdir, {
+            "variant": name,
+            "blessed": blessed,
+            "reason": reason,
+            "quality_deltas": info["quality_deltas"],
+            "max_quality_delta": info["max_quality_delta"],
+            "quality_tolerance": tolerance,
+            "base_model_uri": model_uri,
+        })
+        variants[name] = info
+        if not blessed:
+            log.warning(
+                "rewriter: variant %s NOT_BLESSED (%s)", name, reason
+            )
+
+    if selection == "auto":
+        blessed_names = [n for n in names if variants[n]["blessed"]]
+        if all(
+            variants[n].get("latency_ms") is not None
+            for n in blessed_names
+        ):
+            selected = min(
+                blessed_names, key=lambda n: variants[n]["latency_ms"]
+            )
+        else:
+            selected = "float32"
+    else:
+        selected = selection
+        if not variants[selected]["blessed"]:
+            raise ValueError(
+                f"selection={selected!r} failed the quality gate: "
+                f"{variants[selected].get('reason', '')}"
+            )
+    _copy_payload(
+        os.path.join(out_art.uri, VARIANTS_DIR, selected), out_art.uri
+    )
+
+    speedup = None
+    if (
+        variants[selected].get("latency_ms")
+        and variants["float32"].get("latency_ms")
+    ):
+        speedup = round(
+            variants["float32"]["latency_ms"]
+            / variants[selected]["latency_ms"], 4,
+        )
+
+    aot_stats = None
+    if int(props["aot_warm_buckets"] or 0) > 0 and latency_batch is not None:
+        from tpu_pipelines.serving import aot
+
+        selected_loaded = load_exported_model(out_art.uri)
+        aot_stats = aot.warm_loaded(
+            selected_loaded, latency_batch,
+            int(props["aot_warm_buckets"]), raw=False,
+        )
+
+    report = {
+        "selected_variant": selected,
+        "quality_tolerance": tolerance,
+        "variants": variants,
+        "speedup_vs_float": speedup,
+        "base_model_uri": model_uri,
+    }
+    if aot_stats is not None:
+        report["aot_warm"] = aot_stats
+    with open(os.path.join(out_art.uri, REPORT_FILE), "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True, default=str)
+    out_art.properties.update({
+        "selected_variant": selected,
+        "dtype": variants[selected]["dtype"],
+        "params_bytes": variants[selected]["params_bytes"],
+        "blessed_variants": [
+            n for n in names if variants[n]["blessed"]
+        ],
+    })
+    return report
+
+
+def variant_dirs(model_uri: str) -> Dict[str, str]:
+    """Variant-name -> payload-dir map of a Rewriter output artifact
+    (empty for plain Model payloads)."""
+    root = os.path.join(model_uri, VARIANTS_DIR)
+    if not os.path.isdir(root):
+        return {}
+    return {
+        name: os.path.join(root, name)
+        for name in sorted(os.listdir(root))
+        if os.path.isdir(os.path.join(root, name))
+    }

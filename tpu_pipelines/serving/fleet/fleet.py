@@ -96,12 +96,37 @@ class ServingFleet:
         self._canary_lock = threading.Lock()
         self._rollback_lock = threading.Lock()
         self._m_rollbacks = None
+        self._m_warmup = self._m_aot_compiles = None
+        self._m_aot_hits = self._m_aot_after_warm = None
         if registry is not None:
             self._m_rollbacks = registry.counter(
                 "serving_auto_rollbacks_total",
                 "Automatic activations of the prior resident version "
                 "after an SLO burn-rate breach inside the post-swap "
                 "probation window.",
+            )
+            self._m_warmup = registry.gauge(
+                "serving_swap_warmup_seconds",
+                "Measured wall time of the last swap's bucket warmup "
+                "(AOT compile or cache-deserialize of every padded "
+                "bucket shape, off the request path).",
+            )
+            self._m_aot_compiles = registry.counter(
+                "serving_aot_compiles_total",
+                "Bucket executables compiled at swap gates (AOT cache "
+                "misses).",
+            )
+            self._m_aot_hits = registry.counter(
+                "serving_aot_cache_hits_total",
+                "Bucket executables deserialized from the AOT cache "
+                "instead of compiled.",
+            )
+            self._m_aot_after_warm = registry.counter(
+                "serving_aot_compiles_after_warm_total",
+                "Predict-path shapes that missed the AOT table after "
+                "warmup — each one paid an XLA trace mid-traffic "
+                "(budget: zero; the predict twin of "
+                "serving_decode_compiles_after_warm_total).",
             )
         self.versions = ModelVersionManager(
             model_name,
@@ -245,6 +270,20 @@ class ServingFleet:
     def _canary(self, loaded, version: str) -> str:
         from tpu_pipelines.components.infra_validator import canary_check
 
+        # Gate 2 of the Rewriter's double-gated deploy: a variant payload
+        # the quality gate refused at rewrite time carries
+        # spec["rewriter"]["blessed"] = false, and the fleet refuses to
+        # serve it no matter how it reached the version directory —
+        # CanaryRefused => HTTP 409 / gRPC FAILED_PRECONDITION, the prior
+        # version keeps serving.
+        spec = getattr(loaded, "spec", None)
+        rewrite = spec.get("rewriter") if isinstance(spec, dict) else None
+        if isinstance(rewrite, dict) and rewrite.get("blessed") is False:
+            return (
+                f"rewriter variant {rewrite.get('variant', '?')!r} is "
+                f"NOT_BLESSED (quality gate): "
+                f"{rewrite.get('reason', 'outside quality_tolerance')}"
+            )
         if self.generative:
             # Generative gate: the payload must carry the decode contract,
             # and every replica's engine compiles its full
@@ -267,23 +306,39 @@ class ServingFleet:
         return self._warm_buckets(loaded, batch)
 
     def _warm_buckets(self, loaded, batch: Dict[str, Any]) -> str:
-        """Pre-compile the padded bucket shapes the replica batchers will
-        pose, BEFORE the swap: without this, the new version's first
-        batches pay their XLA compiles mid-traffic and the latency spike
-        lands inside the SLO window.  Runs outside every serving lock
-        (part of load-outside-lock); a shape the version cannot answer is
-        a gate failure — it WOULD fail in production."""
-        from tpu_pipelines.serving.batching import bucket_sizes
+        """Ahead-of-time compile the padded bucket shapes the replica
+        batchers will pose, BEFORE the swap: one lowered computation per
+        bucket on the device step (serving/aot.py), loaded from the
+        serialized-executable cache when this payload was compiled by
+        any prior process — a warm hot-swap deserializes instead of
+        tracing, and post-swap batches never pay an XLA compile
+        mid-traffic (``serving_aot_compiles_after_warm_total`` audits
+        exactly that).  Runs outside every serving lock (part of
+        load-outside-lock); a shape the version cannot answer is a gate
+        failure — it WOULD fail in production.  Measured wall time lands
+        in ``serving_swap_warmup_seconds``."""
+        from tpu_pipelines.serving import aot
 
-        fn = self._predict_callable(loaded)
-        row = {k: np.asarray(v)[:1] for k, v in batch.items()}
+        t0 = time.monotonic()
         try:
-            for bucket in bucket_sizes(self._max_batch_size):
-                fn({
-                    k: np.repeat(v, bucket, axis=0) for k, v in row.items()
-                })
+            stats = aot.warm_loaded(
+                loaded, batch, self._max_batch_size, raw=self.raw
+            )
         except Exception as e:  # noqa: BLE001 — same verdict as the canary
             return f"bucket warmup failed: {type(e).__name__}: {e}"
+        if self._m_warmup is not None:
+            self._m_warmup.set(time.monotonic() - t0)
+            self._m_aot_compiles.inc(stats.get("compiled", 0))
+            self._m_aot_hits.inc(stats.get("cache_hits", 0))
+        dispatch = getattr(loaded, "aot", None)
+        if dispatch is not None and self._m_aot_after_warm is not None:
+            dispatch.on_compile_after_warm = self._m_aot_after_warm.inc
+        log.info(
+            "fleet: %s bucket warmup %.3fs (%d compiled, %d cache hits%s)",
+            self.model_name, stats.get("seconds", 0.0),
+            stats.get("compiled", 0), stats.get("cache_hits", 0),
+            ", legacy trace path" if stats.get("fallback_warm") else "",
+        )
         return ""
 
     # -------------------------------------------------- SLO auto-rollback

@@ -78,6 +78,7 @@ class ModelVersionManager:
         self._load_lock = threading.Lock()   # serializes load/swap sequences
         self._versions: Dict[str, Any] = {}  # insertion order = load order
         self._leases: Dict[str, int] = {}
+        self._dtypes: Dict[str, str] = {}    # for gauge zeroing at drop
         self._evict_pending: set = set()
         self._active: Optional[str] = None
         # SLO auto-rollback state (fleet.on_slo_breach): the last swap
@@ -89,6 +90,7 @@ class ModelVersionManager:
         self._quarantined: Dict[str, str] = {}
         self._m_swaps = self._m_evictions = self._m_canary = None
         self._m_resident = self._m_info = None
+        self._m_memory = self._m_dtype = None
         if registry is not None:
             self._m_swaps = registry.counter(
                 "serving_version_swaps_total",
@@ -111,6 +113,19 @@ class ModelVersionManager:
                 "1 for the currently served model version, 0 for prior "
                 "ones.",
                 labels=("model", "version"),
+            )
+            self._m_memory = registry.gauge(
+                "serving_version_memory_bytes",
+                "Resident parameter bytes per loaded model version "
+                "(payload spec params_bytes; quantized versions count "
+                "int8 + scale storage).  0 after eviction.",
+                labels=("model", "version"),
+            )
+            self._m_dtype = registry.gauge(
+                "serving_version_dtype",
+                "1 for each resident version at its serving dtype "
+                "(float32 | bfloat16 | aqt_int8); 0 after eviction.",
+                labels=("model", "version", "dtype"),
             )
 
     # ------------------------------------------------------------ queries
@@ -204,6 +219,14 @@ class ModelVersionManager:
                 self._activate(version)
                 return version
             loaded = self._loader(version_dir)       # slow: outside locks
+            if not getattr(loaded, "uri", ""):
+                # Stash the payload dir for consumers that key on it
+                # (the AOT executable cache); stubs without the attr
+                # slot simply stay uri-less (in-process AOT only).
+                try:
+                    loaded.uri = os.path.abspath(version_dir)
+                except Exception:  # noqa: BLE001
+                    pass
             if self._canary_fn is not None:
                 error = self._canary_fn(loaded, version)
                 if error:
@@ -213,10 +236,19 @@ class ModelVersionManager:
                         f"version {version!r} of {self.model_name!r} "
                         f"failed the canary check: {error}"
                     )
+            dtype = str(getattr(loaded, "dtype", "") or "float32")
             with self._lock:
                 self._versions[version] = loaded
                 self._leases.setdefault(version, 0)
                 self._evict_pending.discard(version)
+                self._dtypes[version] = dtype
+            if self._m_memory is not None:
+                self._m_memory.labels(self.model_name, version).set(
+                    int(getattr(loaded, "params_bytes", 0) or 0)
+                )
+                self._m_dtype.labels(
+                    self.model_name, version, dtype
+                ).set(1)
             self._activate(version)
             return version
 
@@ -279,6 +311,13 @@ class ModelVersionManager:
         self._versions.pop(version, None)
         self._leases.pop(version, None)
         self._evict_pending.discard(version)
+        dtype = self._dtypes.pop(version, None)
+        if self._m_memory is not None:
+            self._m_memory.labels(self.model_name, version).set(0)
+            if dtype:
+                self._m_dtype.labels(
+                    self.model_name, version, dtype
+                ).set(0)
         if self._m_evictions is not None:
             self._m_evictions.inc()
         log.info("fleet: %s evicted drained version %s",

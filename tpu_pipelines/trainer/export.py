@@ -22,11 +22,13 @@ import dataclasses
 import json
 import os
 import shutil
-from typing import Any, Callable, Dict, List, Optional
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
+from tpu_pipelines.trainer import quantize as qz
 from tpu_pipelines.transform.graph import TransformGraph
 from tpu_pipelines.utils.module_loader import load_fn, load_module
 
@@ -45,6 +47,7 @@ def export_model(
     hyperparameters: Optional[Dict[str, Any]] = None,
     transform_graph_uri: str = "",
     extra_spec: Optional[Dict[str, Any]] = None,
+    serving_dtype: Optional[str] = None,
 ) -> str:
     """Write a self-contained model payload; returns the dir.
 
@@ -79,11 +82,72 @@ def export_model(
             "format": FORMAT_VERSION,
             "hyperparameters": hyperparameters or {},
             "has_transform": bool(transform_graph_uri),
+            # Serving-payload metadata (ISSUE 14): the dtype the loader
+            # should serve at (bf16 payloads cast ONCE at load; aqt_int8
+            # payloads dequantize inside the jitted step) and the
+            # resident parameter bytes — what the fleet's
+            # serving_version_memory_bytes gauge reports per version.
+            "dtype": serving_dtype or qz.infer_dtype(params),
+            "params_bytes": qz.params_nbytes(params),
             **(extra_spec or {}),
         }
         with open(os.path.join(serving_model_dir, SPEC_FILE), "w") as f:
             json.dump(spec, f, indent=2, sort_keys=True, default=str)
     return serving_model_dir
+
+
+class AotDispatch:
+    """Shape-keyed table of ahead-of-time compiled serving executables.
+
+    ``serving/aot.py`` populates it at swap/canary time (one compiled —
+    or cache-deserialized — executable per padded bucket shape); the
+    loaded model's predict paths consult it before falling back to the
+    lazily-traced jit.  Empty table = zero-cost passthrough (one truthy
+    check per request), so payloads outside the fleet never pay for it.
+
+    A post-warm lookup MISS that falls back to jit is a broken warmup
+    contract — the request pays an XLA trace mid-traffic.  The first
+    miss per (endpoint, signature) increments ``compiles_after_warm``
+    (repeats hit the jit cache, so only the first is a compile) and
+    fires ``on_compile_after_warm`` — the fleet wires that to
+    ``serving_aot_compiles_after_warm_total`` (budget: zero), the
+    predict twin of the decode engine's counter.
+    """
+
+    def __init__(self):
+        self.entries: Dict[Tuple[str, tuple], Any] = {}
+        self.fallbacks = 0
+        self.compiles_after_warm = 0
+        self.on_compile_after_warm: Optional[Callable[[], None]] = None
+        self._fallback_sigs: set = set()
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def signature(batch: Dict[str, Any]) -> tuple:
+        return tuple(sorted(
+            (k, tuple(np.shape(v)), str(np.asarray(v).dtype))
+            for k, v in batch.items()
+        ))
+
+    def lookup(self, endpoint: str, batch: Dict[str, Any]):
+        return self.entries.get((endpoint, self.signature(batch)))
+
+    def install(self, endpoint: str, sig: tuple, executable: Any) -> None:
+        with self._lock:
+            self.entries[(endpoint, sig)] = executable
+
+    def record_fallback(self, endpoint: str, batch: Dict[str, Any]) -> None:
+        sig = (endpoint, self.signature(batch))
+        fresh = False
+        with self._lock:
+            self.fallbacks += 1
+            if sig not in self._fallback_sigs:
+                self._fallback_sigs.add(sig)
+                self.compiles_after_warm += 1
+                fresh = True
+            cb = self.on_compile_after_warm
+        if fresh and cb is not None:
+            cb()
 
 
 @dataclasses.dataclass
@@ -121,6 +185,19 @@ class LoadedModel:
     # remote-compile platforms).  Tested by test_export_no_weight_constants.
     forward_step: Callable[[Any, Dict[str, Any]], Any] = None
     device_step: Callable[[Any, Dict[str, Any]], Any] = None
+    # Serving-payload metadata recorded at export (spec["dtype"] /
+    # spec["params_bytes"]): the dtype this payload serves at
+    # ("float32" | "bfloat16" | "aqt_int8") and its resident parameter
+    # bytes (quantized payloads count int8 + scale storage).  The fleet
+    # publishes both per resident version.
+    dtype: str = "float32"
+    params_bytes: int = 0
+    # Payload directory this model was loaded from ("" for hand-built
+    # instances) — the AOT executable cache keys on its content hash.
+    uri: str = ""
+    # Ahead-of-time executable table (serving/aot.py warms it at the
+    # fleet's canary gate; empty = lazy jit, the pre-ISSUE-14 behavior).
+    aot: Optional[AotDispatch] = None
 
 
 def model_input_columns(
@@ -293,6 +370,23 @@ def load_exported_model(uri: str) -> LoadedModel:
     )
 
     params = restore_exported_params(uri)
+    dtype = str(spec.get("dtype") or qz.infer_dtype(params))
+    quantized = dtype == qz.DTYPE_AQT_INT8 or qz.tree_is_quantized(params)
+    if dtype == qz.DTYPE_BFLOAT16:
+        # bf16 fast path: ONE cast at load (a no-op when the checkpoint
+        # already stores bf16), so no request ever pays a per-call cast
+        # and the resident tree holds half the bytes.
+        import jax.numpy as jnp
+
+        params = qz.cast_params(params, jnp.bfloat16)
+    if quantized:
+        # aqt_int8 payloads stay int8-resident; the dequant runs INSIDE
+        # the jitted step (fused by XLA — gathers read int8 rows), so
+        # apply_fn always sees the dense tree it was written against.
+        raw_apply = apply_fn
+
+        def apply_fn(model, p, batch, _apply=raw_apply):
+            return _apply(model, qz.dequantize_params(p), batch)
 
     transform = None
     if spec.get("has_transform"):
@@ -301,6 +395,19 @@ def load_exported_model(uri: str) -> LoadedModel:
     @jax.jit
     def _forward(params, transformed: Dict[str, Any]):
         return apply_fn(model, params, transformed)
+
+    # AOT executable table: serving/aot.py fills it per padded bucket at
+    # the fleet's swap gate; until then every lookup short-circuits on
+    # the empty-dict check and the jit path below is exactly pre-AOT.
+    aot = AotDispatch()
+
+    def _dispatch(endpoint: str, jit_fn, batch):
+        if aot.entries:
+            exe = aot.lookup(endpoint, batch)
+            if exe is not None:
+                return exe(params, batch)
+            aot.record_fallback(endpoint, batch)
+        return jit_fn(params, batch)
 
     if transform is not None:
         host_fn, device_fn, _ = transform.split_host_device()
@@ -311,20 +418,29 @@ def load_exported_model(uri: str) -> LoadedModel:
             return apply_fn(model, params, device_fn(iface))
 
         def predict(raw_batch: Dict[str, np.ndarray]):
-            return _transform_and_forward(params, host_fn(raw_batch))
+            return _dispatch("raw", _transform_and_forward, host_fn(raw_batch))
 
         host_preprocess = host_fn
         device_step = _transform_and_forward
     else:
         def predict(raw_batch: Dict[str, np.ndarray]):
-            return _forward(params, raw_batch)
+            return _dispatch("raw", _forward, raw_batch)
 
         host_preprocess = lambda b: b  # noqa: E731
         device_step = _forward
 
+    def predict_transformed(batch: Dict[str, np.ndarray]):
+        return _dispatch("transformed", _forward, batch)
+
     generate = None
     step_builder = getattr(module, "make_generate_step", None)
     gen_builder = getattr(module, "make_generate_fn", None)
+    if quantized:
+        # Generate/decode hooks receive the params tree verbatim and were
+        # written against dense params; a quantized payload serves the
+        # predict surfaces only.  A generative fleet's canary refuses it
+        # (no decode contract) instead of crashing mid-decode.
+        step_builder = gen_builder = None
     if step_builder is not None:
         # Preferred hook: fn(params, transformed_batch) — params stay a jit
         # argument all the way down.
@@ -347,7 +463,9 @@ def load_exported_model(uri: str) -> LoadedModel:
         else:
             generate = device_generate
 
-    decode_builder = getattr(module, "make_decode_fns", None)
+    decode_builder = (
+        None if quantized else getattr(module, "make_decode_fns", None)
+    )
     decode_fns = None
     if decode_builder is not None:
         # Continuous-batching contract for the generative fleet model
@@ -361,11 +479,17 @@ def load_exported_model(uri: str) -> LoadedModel:
         spec=spec,
         transform=transform,
         predict=predict,
-        predict_transformed=lambda batch: _forward(params, batch),
+        predict_transformed=predict_transformed,
         host_preprocess=host_preprocess,
         device_predict=lambda batch: device_step(params, batch),
         forward_step=_forward,
         device_step=device_step,
         generate=generate,
         decode_fns=decode_fns,
+        dtype=dtype,
+        # Resident bytes of the tree actually held in memory (after the
+        # bf16 load cast / with int8 + scales), not the on-disk figure.
+        params_bytes=qz.params_nbytes(params),
+        uri=os.path.abspath(uri),
+        aot=aot,
     )

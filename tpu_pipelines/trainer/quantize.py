@@ -1,0 +1,223 @@
+"""Weight quantization for serving payloads (the Rewriter's math).
+
+AQT-style post-training weight-only quantization: each large floating
+weight tensor is stored as an int8 ``qvalue`` plus a per-channel float32
+``scale`` (symmetric, first-axis channels), and the serving loader
+dequantizes INSIDE the jitted forward pass — ``q.astype(f32) * s`` fused
+into the computation by XLA — so the resident params tree stays int8
+(4x smaller) and ops that touch a slice of a tensor (embedding gathers)
+read a quarter of the bytes.  When the installed ``aqtp`` package is
+importable its calibrated quantizer produces the (qvalue, scale) pair;
+otherwise a numerically-identical symmetric max/127 fallback does.
+
+Representation: a quantized leaf is replaced by a plain dict subtree
+
+    {"__aqt_int8_q__": int8[...], "__aqt_int8_s__": float32[d0,1,...]}
+
+which round-trips through orbax (a pytree of arrays), keeps the payload
+self-contained, and needs no aqt import at load time.  Per-FIRST-axis
+scales are exact under both canonical uses: for an embedding table
+``[V, D]`` each row carries its own scale (the gathered rows dequantize
+independently), and for a matmul weight ``[D, H]`` a per-input-channel
+scale is algebraically a rescaling of the input — quality comparable to
+per-output-channel at identical storage.
+
+Small or 0/1-D leaves (biases, norms, scalars) stay float: quantizing
+them saves nothing and costs quality (standard weight-only practice).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger("tpu_pipelines.trainer.quantize")
+
+QUANT_Q = "__aqt_int8_q__"
+QUANT_S = "__aqt_int8_s__"
+
+# The three serving dtypes a payload spec can declare (export.py records
+# them; the Rewriter emits one payload per variant name).
+DTYPE_FLOAT32 = "float32"
+DTYPE_BFLOAT16 = "bfloat16"
+DTYPE_AQT_INT8 = "aqt_int8"
+
+# Leaves smaller than this many elements stay float (quantization saves
+# ~3 bytes/element; below a few KiB the scale tensor + quality cost win).
+DEFAULT_MIN_QUANT_SIZE = 4096
+
+
+def is_quantized_leaf(node: Any) -> bool:
+    return isinstance(node, dict) and QUANT_Q in node and QUANT_S in node
+
+
+def _is_float_dtype(dtype: Any) -> bool:
+    """True for numpy floats AND the ml_dtypes extension floats (bfloat16
+    has numpy kind 'V', so ``np.issubdtype(..., np.floating)`` misses it)."""
+    if dtype is None:
+        return False
+    dt = np.dtype(dtype)
+    return np.issubdtype(dt, np.floating) or dt.name in (
+        "bfloat16", "float16"
+    )
+
+
+def _quantize_array(w) -> Tuple[Any, Any]:
+    """(qvalue int8, scale f32) with per-first-axis symmetric scales.
+
+    Prefers the installed aqt calibrated quantizer; the fallback is the
+    same symmetric max/127 math (dequant ``q * s`` in both cases).
+    """
+    import jax.numpy as jnp
+
+    axes = tuple(range(1, np.ndim(w)))
+    try:
+        from aqt.jax.v2 import aqt_quantizer
+
+        q = aqt_quantizer.quantizer_make(8, initialize_calibration=True)
+        qt, _ = q.quant(jnp.asarray(w), calibration_axes=axes)
+        return (
+            jnp.asarray(qt.qvalue, jnp.int8),
+            jnp.asarray(qt.scale[0], jnp.float32),
+        )
+    except Exception as e:  # noqa: BLE001 — aqt drift: identical fallback
+        log.debug("aqt quantizer unavailable (%s); symmetric fallback", e)
+    w = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=axes, keepdims=True) if axes else (
+        jnp.abs(w)
+    )
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    qvalue = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return qvalue, scale
+
+
+def _should_quantize(leaf: Any, min_size: int) -> bool:
+    if not _is_float_dtype(getattr(leaf, "dtype", None)):
+        return False
+    return np.ndim(leaf) >= 2 and int(np.size(leaf)) >= int(min_size)
+
+
+def quantize_params(
+    params: Any, min_size: int = DEFAULT_MIN_QUANT_SIZE
+) -> Tuple[Any, Dict[str, Any]]:
+    """Quantize eligible leaves of a (nested-dict) params tree.
+
+    Returns ``(tree, report)``: the tree with eligible leaves replaced by
+    quantized subtrees, and a JSON-native report (per-leaf path/shape/
+    bytes, totals) the Rewriter records on its execution.
+    """
+    quantized: List[Dict[str, Any]] = []
+
+    def walk(node: Any, path: str) -> Any:
+        if isinstance(node, dict):
+            return {
+                k: walk(v, f"{path}/{k}" if path else str(k))
+                for k, v in node.items()
+            }
+        if _should_quantize(node, min_size):
+            qvalue, scale = _quantize_array(node)
+            quantized.append({
+                "path": path,
+                "shape": [int(d) for d in np.shape(node)],
+                "bytes_float": int(np.size(node)) * np.dtype(
+                    getattr(node, "dtype", np.float32)
+                ).itemsize,
+                "bytes_int8": int(np.size(qvalue)) + int(
+                    np.size(scale)
+                ) * 4,
+            })
+            return {QUANT_Q: qvalue, QUANT_S: scale}
+        return node
+
+    tree = walk(params, "")
+    report = {
+        "quantized_leaves": quantized,
+        "num_quantized": len(quantized),
+        "min_quant_size": int(min_size),
+    }
+    return tree, report
+
+
+def dequantize_params(tree: Any, dtype: Optional[Any] = None) -> Any:
+    """Replace quantized subtrees with dense ``q * s`` arrays.
+
+    jnp ops throughout, so calling this INSIDE a jitted function fuses
+    the dequant into the consumer (XLA sinks the convert through gathers
+    — the int8 bandwidth win survives); calling it outside jit gives a
+    concrete dense tree (used by parity tests).
+    """
+    import jax.numpy as jnp
+
+    target = dtype or jnp.float32
+
+    def walk(node: Any) -> Any:
+        if is_quantized_leaf(node):
+            return (
+                node[QUANT_Q].astype(target) * node[QUANT_S].astype(target)
+            )
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(tree)
+
+
+def tree_is_quantized(tree: Any) -> bool:
+    if is_quantized_leaf(tree):
+        return True
+    if isinstance(tree, dict):
+        return any(tree_is_quantized(v) for v in tree.values())
+    return False
+
+
+def cast_params(params: Any, dtype: Any) -> Any:
+    """Cast every floating leaf to ``dtype`` (ints/quantized untouched) —
+    the one-time load cast behind the bf16 fast path."""
+
+    def walk(node: Any) -> Any:
+        if is_quantized_leaf(node):
+            return node
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if _is_float_dtype(getattr(node, "dtype", None)):
+            return node.astype(dtype)
+        return node
+
+    return walk(params)
+
+
+def params_nbytes(tree: Any) -> int:
+    """Resident bytes of a params tree (quantized subtrees count their
+    int8 + scale storage, which is the point)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None:
+            nbytes = int(np.size(leaf)) * np.dtype(
+                getattr(leaf, "dtype", np.float64)
+            ).itemsize
+        total += int(nbytes)
+    return total
+
+
+def infer_dtype(tree: Any) -> str:
+    """Serving-dtype string for a params tree: quantized markers win,
+    else the widest floating leaf dtype name, else float32."""
+    if tree_is_quantized(tree):
+        return DTYPE_AQT_INT8
+    import jax
+
+    names = set()
+    for leaf in jax.tree_util.tree_leaves(tree):
+        dtype = getattr(leaf, "dtype", None)
+        if _is_float_dtype(dtype):
+            names.add(np.dtype(dtype).name)
+    if names == {"bfloat16"}:
+        return DTYPE_BFLOAT16
+    return DTYPE_FLOAT32 if not names or "float32" in names or (
+        "float64" in names
+    ) else sorted(names)[0]
