@@ -10,7 +10,11 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from tpu_pipelines.data.input_pipeline import BatchIterator, InputConfig
+from tpu_pipelines.data.input_pipeline import (
+    BatchIterator,
+    InputConfig,
+    per_host_input_config,
+)
 from tpu_pipelines.models.bert import (
     DEFAULT_HPARAMS,
     bert_partition_rules,
@@ -58,7 +62,10 @@ def run_fn(fn_args):
 
     train_iter = BatchIterator(
         fn_args.train_examples_uri, "train",
-        InputConfig(batch_size=batch_size, shuffle=True, seed=0),
+        # Multi-host DP: each process reads only its own shard of the
+        # train split (whole files over a sharded artifact) instead
+        # of every host decoding every row.  No-op single-process.
+        per_host_input_config(InputConfig(batch_size=batch_size, shuffle=True, seed=0)),
     )
 
     def eval_iter_fn():

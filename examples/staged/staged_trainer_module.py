@@ -12,7 +12,11 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from tpu_pipelines.data.input_pipeline import BatchIterator, InputConfig
+from tpu_pipelines.data.input_pipeline import (
+    BatchIterator,
+    InputConfig,
+    per_host_input_config,
+)
 from tpu_pipelines.models.staged import (
     DEFAULT_HPARAMS,
     build_staged_model,
@@ -54,8 +58,13 @@ def run_fn(fn_args):
 
     train_iter = BatchIterator(
         fn_args.train_examples_uri, "train",
-        InputConfig(batch_size=batch_size, shuffle=True, seed=0,
-                    drop_remainder=True),
+        # Multi-host DP: each process reads only its own shard of the
+        # train split (whole files over a sharded artifact) instead
+        # of every host decoding every row.  No-op single-process.
+        per_host_input_config(
+            InputConfig(batch_size=batch_size, shuffle=True, seed=0,
+                        drop_remainder=True)
+        ),
     )
 
     def eval_iter_fn():

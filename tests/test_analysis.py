@@ -18,6 +18,7 @@ The ISSUE-6 contracts, each proven here:
     component-declaration reordering.
 """
 
+import glob
 import json
 import os
 import subprocess
@@ -917,6 +918,90 @@ def test_tpp209_whole_request_decode(tmp_path):
             assert 'model_type="generative"' in f209[0].fix
 
 
+def test_tpp210_mesh_without_per_host_input(tmp_path):
+    """TPP210: a configured mesh next to an unsharded InputConfig fires
+    WARN; explicit shard kwargs, the per_host_input_config helper, an
+    assigned_shard_files mention, and mesh-less modules all stay silent."""
+    from tpu_pipelines.utils.module_loader import load_fn
+
+    mod = tmp_path / "meshy.py"
+    mod.write_text(textwrap.dedent('''
+        def mesh_and_full_iteration(fn_args):
+            from tpu_pipelines.data.input_pipeline import InputConfig
+            from tpu_pipelines.parallel.mesh import MeshConfig, make_mesh
+
+            mesh = make_mesh(MeshConfig(data=8))
+            return mesh, InputConfig(batch_size=64)
+
+
+        def mesh_config_kwarg_counts(fn_args):
+            from tpu_pipelines.data.input_pipeline import InputConfig
+            from tpu_pipelines.trainer import TrainLoopConfig
+
+            cfg = TrainLoopConfig(train_steps=4, mesh_config=fn_args.mc)
+            return cfg, InputConfig(batch_size=64)
+
+
+        def explicit_shard_kwargs_are_fine(fn_args):
+            from tpu_pipelines.data.input_pipeline import InputConfig
+            from tpu_pipelines.parallel.mesh import MeshConfig, make_mesh
+
+            mesh = make_mesh(MeshConfig(data=8))
+            return mesh, InputConfig(
+                batch_size=64, shard_index=0, num_shards=2
+            )
+
+
+        def per_host_helper_is_fine(fn_args):
+            from tpu_pipelines.data.input_pipeline import (
+                InputConfig, per_host_input_config,
+            )
+            from tpu_pipelines.parallel.mesh import MeshConfig, make_mesh
+
+            mesh = make_mesh(MeshConfig(data=8))
+            return mesh, per_host_input_config(InputConfig(batch_size=64))
+
+
+        def no_mesh_is_silent(fn_args):
+            from tpu_pipelines.data.input_pipeline import InputConfig
+
+            return InputConfig(batch_size=64)
+
+
+        def none_mesh_config_is_silent(fn_args):
+            from tpu_pipelines.data.input_pipeline import InputConfig
+            from tpu_pipelines.trainer import TrainLoopConfig
+
+            cfg = TrainLoopConfig(train_steps=4, mesh_config=None)
+            return cfg, InputConfig(batch_size=64)
+    '''))
+    for fn, n in (("mesh_and_full_iteration", 1),
+                  ("mesh_config_kwarg_counts", 1),
+                  ("explicit_shard_kwargs_are_fine", 0),
+                  ("per_host_helper_is_fine", 0),
+                  ("no_mesh_is_silent", 0),
+                  ("none_mesh_config_is_silent", 0)):
+        findings = check_callable(load_fn(str(mod), fn), "Trainer")
+        f210 = [f for f in findings if f.rule == "TPP210"]
+        assert len(f210) == n, (fn, findings)
+        if n:
+            assert f210[0].severity == "warn"
+            assert "per_host_input_config" in f210[0].fix
+
+
+def test_tpp210_example_trainer_modules_are_clean():
+    """The shipped trainer modules dogfood per_host_input_config — the
+    lint leg (all six examples CLEAN) holds with TPP210 in the catalog."""
+    from tpu_pipelines.utils.module_loader import load_fn
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    for mod in sorted(
+        glob.glob(os.path.join(root, "examples", "*", "*_trainer_module.py"))
+    ):
+        findings = check_callable(load_fn(mod, "run_fn"), "Trainer")
+        assert [f for f in findings if f.rule == "TPP210"] == [], mod
+
+
 # ------------------------------------------------------------------- gates
 
 
@@ -1300,6 +1385,20 @@ def ServeGen(ctx):
 
 def create_pipeline():
     gen = ServeGen()
+    return _pipe([gen, Sink(examples=gen.outputs["examples"])])
+''',
+    "TPP210": '''
+@component(outputs={{"examples": "Examples"}}, name="MeshGen")
+def MeshGen(ctx):
+    from tpu_pipelines.data.input_pipeline import InputConfig
+    from tpu_pipelines.parallel.mesh import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(data=8))
+    return mesh, InputConfig(batch_size=64)
+
+
+def create_pipeline():
+    gen = MeshGen()
     return _pipe([gen, Sink(examples=gen.outputs["examples"])])
 ''',
 }

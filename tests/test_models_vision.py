@@ -43,14 +43,18 @@ def test_mnist_trains_on_mesh():
         acc = jnp.mean(jnp.argmax(logits, -1) == batch["label"])
         return loss, {"accuracy": acc}
 
+    # 40 steps, not 20: optimizer/PRNG numerics drift across jax releases
+    # and 20 steps sat exactly on the 0.7 threshold (0.75 on jax 0.4.37).
+    # At 40 the loss reads ~0.37 with accuracy ~0.89 — a real learning
+    # signal with margin, instead of a coin flip on the version's rng.
     params, result = train_loop(
         loss_fn=loss_fn,
         init_params_fn=lambda rng, b: model.init(rng, b["image"])["params"],
         optimizer=optax.adam(1e-3),
         train_iter=batches(),
-        config=TrainLoopConfig(train_steps=20, batch_size=64, log_every=0),
+        config=TrainLoopConfig(train_steps=40, batch_size=64, log_every=0),
     )
-    assert result.steps_completed == 20
+    assert result.steps_completed == 40
     assert result.final_metrics["loss"] < 0.7  # learned something
 
 

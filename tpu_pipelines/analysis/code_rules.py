@@ -461,6 +461,82 @@ def _check_flash_below_crossover(
     return out
 
 
+# Source-level markers that the module already does per-host input
+# assignment (the remedies TPP210 points at); their presence anywhere in
+# the source silences the rule for the whole module.
+_PER_HOST_INPUT_MARKERS = ("per_host_input_config", "assigned_shard_files")
+# InputConfig keywords that pin an explicit per-host shard; a call
+# carrying either is already sharded and stays silent.
+_SHARD_KWARGS = {"shard_index", "num_shards"}
+
+
+def _mesh_configured(tree: ast.AST) -> bool:
+    """True when the source statically configures a multi-chip mesh: a
+    ``make_mesh(...)`` call, or ``TrainLoopConfig(mesh_config=...)`` with
+    anything but the constant None."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        if dotted == "make_mesh" or dotted.endswith(".make_mesh"):
+            return True
+        if dotted.endswith("TrainLoopConfig"):
+            for kw in node.keywords:
+                if kw.arg == "mesh_config" and not (
+                    isinstance(kw.value, ast.Constant)
+                    and kw.value.value is None
+                ):
+                    return True
+    return False
+
+
+def _check_mesh_unsharded_input(
+    src: _Source, node_id: str, fn_label: str
+) -> List[Finding]:
+    """TPP210: a mesh is configured but every host iterates the full
+    dataset.
+
+    With a ``Mesh``/``mesh_config`` in play the code is written for
+    multi-chip — but an ``InputConfig(...)`` with no ``shard_index``/
+    ``num_shards`` (and no ``per_host_input_config`` /
+    ``assigned_shard_files`` anywhere in the module) means every host
+    decodes every row and drops all but 1/N of them: the silent
+    multi-chip input tax.  Single-process runs are unaffected (the
+    per-host helper is a no-op there), so the remedy costs nothing."""
+    if not _mesh_configured(src.tree):
+        return []
+    mentioned = {
+        n.id for n in ast.walk(src.tree) if isinstance(n, ast.Name)
+    } | {
+        n.attr for n in ast.walk(src.tree) if isinstance(n, ast.Attribute)
+    }
+    if mentioned & set(_PER_HOST_INPUT_MARKERS):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        if not (dotted == "InputConfig" or dotted.endswith(".InputConfig")):
+            continue
+        if _SHARD_KWARGS & {kw.arg for kw in node.keywords}:
+            continue
+        f = _finding(
+            src, node, "TPP210", WARN, node_id,
+            f"{fn_label}: a mesh is configured but this InputConfig has "
+            "no per-host shard (shard_index/num_shards) — every host "
+            "decodes the full dataset and drops the rows it doesn't "
+            "feed, the silent multi-chip input tax",
+            "wrap the config in per_host_input_config(...) (derives the "
+            "shard from the jax process topology; over a sharded "
+            "Examples artifact each host then reads only its own shard "
+            "files), or pin shard_index/num_shards explicitly",
+        )
+        if f:
+            out.append(f)
+    return out
+
+
 # Keys whose presence in a serving call/config declares the payload
 # autoregressive (decode geometry the predict path never takes).
 _DECODE_KEYS = ("max_decode_len", "max_new_tokens", "beam_size")
@@ -565,6 +641,7 @@ def check_callable(
     out.extend(_check_window_host_traffic(src, node_id, label))
     out.extend(_check_flash_below_crossover(src, node_id, label))
     out.extend(_check_whole_request_decode(src, node_id, label))
+    out.extend(_check_mesh_unsharded_input(src, node_id, label))
     return out
 
 

@@ -152,6 +152,14 @@ RULES: Dict[str, Dict[str, str]] = {
                  '(model_type="generative") serves at the decode-step '
                  "level",
     },
+    "TPP210": {
+        "severity": WARN,
+        "title": "mesh configured but input iteration has no per-host "
+                 "shard (no per_host_input_config / assigned_shard_files "
+                 "/ shard kwargs) — every host decodes the full dataset "
+                 "and drops the rows it doesn't feed, the silent "
+                 "multi-chip input tax",
+    },
 }
 
 GRAPH_RULE_PREFIX = "TPP1"

@@ -49,6 +49,37 @@ def assigned_shard_files(
     )
 
 
+def per_host_input_config(
+    config: "InputConfig",
+    *,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> "InputConfig":
+    """This host's shard of the input: InputConfig with shard_index /
+    num_shards derived from the JAX process topology.
+
+    The multi-host input contract (SURVEY.md §3.3): every process feeds
+    only its own rows — over a sharded Examples artifact the reader then
+    takes whole shard files (``assigned_shard_files``), so no host decodes
+    a row it drops.  A config that already pins ``num_shards`` explicitly
+    is returned unchanged (the caller knows better), as is everything on a
+    single-process runtime.  Pass ``process_index``/``process_count`` to
+    derive for a simulated topology without touching the jax backend.
+    """
+    if config.num_shards > 1:
+        return config
+    if process_count is None or process_index is None:
+        import jax
+
+        process_count = jax.process_count()
+        process_index = jax.process_index()
+    if process_count <= 1:
+        return config
+    return dataclasses.replace(
+        config, shard_index=int(process_index), num_shards=int(process_count)
+    )
+
+
 @dataclasses.dataclass
 class InputConfig:
     batch_size: int = 128

@@ -6,10 +6,13 @@ TPU-native replacement for the reference's tf.distribute + NCCL stack
 ICI/DCN.  No user-level collective library exists or is needed.
 """
 
+from tpu_pipelines.parallel.compat import shard_map  # noqa: F401
 from tpu_pipelines.parallel.mesh import (  # noqa: F401
+    VALID_MASK_KEY,
     MeshConfig,
-    make_mesh,
-    shard_batch,
-    replicate,
     data_parallel_sharding,
+    make_mesh,
+    masked_mean,
+    replicate,
+    shard_batch,
 )

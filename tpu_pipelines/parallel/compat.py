@@ -1,0 +1,39 @@
+"""Version-portable ``shard_map``: one import site for every jax we support.
+
+The public API moved twice across the jax versions this repo meets in the
+wild: ``jax.experimental.shard_map.shard_map(..., check_rep=)`` (<= 0.4.x),
+then top-level ``jax.shard_map(..., check_vma=)`` (the replication check was
+renamed when it became the varying-manual-axes check).  Every in-repo caller
+imports :func:`shard_map` from here and spells the knob ``check_vma`` — the
+shim maps it onto whichever spelling the installed jax understands, so the
+parallel layer (ring/ulysses attention, pipeline parallelism, the DP
+windowed train step) runs unmodified on either side of the rename.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any
+
+import jax
+
+try:  # jax >= 0.6: top-level public API
+    _native = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _native
+
+# The replication-check kwarg kept its meaning but changed its name
+# (check_rep -> check_vma); detect which one the installed jax takes.
+_PARAMS = set(inspect.signature(_native).parameters)
+_CHECK_KW = "check_vma" if "check_vma" in _PARAMS else "check_rep"
+
+
+def shard_map(
+    f, *, mesh, in_specs, out_specs, check_vma: bool = True, **kwargs: Any
+):
+    """``jax.shard_map`` with the replication-check knob normalized to its
+    modern ``check_vma`` spelling regardless of installed jax version."""
+    kwargs[_CHECK_KW] = check_vma
+    return _native(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )

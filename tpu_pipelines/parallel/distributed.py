@@ -64,6 +64,52 @@ class DistributedConfig:
         }
 
 
+def survivor_configs(
+    num_processes: int,
+    lost_process_ids,
+    coordinator_address: str = "",
+) -> list:
+    """Re-form the process topology after losing hosts: the elastic-resume
+    bootstrap (PERFORMANCE.md "Multi-chip window").
+
+    jax's coordination service cannot shrink in place — the driver
+    restarts the job on the survivors with a re-derived topology.  This is
+    that derivation: survivors keep their RELATIVE order but are
+    re-indexed densely 0..n-1 (process 0 duties — metadata writes,
+    TensorBoard — fall to the lowest surviving rank), and the coordinator
+    moves to the new process 0's address unless one is passed explicitly.
+    Each surviving worker then resumes from the last durable window with
+    a per-host shard assignment re-derived from the NEW (index, count)
+    (``per_host_input_config`` / ``assigned_shard_files``), so the
+    surviving hosts cover the whole dataset again with no overlap.
+
+    Returns ``[(old_process_id, DistributedConfig), ...]`` in new-rank
+    order; raises when nothing survives.
+    """
+    lost = {int(p) for p in lost_process_ids}
+    bad = lost - set(range(num_processes))
+    if bad:
+        raise ValueError(
+            f"lost process ids {sorted(bad)} not in 0..{num_processes - 1}"
+        )
+    survivors = [p for p in range(num_processes) if p not in lost]
+    if not survivors:
+        raise ValueError(
+            f"all {num_processes} processes lost: nothing to re-form"
+        )
+    return [
+        (
+            old_id,
+            DistributedConfig(
+                coordinator_address=coordinator_address,
+                num_processes=len(survivors),
+                process_id=new_id,
+            ),
+        )
+        for new_id, old_id in enumerate(survivors)
+    ]
+
+
 def local_process_id(env=os.environ) -> int:
     """This host's process id in a multi-host run; 0 for single-process.
 

@@ -86,18 +86,78 @@ def replicate(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(batch: Any, mesh: Mesh) -> Any:
+# Key under which shard_batch records row validity when it had to pad a
+# short batch to the mesh data axis: float32 [batch], 1.0 = real row,
+# 0.0 = zero padding.  Loss/metric code weights by it via masked_mean().
+VALID_MASK_KEY = "__valid__"
+
+
+def shard_batch(batch: Any, mesh: Mesh, *, pad_to_mesh: bool = True) -> Any:
     """Place a host pytree of arrays on the mesh, batch dim over 'data'.
 
     This is the host→device infeed boundary (SURVEY.md §3.3): one
     ``device_put`` per step; everything after is on-chip.
+
+    A dict batch whose row count does not divide the mesh ``data`` axis —
+    the short tail of a ``drop_remainder=False`` epoch, or a window tail —
+    is zero-padded up to the next multiple and gains a ``VALID_MASK_KEY``
+    float32 row-validity mask (1.0 real, 0.0 padding) so the tail still
+    shards evenly instead of erroring; weight per-row losses/metrics with
+    :func:`masked_mean` to ignore the padded rows.  Batches that already
+    divide take the exact pre-padding path (no mask key, bitwise-identical
+    placement), and non-dict pytrees keep the strict divide-or-error
+    contract (there is nowhere to attach a mask).
     """
+    data_axis = mesh.shape.get("data", 1)
+    if (
+        pad_to_mesh
+        and data_axis > 1
+        and isinstance(batch, dict)
+        and batch
+        and VALID_MASK_KEY not in batch
+    ):
+        n = len(np.asarray(next(iter(batch.values()))))
+        target = pad_to_multiple(n, data_axis)
+        if target != n:
+            pad = target - n
+
+            def pad_rows(x):
+                arr = np.asarray(x)
+                return np.concatenate(
+                    [arr, np.zeros((pad, *arr.shape[1:]), arr.dtype)]
+                )
+
+            batch = {k: pad_rows(v) for k, v in batch.items()}
+            batch[VALID_MASK_KEY] = np.concatenate(
+                [np.ones(n, np.float32), np.zeros(pad, np.float32)]
+            )
 
     def put(x):
         arr = np.asarray(x)
         return jax.device_put(arr, data_parallel_sharding(mesh, arr.ndim))
 
     return jax.tree_util.tree_map(put, batch)
+
+
+def masked_mean(values: Any, mask: Any = None) -> Any:
+    """Mean of per-row ``values`` over valid rows.
+
+    ``mask=None`` (the unpadded case) is exactly ``jnp.mean`` — same op,
+    bitwise-identical to pre-mask code — so callers can unconditionally
+    write ``masked_mean(per_row, batch.get(VALID_MASK_KEY))``.  With a
+    mask, padded rows are weighted out of both numerator and denominator;
+    ``values`` may carry trailing dims (per-row vectors), the mask
+    broadcasts from the batch dim.
+    """
+    import jax.numpy as jnp
+
+    values = jnp.asarray(values)
+    if mask is None:
+        return jnp.mean(values)
+    mask = jnp.asarray(mask, values.dtype)
+    weights = mask.reshape(mask.shape + (1,) * (values.ndim - mask.ndim))
+    denom = jnp.sum(mask) * float(np.prod(values.shape[mask.ndim:], dtype=np.int64) or 1)
+    return jnp.sum(values * weights) / jnp.maximum(denom, 1.0)
 
 
 def pad_to_multiple(n: int, k: int) -> int:

@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpu_pipelines.parallel.compat import shard_map
+
 NEG_INF = -1e30  # finite mask value: exp underflows to 0, no NaN plumbing
 
 
@@ -164,14 +166,14 @@ def ring_attention(
     qkv_spec = P(batch_axis, axis, head_axis, None)
     mask_spec = P(batch_axis, axis)
     if has_mask:
-        return jax.shard_map(
+        return shard_map(
             local_fn,
             mesh=mesh,
             in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
             out_specs=qkv_spec,
             check_vma=False,
         )(q, k, v, kv_mask)
-    return jax.shard_map(
+    return shard_map(
         lambda q, k, v: local_fn(q, k, v, None),
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec),
@@ -236,14 +238,14 @@ def ulysses_attention(
     qkv_spec = P(batch_axis, axis, head_axis, None)
     mask_spec = P(batch_axis, axis)
     if kv_mask is not None:
-        return jax.shard_map(
+        return shard_map(
             local_fn,
             mesh=mesh,
             in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
             out_specs=qkv_spec,
             check_vma=False,
         )(q, k, v, kv_mask)
-    return jax.shard_map(
+    return shard_map(
         lambda q, k, v: local_fn(q, k, v, None),
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec),

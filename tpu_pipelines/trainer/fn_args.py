@@ -146,3 +146,14 @@ class TrainResult:
     # (TrainLoopConfig.window_steps / TPP_WINDOW_STEPS, default log_every);
     # 1 = the per-step host loop.
     window_steps: int = 1
+    # Elastic-resume replay: steps this run re-executed because the
+    # previous run was interrupted past its last durable window (the
+    # window_progress marker outran the restored checkpoint).  0 for
+    # uninterrupted runs.  Replayed examples are accounted as lost work,
+    # never as fresh progress — the no-double-counting contract asserted
+    # in tests/test_multichip_window.py.
+    replayed_steps: int = 0
+    # Gradient-exchange mode the loop ran with: "" = implicit GSPMD,
+    # "psum_bucketed" = chunked in-scan psums, "ordered" = fixed-block
+    # mesh-size-invariant reduction (TrainLoopConfig.dp_collective).
+    dp_collective: str = ""

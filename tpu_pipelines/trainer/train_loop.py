@@ -106,6 +106,32 @@ class TrainLoopConfig:
     # (every a-th row) so each stays evenly sharded over the mesh ``data``
     # axis.  batch_size must divide evenly.
     grad_accum_steps: int = 1
+    # ---- explicit data-parallel collective modes (multi-chip window) ----
+    # How the scan body's gradient all-reduce is expressed on a >1 'data'
+    # axis.  None/"auto" (default): implicit GSPMD — XLA inserts one fused
+    # all-reduce wherever it likes, which on µs-scale steps lands exactly
+    # at the window boundary and serializes against the next step.
+    # "psum_bucketed": grads are computed per device under shard_map and
+    # all-reduced as ``collective_buckets`` chunked psums INSIDE the scan
+    # body, so the scheduler can overlap bucket k's collective with the
+    # remaining backward compute (verified from compiled HLO in
+    # tests/test_multichip_window.py).  "ordered": grads are computed per
+    # fixed global block (``dp_grad_blocks`` blocks, a count chosen
+    # independently of the mesh), all-gathered, and summed in block order —
+    # the param trajectory is bitwise-invariant to the data-axis size, so
+    # an elastic resume onto a survivor mesh continues the exact same
+    # trajectory; costs all-gather bandwidth (block grads move whole).
+    # Both explicit modes require pure DP: no param_partition /
+    # batch_partition / grad_accum / model_state.
+    dp_collective: Optional[str] = None
+    # Chunked-psum bucket count for "psum_bucketed" (>=1; grad leaves are
+    # round-robined into buckets, one psum each).
+    collective_buckets: int = 2
+    # Fixed global gradient-block count for "ordered".  None = the mesh
+    # data-axis size (cheapest).  Pin it to the LARGEST mesh you intend to
+    # resume across — trajectories are bitwise-comparable only between
+    # runs sharing the same block count.
+    dp_grad_blocks: Optional[int] = None
     # Sync-anchored throughput windows: every ``anchor_every`` post-compile
     # steps, force a device-to-host read of that step's loss (the same
     # cannot-lie transfer used for t_start below) and time the span since the
@@ -199,6 +225,119 @@ def _opt_state_sharding(opt_state, params, p_shard, mesh: Mesh):
     return jax.tree_util.tree_unflatten(
         treedef, [match(path, leaf) for path, leaf in flat]
     )
+
+
+ENV_DP_COLLECTIVE = "TPP_DP_COLLECTIVE"
+_DP_MODES = ("auto", "psum_bucketed", "ordered")
+
+
+def _effective_dp_collective(config: TrainLoopConfig) -> str:
+    """Resolve the explicit-collective mode: config > TPP_DP_COLLECTIVE
+    env > '' (implicit GSPMD).  'auto' normalizes to ''."""
+    mode = config.dp_collective
+    if mode is None:
+        mode = os.environ.get(ENV_DP_COLLECTIVE, "").strip() or None
+    if mode in (None, "", "auto"):
+        return ""
+    if mode not in _DP_MODES:
+        raise ValueError(
+            f"dp_collective {mode!r}: expected one of {_DP_MODES}"
+        )
+    return mode
+
+
+def _make_dp_forward_backward(
+    loss_fn: LossFn,
+    mesh: Mesh,
+    mode: str,
+    *,
+    buckets: int,
+    grad_blocks: int,
+):
+    """Mesh-explicit DP forward/backward: (params, batch, rng) ->
+    (loss, metrics, grads), all replicated.
+
+    The gradient exchange is expressed INSIDE the function (and therefore
+    inside the windowed scan body) instead of being left to GSPMD:
+
+      * ``psum_bucketed`` — per-device grads, leaves round-robined into
+        ``buckets`` chunks, one ``psum`` per chunk.  Distinct all-reduce
+        ops in the compiled HLO let the scheduler start bucket k's
+        collective while the rest of the backward still computes, instead
+        of one fused all-reduce serialized at the window boundary.
+      * ``ordered`` — grads per fixed global block (``grad_blocks`` blocks
+        of the global batch, a count independent of the mesh), block grads
+        all-gathered to every device and summed in block order by one
+        ``jnp.sum`` over the stacked [G, ...] axis.  Because every mesh
+        size computes the same per-block grads and reduces them with the
+        same op, the result is bitwise-invariant to the data-axis size —
+        the contract elastic resume onto a survivor mesh relies on.
+
+    Loss/metrics follow the same reduction as the grads, so the reported
+    series inherits the mode's determinism contract.
+    """
+    from tpu_pipelines.parallel.compat import shard_map
+
+    data_axis = mesh.shape["data"]
+
+    def fb(params, batch, rng):
+        def local_psum(params, lb, rng):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, lb, rng)
+            leaves, treedef = jax.tree_util.tree_flatten(grads)
+            k = max(1, min(buckets, len(leaves)))
+            reduced: list = [None] * len(leaves)
+            for i in range(k):
+                chunk = tuple(leaves[i::k])
+                out = jax.lax.psum(chunk, "data")
+                for j, v in enumerate(out):
+                    reduced[i + j * k] = v
+            inv = 1.0 / data_axis
+            grads = jax.tree_util.tree_unflatten(
+                treedef, [v * inv for v in reduced]
+            )
+            loss = jax.lax.psum(loss, "data") * inv
+            metrics = jax.tree_util.tree_map(
+                lambda v: jax.lax.psum(v, "data") * inv, metrics
+            )
+            return loss, metrics, grads
+
+        def local_ordered(params, lb, rng):
+            blocks = grad_blocks // data_axis
+
+            def block_fb(mb):
+                (loss, metrics), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params, mb, rng)
+                return loss, metrics, grads
+
+            mb = jax.tree_util.tree_map(
+                lambda x: x.reshape(
+                    blocks, x.shape[0] // blocks, *x.shape[1:]
+                ),
+                lb,
+            )
+            l_b, m_b, g_b = jax.vmap(block_fb)(mb)
+            gather = lambda t: jax.lax.all_gather(t, "data", tiled=True)
+            inv = 1.0 / grad_blocks
+            ordered_sum = lambda v: jnp.sum(gather(v), axis=0) * inv
+            return (
+                ordered_sum(l_b),
+                jax.tree_util.tree_map(ordered_sum, m_b),
+                jax.tree_util.tree_map(ordered_sum, g_b),
+            )
+
+        local = local_psum if mode == "psum_bucketed" else local_ordered
+        return shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(), P("data"), P()),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )(params, batch, rng)
+
+    return fb
 
 
 def train_loop(
@@ -309,6 +448,38 @@ def train_loop(
             f"grad_accum_steps {accum}"
         )
 
+    # Explicit DP collective mode (multi-chip window): replace the implicit
+    # GSPMD gradient exchange with a shard_map-expressed one — bucketed
+    # psum (overlap-friendly) or fixed-block ordered reduction (bitwise
+    # mesh-size-invariant).  Runs even on a data=1 mesh so a single-chip
+    # "ordered" run shares the multi-chip run's exact reduction structure.
+    dp_mode = _effective_dp_collective(config)
+    dp_fb = None
+    if dp_mode:
+        data_axis = mesh.shape["data"]
+        if config.param_partition is not None or bp:
+            raise ValueError(
+                f"dp_collective={dp_mode!r} is pure data parallelism: "
+                "param_partition/batch_partition are not supported"
+            )
+        if accum > 1 or has_model_state:
+            raise ValueError(
+                f"dp_collective={dp_mode!r} does not compose with "
+                "grad_accum_steps>1 or has_model_state"
+            )
+        grad_blocks = int(config.dp_grad_blocks or data_axis)
+        if grad_blocks % data_axis or config.batch_size % grad_blocks:
+            raise ValueError(
+                f"dp_grad_blocks {grad_blocks} must be a multiple of the "
+                f"mesh data axis ({data_axis}) and divide batch_size "
+                f"({config.batch_size})"
+            )
+        dp_fb = _make_dp_forward_backward(
+            loss_fn, mesh, dp_mode,
+            buckets=max(1, int(config.collective_buckets)),
+            grad_blocks=grad_blocks,
+        )
+
     def forward_backward(params, mstate, mb, rng):
         if has_model_state:
             (loss, (metrics, new_mstate)), grads = jax.value_and_grad(
@@ -323,7 +494,10 @@ def train_loop(
 
     def step_fn(state: TrainState, batch) -> Tuple[TrainState, Dict[str, jax.Array]]:
         step_rng = jax.random.fold_in(state.rng, state.step)
-        if accum == 1:
+        if dp_fb is not None:
+            loss, metrics, grads = dp_fb(state.params, batch, step_rng)
+            new_mstate = state.model_state
+        elif accum == 1:
             loss, metrics, grads, new_mstate = forward_backward(
                 state.params, state.model_state, batch, step_rng
             )
@@ -470,6 +644,21 @@ def train_loop(
             )
             start_step = int(latest)
             log.info("resumed from checkpoint step %d", start_step)
+    # Replayed-span accounting: the progress marker records the furthest
+    # EXECUTED step; resuming from an earlier durable checkpoint means the
+    # gap re-executes.  Reported (never double-counted as fresh progress)
+    # so an elastic restart can prove exactly how much work the lost host
+    # cost — see tests/test_multichip_window.py.
+    replayed_steps = 0
+    if checkpoint_dir:
+        executed = _read_progress_step(checkpoint_dir)
+        if executed > start_step:
+            replayed_steps = executed - start_step
+            log.info(
+                "resume replays steps %d..%d (executed before the "
+                "interruption, lost with the non-durable window)",
+                start_step + 1, executed,
+            )
     tracker.training_prep_end()
 
     # ---- the loop
@@ -650,7 +839,11 @@ def train_loop(
             # a data dependency of every step in the window, so the
             # transfer proves the whole window executed before the clock
             # is read — the same cannot-lie anchoring as the per-step
-            # path, at window granularity.
+            # path, at window granularity.  Per HOST, not per device: the
+            # scan's metric outputs land replicated (the loss mean/psum
+            # makes them so), so device_get reads one locally-addressable
+            # copy — no cross-device gather, and each process in a
+            # multi-host run fetches only from its own devices.
             host_stack = jax.device_get(mstack)
             now = time.perf_counter()
             if t_start is None:
@@ -679,6 +872,12 @@ def train_loop(
                 float(host_stack["loss"][-1]),
             )
             window_anchor = (step, now)
+            if checkpoint_dir:
+                # The window just proved itself executed (the metric fetch
+                # above is a data dependency of every step in it): advance
+                # the progress marker so a crash before the NEXT durable
+                # checkpoint shows up as a replayed span on resume.
+                _write_progress(checkpoint_dir, step)
             if (
                 saver is not None and checkpoint_every
                 and step % checkpoint_every == 0
@@ -759,6 +958,7 @@ def train_loop(
                 # save args and consulting the manager every step is pure
                 # per-step host overhead on the hot path.
                 mngr.save(step, args=_ocp_save_args(state))
+                _write_progress(checkpoint_dir, step)
             if (
                 eval_step is not None
                 and config.eval_every
@@ -839,6 +1039,7 @@ def train_loop(
         if mngr.latest_step() != step:
             mngr.save(step, args=_ocp_save_args(state), force=True)
         mngr.wait_until_finished()
+        _write_progress(checkpoint_dir, step)
 
     cost_flops = None
     cost_source = ""
@@ -896,6 +1097,10 @@ def train_loop(
             "badput": gsum.get("badput", {}),
             "goodput_post_compile": proxy_goodput,
             "steps_completed": step,
+            # Replayed span (elastic resume): steps re-executed because
+            # the interruption outran the last durable window.  Counted
+            # here as lost work, never as fresh progress.
+            "replayed_steps": replayed_steps,
         },
     )
     result = TrainResult(
@@ -915,6 +1120,8 @@ def train_loop(
         cost_analysis_flops_per_step=cost_flops,
         cost_analysis_source=cost_source,
         window_steps=eff_window,
+        replayed_steps=replayed_steps,
+        dp_collective=dp_mode,
     )
     final = (
         (state.params, state.model_state) if has_model_state
@@ -948,6 +1155,38 @@ def _effective_window_steps(config: TrainLoopConfig) -> int:
         )
         return 1
     return w
+
+
+def _progress_path(checkpoint_dir: str) -> str:
+    return os.path.join(os.path.abspath(checkpoint_dir), "window_progress.json")
+
+
+def _write_progress(checkpoint_dir: str, step: int) -> None:
+    """Record the furthest step the loop has EXECUTED (crash-durable,
+    atomic) — intentionally ahead of the last durable checkpoint.  On
+    resume the gap between this marker and the restored step is the
+    replayed span: work that ran, was lost with the host, and runs again.
+    The resumed run reports it (TrainResult.replayed_steps) so goodput
+    accounting can prove replayed examples are counted as badput, not as
+    fresh progress."""
+    from tpu_pipelines.robustness import atomic_write_json
+
+    try:
+        atomic_write_json(
+            _progress_path(checkpoint_dir), {"step": int(step)}
+        )
+    except OSError as e:  # progress is accounting, never a run failure
+        log.warning("window progress write failed: %s", e)
+
+
+def _read_progress_step(checkpoint_dir: str) -> int:
+    from tpu_pipelines.robustness import load_json_tolerant
+
+    data = load_json_tolerant(_progress_path(checkpoint_dir))
+    try:
+        return int((data or {}).get("step", 0))
+    except (TypeError, ValueError):
+        return 0
 
 
 def _saveable(state):
