@@ -32,6 +32,7 @@ from tpu_pipelines.analysis import (
     analyze_ir,
     analyze_pipeline,
     check_callable,
+    check_serving_metric_docs,
     format_findings,
     gated,
 )
@@ -1000,6 +1001,90 @@ def test_tpp210_example_trainer_modules_are_clean():
     ):
         findings = check_callable(load_fn(mod, "run_fn"), "Trainer")
         assert [f for f in findings if f.rule == "TPP210"] == [], mod
+
+
+def test_tpp211_undocumented_serving_metric(tmp_path):
+    """TPP211: a serving_decode_* string constant under serving/ with no
+    row in docs/SERVING.md fires WARN with file:line attribution; a
+    documented name, a non-metric string, and a `# tpp: disable=TPP211`
+    line all stay silent."""
+    serving = tmp_path / "serving"
+    serving.mkdir()
+    (serving / "engine.py").write_text(textwrap.dedent('''
+        DOCUMENTED = "serving_decode_steps_total"
+        UNDOCUMENTED = "serving_decode_mystery_total"
+        SUPPRESSED = "serving_decode_hidden_total"  # tpp: disable=TPP211
+        NOT_A_METRIC = "serving_decode_"
+        PROSE = "the serving_decode_ prefix is reserved"
+    '''))
+    # Nested packages are walked too.
+    sub = serving / "fleet"
+    sub.mkdir()
+    (sub / "replica.py").write_text(
+        'ALSO_MISSING = "serving_decode_orphan_ratio"\n'
+    )
+    doc = tmp_path / "SERVING.md"
+    doc.write_text("| `serving_decode_steps_total` | counter | steps |\n")
+
+    findings = check_serving_metric_docs(
+        serving_dir=str(serving), doc_path=str(doc)
+    )
+    assert sorted(
+        (os.path.basename(f.file), f.rule, f.severity) for f in findings
+    ) == [
+        ("engine.py", "TPP211", "warn"),
+        ("replica.py", "TPP211", "warn"),
+    ]
+    by_file = {os.path.basename(f.file): f for f in findings}
+    assert "serving_decode_mystery_total" in by_file["engine.py"].message
+    assert by_file["engine.py"].line > 0
+    assert "SERVING.md" in by_file["engine.py"].fix
+    assert "serving_decode_orphan_ratio" in by_file["replica.py"].message
+
+    # Documenting the stragglers clears the check.
+    doc.write_text(
+        "serving_decode_steps_total serving_decode_mystery_total "
+        "serving_decode_orphan_ratio\n"
+    )
+    assert check_serving_metric_docs(
+        serving_dir=str(serving), doc_path=str(doc)
+    ) == []
+
+    # A missing catalog means NOTHING is documented: every emission flags
+    # (the doc is the contract; losing it must not silence the rule).
+    doc.unlink()
+    missing = check_serving_metric_docs(
+        serving_dir=str(serving), doc_path=str(doc)
+    )
+    assert len(missing) == 3
+
+
+def test_tpp211_dedupes_within_file_and_gates_like_any_warn(tmp_path):
+    """One finding per metric name per file (a name used five times is one
+    catalog omission), and the findings ride the standard gate."""
+    serving = tmp_path / "serving"
+    serving.mkdir()
+    (serving / "metrics.py").write_text(textwrap.dedent('''
+        A = "serving_decode_repeat_total"
+        B = "serving_decode_repeat_total"
+        def emit(reg):
+            return reg.counter("serving_decode_repeat_total")
+    '''))
+    doc = tmp_path / "SERVING.md"
+    doc.write_text("nothing documented here\n")
+    findings = check_serving_metric_docs(
+        serving_dir=str(serving), doc_path=str(doc)
+    )
+    assert len(findings) == 1
+    assert gated(findings, "warn") == findings
+    assert gated(findings, "error") == []
+
+
+def test_tpp211_repo_serving_metrics_are_documented():
+    """Dogfood: every serving_decode_* series the repo's own serving/
+    tree emits has its row in docs/SERVING.md (the defaults resolve
+    against the installed package — exactly what the lint CLI runs)."""
+    assert check_serving_metric_docs() == []
 
 
 # ------------------------------------------------------------------- gates

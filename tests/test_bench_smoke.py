@@ -440,12 +440,19 @@ def test_bench_budget_skips_but_emits():
     lines = _run_bench({"BENCH_BUDGET_S": "0"}, timeout=300)
     compact = json.loads(lines[-1])
     assert compact["metric"] == "bench_failed"
-    assert "taxi" in compact["skipped"]
-    assert "bert" in compact["skipped"]
+    # Each skip entry carries WHY it was skipped — `name(need Xs, had Ys)`
+    # — a bare name read as "forgot to run it" (ISSUE 16).
+    names = {s.split("(", 1)[0] for s in compact["skipped"]}
+    assert all("(need " in s and "s, had " in s for s in compact["skipped"]), (
+        compact["skipped"]
+    )
+    assert "taxi" in names
+    assert "bert" in names
+    assert "bert_goodput" in names
     # e2e legs are prefixed so they never collide with the same-named
     # throughput legs, and the list is dup-free.
-    assert "e2e_bert" in compact["skipped"]
-    assert "e2e_taxi_sched" in compact["skipped"]
+    assert "e2e_bert" in names
+    assert "e2e_taxi_sched" in names
     assert len(compact["skipped"]) == len(set(compact["skipped"]))
     with open(os.path.join(REPO, "BENCH_PARTIAL.json")) as f:
         report = json.load(f)
@@ -453,10 +460,10 @@ def test_bench_budget_skips_but_emits():
     assert report["bert"]["skipped_budget"] is True
     assert report["pipeline_e2e"]["bert"]["skipped_budget"] is True
     assert report["data_plane"]["skipped_budget"] is True
-    assert "data_plane" in compact["skipped"]
-    assert "serving" in compact["skipped"]
-    assert "serving_fleet" in compact["skipped"]
-    assert "generative_serving" in compact["skipped"]
+    assert "data_plane" in names
+    assert "serving" in names
+    assert "serving_fleet" in names
+    assert "generative_serving" in names
     # No taxi leg ran, so the trace-diff self-report degrades to empty
     # flags (never a crash, never a missing key).
     assert compact["regression_flags"] == []

@@ -105,6 +105,18 @@ def test_kv_bucket_sizes():
     assert kv_bucket_sizes(32, 4) == [4, 8, 16, 32]
     # Non-power-of-two cache still terminates exactly at the cache.
     assert kv_bucket_sizes(24, 4) == [4, 8, 16, 24]
+    # Page edges around the cache length (ISSUE 16): exactly equal is the
+    # one-bucket degenerate case, one below yields the tight {page, cache}
+    # pair, one above collapses to the whole cache.
+    assert kv_bucket_sizes(32, 31) == [31, 32]
+    assert kv_bucket_sizes(32, 33) == [32]
+    # A decode budget must be positive — a negative (or zero) cache length
+    # would silently produce an empty bucket list and an engine whose
+    # every program set is degenerate.
+    with pytest.raises(ValueError, match="max_decode_len"):
+        kv_bucket_sizes(-1, 4)
+    with pytest.raises(ValueError, match="max_decode_len"):
+        kv_bucket_sizes(0, 0)
 
 
 def test_validate_generation_params():
@@ -407,6 +419,302 @@ def test_engine_warmup_contract_and_telemetry():
     )
 
 
+# ------------------------------------- decode-path optimisations (ISSUE 16)
+
+
+def test_prefix_cache_refcount_and_trim():
+    """Unit contract of the refcounted prefix cache: a page shared by live
+    readers is PINNED — trim may evict only zero-reader entries (LRU), so
+    an over-capacity entry is freed exactly when its last reader lets
+    go."""
+    from tpu_pipelines.serving.generative import PrefixCache
+
+    cache = PrefixCache(capacity=1, page=2)
+    key_a, pages_a = PrefixCache.key_of(
+        np.asarray([3, 5, 7, 0], np.int64), np.asarray([1, 1, 1, 0]), 2
+    )
+    assert pages_a == 2  # 3 valid tokens / page 2, ceil
+    a = cache.insert(key_a, pages_a, tok0=9, cache={}, encoded=None)
+    cache.acquire(a)
+    cache.acquire(a)  # two live readers share the pages
+
+    # Over capacity while A is pinned: B inserts, trim must evict B's
+    # fellow zero-reader (B itself once C lands), never A.
+    key_b, _ = PrefixCache.key_of(
+        np.asarray([4, 4, 4, 4], np.int64), np.asarray([1, 1, 1, 1]), 2
+    )
+    cache.insert(key_b, 2, tok0=1, cache={}, encoded=None)
+    assert cache.peek(key_a) is a  # pinned past capacity
+    key_c, _ = PrefixCache.key_of(
+        np.asarray([8, 8, 0, 0], np.int64), np.asarray([1, 1, 0, 0]), 2
+    )
+    cache.insert(key_c, 1, tok0=2, cache={}, encoded=None)
+    assert cache.peek(key_b) is None   # LRU zero-reader went
+    assert cache.peek(key_a) is a      # still pinned
+
+    # First release: one reader remains, the pages stay.
+    cache.release(a)
+    assert cache.peek(key_a) is a
+    assert cache.pages_in_use() == pages_a + 1  # A + C resident
+    # LAST reader retires: trim shrinks to capacity, A's pages freed.
+    cache.release(a)
+    assert cache.peek(key_a) is None
+    assert len(cache) == 1
+    assert cache.pages_in_use() == 1  # only C
+
+
+def test_prefix_cache_key_is_mask_and_content_sensitive():
+    from tpu_pipelines.serving.generative import PrefixCache
+
+    toks = np.asarray([3, 5, 7, 9], np.int64)
+    ones = np.asarray([1, 1, 1, 1])
+    k1, p1 = PrefixCache.key_of(toks, ones, 2)
+    # Identical prompt: identical key.
+    assert PrefixCache.key_of(toks.copy(), ones.copy(), 2) == (k1, p1)
+    # Different content, different mask structure: different keys.
+    assert PrefixCache.key_of(toks + 1, ones, 2)[0] != k1
+    assert PrefixCache.key_of(toks, np.asarray([1, 1, 1, 0]), 2)[0] != k1
+    # Masked positions are zeroed before hashing: their (never model-
+    # visible) values must not split the key.
+    half = np.asarray([1, 1, 0, 0])
+    ka, _ = PrefixCache.key_of(np.asarray([3, 5, 99, 42], np.int64), half, 2)
+    kb, _ = PrefixCache.key_of(np.asarray([3, 5, 7, 11], np.int64), half, 2)
+    assert ka == kb
+
+
+def test_engine_prefix_and_chunked_prefill_bitwise_identity():
+    """Acceptance (ISSUE 16): greedy streams with prefix caching AND
+    chunked prefill on are identical to the plain engine's — both
+    optimisations reuse/reschedule the exact same compiled programs, they
+    never change the math."""
+    from tpu_pipelines.serving.generative import GenerativeEngine
+
+    fns = make_stub_fns()
+    rng = np.random.default_rng(23)
+    shared = rng.integers(1, VOCAB, size=(5,)).astype(np.int32)
+    reqs = []
+    for i in range(16):
+        if i % 2 == 0:  # every other request rides the shared prompt
+            reqs.append((shared, int(rng.integers(2, 12))))
+        else:
+            reqs.append((
+                rng.integers(1, VOCAB, size=(int(rng.integers(2, 6)),))
+                .astype(np.int32),
+                int(rng.integers(1, 12)),
+            ))
+
+    engine = GenerativeEngine(
+        fns, {}, max_batch_size=3, page_size=2,
+        prefix_cache_entries=4, prefill_chunk_pages=1,
+    )
+    try:
+        engine.warm()
+        handles = [
+            engine.submit_nowait(inp, max_new_tokens=m) for inp, m in reqs
+        ]
+        outs = [h.wait(30.0) for h in handles]
+    finally:
+        engine.close()
+    assert engine.compiles_after_warm == 0
+    for (inp, m), out in zip(reqs, outs):
+        assert [int(t) for t in out] == ref_stream(inp, m)
+    # The shared prompt actually hit: one miss funded every later reader.
+    assert engine._prefix.hits > 0
+    assert engine._prefix.misses >= 1
+
+
+def test_engine_prefix_cache_lifecycle_and_telemetry():
+    """Engine-level refcount lifecycle: capacity-1 cache across two
+    prompts — the resident entry swaps only after its readers retire, and
+    the hit/miss/pages telemetry matches the schedule."""
+    from tpu_pipelines.observability.metrics import MetricsRegistry
+    from tpu_pipelines.serving.generative import GenerativeEngine
+
+    reg = MetricsRegistry()
+    fns = make_stub_fns()
+    engine = GenerativeEngine(
+        fns, {}, max_batch_size=4, page_size=2,
+        prefix_cache_entries=1, registry=reg, replica="0",
+    )
+    p1 = np.asarray([3, 5, 7], np.int32)
+    p2 = np.asarray([2, 9], np.int32)
+    try:
+        engine.warm()
+        # Concurrent shared-prefix burst: admissions are sequential on the
+        # worker, so the first P1 misses and every later P1 hits its entry.
+        handles = [
+            engine.submit_nowait(p1, max_new_tokens=6) for _ in range(3)
+        ]
+        outs = [h.wait(30.0) for h in handles]
+        for out in outs:
+            assert [int(t) for t in out] == ref_stream(p1, 6)
+        assert engine._prefix.hits == 2
+        assert engine._prefix.misses == 1
+        # Switch prompts: P2 misses, its insert evicts P1 (zero readers
+        # now) from the capacity-1 cache; a second P2 hits.
+        assert [int(t) for t in engine.submit(
+            p2, max_new_tokens=4, timeout_s=30.0
+        )] == ref_stream(p2, 4)
+        assert [int(t) for t in engine.submit(
+            p2, max_new_tokens=7, timeout_s=30.0
+        )] == ref_stream(p2, 7)
+    finally:
+        engine.close()
+    assert len(engine._prefix) == 1
+    assert engine._prefix.hits == 3
+    assert engine._prefix.misses == 2
+    assert reg.get(
+        "serving_decode_prefix_hit_total"
+    ).labels("0").get() == 3
+    assert reg.get(
+        "serving_decode_prefix_miss_total"
+    ).labels("0").get() == 2
+    # P2 (2 valid tokens, page 2) is the lone resident entry: 1 page.
+    assert reg.get(
+        "serving_decode_prefix_pages_in_use"
+    ).labels("0").get() == 1
+
+
+def test_engine_pages_accounting_under_admit_retire_move_mix():
+    """The pages-in-use figure published at every step equals the sum of
+    live sequences' ceil((emitted+1)/page) — through a schedule that
+    forces admissions, retirements, and slot moves."""
+    from tpu_pipelines.serving.generative import GenerativeEngine
+
+    page = 2
+    fns = make_stub_fns()
+    engine = GenerativeEngine(fns, {}, max_batch_size=3, page_size=page)
+    observed = []
+    real_on_step = engine.telemetry.on_step
+
+    def spy(dt, ewma, live, bucket, pages, active):
+        # Same worker thread: the slot table is consistent here.  Lengths
+        # EXCLUDE the token this step is about to append — the published
+        # figure covers the post-step cache footprint, hence the +1.
+        lengths = [
+            len(s.tokens)
+            for s in engine._slots[:live] if s is not None
+        ]
+        observed.append((int(pages), tuple(lengths)))
+        return real_on_step(dt, ewma, live, bucket, pages, active)
+
+    engine.telemetry.on_step = spy
+    rng = np.random.default_rng(7)
+    reqs = [
+        (
+            rng.integers(1, VOCAB, size=(int(rng.integers(2, 6)),))
+            .astype(np.int32),
+            int(rng.integers(2, 12)),
+        )
+        for _ in range(12)
+    ]
+    try:
+        engine.warm()
+        handles = []
+        for i, (inp, m) in enumerate(reqs):
+            handles.append(engine.submit_nowait(inp, max_new_tokens=m))
+            if i % 4 == 0:
+                time.sleep(0.005)
+        outs = [h.wait(30.0) for h in handles]
+    finally:
+        engine.close()
+    for (inp, m), out in zip(reqs, outs):
+        assert [int(t) for t in out] == ref_stream(inp, m)
+    assert observed, "no decode steps recorded"
+    for pages, lengths in observed:
+        assert pages == sum(-(-(n + 1) // page) for n in lengths)
+    # 12 mixed-budget sequences through 3 slots: some steps ran partially
+    # occupied (retire + move recycled slots mid-schedule).
+    assert any(len(ls) < 3 for _, ls in observed)
+    assert any(len(ls) == 3 for _, ls in observed)
+
+
+def test_engine_speculative_self_draft_exact_and_full_acceptance():
+    """Acceptance (ISSUE 16): with the trivial self-draft (draft == target)
+    every proposal matches the target's greedy choice — 100% acceptance —
+    and the emitted streams reproduce the non-speculative ones exactly."""
+    from tpu_pipelines.observability.metrics import MetricsRegistry
+    from tpu_pipelines.serving.generative import GenerativeEngine
+
+    fns = make_stub_fns()
+    rng = np.random.default_rng(31)
+    reqs = [
+        (
+            rng.integers(1, VOCAB, size=(int(rng.integers(2, 6)),))
+            .astype(np.int32),
+            int(rng.integers(1, 12)),
+        )
+        for _ in range(12)
+    ]
+    for k in (1, 3):
+        reg = MetricsRegistry()
+        engine = GenerativeEngine(
+            fns, {}, max_batch_size=3, page_size=0,
+            spec_tokens=k, registry=reg, replica="0",
+        )
+        try:
+            engine.warm()
+            assert engine.compiles_after_warm == 0
+            handles = [
+                engine.submit_nowait(inp, max_new_tokens=m)
+                for inp, m in reqs
+            ]
+            outs = [h.wait(30.0) for h in handles]
+        finally:
+            engine.close()
+        assert engine.compiles_after_warm == 0
+        for (inp, m), out in zip(reqs, outs):
+            assert [int(t) for t in out] == ref_stream(inp, m)
+        # Self-draft: the verifier can never disagree with its own draft.
+        assert engine.spec_proposed == engine.spec_accepted
+        if k > 1:
+            assert engine.spec_proposed > 0
+            assert reg.get(
+                "serving_decode_spec_accept_ratio"
+            ).labels("0").get() == 1.0
+            assert reg.get(
+                "serving_decode_spec_proposed_total"
+            ).labels("0").get() == engine.spec_proposed
+
+
+def test_engine_all_decode_opts_compose_bitwise():
+    """Prefix cache + chunked prefill + speculative decoding TOGETHER
+    still reproduce the plain engine's streams token for token."""
+    from tpu_pipelines.serving.generative import GenerativeEngine
+
+    fns = make_stub_fns()
+    rng = np.random.default_rng(41)
+    shared = rng.integers(1, VOCAB, size=(4,)).astype(np.int32)
+    reqs = [(shared, int(rng.integers(2, 12)))]
+    reqs += [
+        (
+            rng.integers(1, VOCAB, size=(int(rng.integers(2, 6)),))
+            .astype(np.int32),
+            int(rng.integers(1, 12)),
+        )
+        for _ in range(7)
+    ]
+    reqs += [(shared, int(rng.integers(2, 12))) for _ in range(4)]
+
+    engine = GenerativeEngine(
+        fns, {}, max_batch_size=3, page_size=2,
+        prefix_cache_entries=4, prefill_chunk_pages=1, spec_tokens=2,
+    )
+    try:
+        engine.warm()
+        handles = [
+            engine.submit_nowait(inp, max_new_tokens=m) for inp, m in reqs
+        ]
+        outs = [h.wait(30.0) for h in handles]
+    finally:
+        engine.close()
+    assert engine.compiles_after_warm == 0
+    for (inp, m), out in zip(reqs, outs):
+        assert [int(t) for t in out] == ref_stream(inp, m)
+    assert engine._prefix.hits > 0
+    assert engine.spec_proposed == engine.spec_accepted
+
+
 # ----------------------------------------------------- real-model parity
 
 
@@ -474,6 +782,107 @@ def test_engine_bitwise_identity_vs_isolated_greedy_t5(tiny_t5):
     assert engine.compiles_after_warm == 0
     for out, ref in zip(outs, iso):
         assert [int(t) for t in out] == ref
+
+
+def test_t5_verify_matches_chained_steps(tiny_t5):
+    """The multi-query ``verify`` program (one decoder pass scoring k fed
+    positions through the per-query causal window) agrees with k chained
+    single-token ``step`` calls — same logits up to accumulation order,
+    same argmax."""
+    import jax.numpy as jnp
+
+    from tpu_pipelines.models.t5 import make_continuous_decode_fns
+
+    model, params = tiny_t5
+    L = 8
+    fns = make_continuous_decode_fns(
+        model, max_decode_len=L, eos_id=1, max_input_len=6
+    )
+    inputs = np.asarray([[5, 9, 12, 3, 0, 0]], np.int32)
+    mask = np.asarray([[1, 1, 1, 1, 0, 0]], np.int32)
+    cache0, encoded, logits0 = fns.prefill(params, inputs, mask)
+    t0 = int(np.argmax(np.asarray(logits0)[0]))
+
+    k = 3
+    cache = cache0
+    fed = [t0]
+    step_logits = []
+    for j in range(k):
+        cache, lg = fns.step(
+            params, cache,
+            jnp.asarray([fed[-1]], jnp.int32),
+            jnp.asarray([j + 1], jnp.int32),
+            encoded, mask, L,
+        )
+        step_logits.append(np.asarray(lg)[0])
+        fed.append(int(np.argmax(step_logits[-1])))
+
+    _, vlogits = fns.verify(
+        params, cache0,
+        jnp.asarray([fed[:k]], jnp.int32),
+        jnp.asarray([1], jnp.int32),
+        encoded, mask, L,
+    )
+    vlogits = np.asarray(vlogits)[0]  # [k, V]
+    assert vlogits.shape == (k, np.asarray(logits0).shape[-1])
+    for j in range(k):
+        np.testing.assert_allclose(
+            vlogits[j], step_logits[j], rtol=1e-5, atol=1e-5
+        )
+        assert int(np.argmax(vlogits[j])) == int(np.argmax(step_logits[j]))
+
+
+def test_engine_t5_decode_opts_bitwise_identity(tiny_t5):
+    """Acceptance (ISSUE 16) on a real T5: prefix caching + chunked
+    prefill + self-draft speculative decoding together reproduce isolated
+    greedy streams bitwise, with 100% draft acceptance and zero post-warm
+    compiles."""
+    from tpu_pipelines.models.t5 import (
+        make_continuous_decode_fns,
+        make_greedy_generate,
+    )
+    from tpu_pipelines.serving.generative import GenerativeEngine
+
+    model, params = tiny_t5
+    L = 8
+    fns = make_continuous_decode_fns(
+        model, max_decode_len=L, eos_id=1, max_input_len=6
+    )
+    greedy = make_greedy_generate(model, max_decode_len=L, eos_id=1)
+    rng = np.random.default_rng(3)
+    shared = rng.integers(2, 40, size=(5,)).astype(np.int32)
+    reqs = [shared] + [
+        rng.integers(2, 40, size=(int(rng.integers(2, 7)),)).astype(np.int32)
+        for _ in range(4)
+    ] + [shared, shared]
+    iso = []
+    for r in reqs:
+        toks, _ = greedy(params, r[None], np.ones((1, len(r)), np.int32))
+        row = [int(t) for t in np.asarray(toks)[0]]
+        if 1 in row:
+            row = row[: row.index(1) + 1]
+        iso.append(row)
+
+    engine = GenerativeEngine(
+        fns, params, max_batch_size=4, page_size=0,
+        # Capacity covers every distinct prompt: the shared entry must
+        # survive until its later readers arrive.
+        prefix_cache_entries=8, prefill_chunk_pages=1, spec_tokens=2,
+    )
+    try:
+        engine.warm()
+        handles = [
+            engine.submit_nowait(r, max_new_tokens=L) for r in reqs
+        ]
+        outs = [h.wait(60.0) for h in handles]
+    finally:
+        engine.close()
+    assert engine.compiles_after_warm == 0
+    for out, ref in zip(outs, iso):
+        assert [int(t) for t in out] == ref
+    assert engine._prefix.hits >= 2
+    assert engine.spec_proposed == engine.spec_accepted
+    assert engine.spec_proposed > 0
 
 
 def test_flash_decode_kernel_matches_dense():

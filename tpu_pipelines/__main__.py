@@ -560,9 +560,11 @@ def cmd_lint(args) -> int:
     from tpu_pipelines.analysis import (
         EXIT_GATED,
         analyze_pipeline,
+        check_serving_metric_docs,
         format_findings,
         gated,
         lint_report,
+        sort_findings,
     )
     from tpu_pipelines.utils.module_loader import load_fn
 
@@ -572,6 +574,13 @@ def cmd_lint(args) -> int:
             pipeline,
             spmd_sync=getattr(args, "spmd_sync", False),
             continuous=getattr(args, "continuous", False),
+        )
+        # TPP211 is repo-scoped (serving/ emissions vs the docs/SERVING.md
+        # catalog), not pipeline-scoped — it rides along with every lint so
+        # the same gate catches a decode metric shipped without its catalog
+        # row.
+        findings = sort_findings(
+            list(findings) + check_serving_metric_docs()
         )
     except Exception as e:
         # The module failing to load/compile is a tool error (1), not a

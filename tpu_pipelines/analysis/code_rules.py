@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import ast
 import inspect
+import re
 import textwrap
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set
 
@@ -679,3 +680,92 @@ def _check_module_entry(
                 "the module file before running",
         )]
     return check_callable(fn, node_id, f"{entry} ({module_file})")
+
+
+# ------------------------------------------------- repo-level rules (TPP211)
+
+# A serving_decode_* time-series name as it appears in source: the full
+# string constant is the metric name (not a substring of a longer message).
+_DECODE_METRIC_RE = re.compile(r"serving_decode_[a-z0-9_]+\Z")
+
+
+def check_serving_metric_docs(
+    serving_dir: Optional[str] = None, doc_path: Optional[str] = None
+) -> List[Finding]:
+    """TPP211: every ``serving_decode_*`` metric name emitted under
+    ``serving/`` must be listed in ``docs/SERVING.md``.
+
+    The decode metric catalog in the serving doc is the operator contract —
+    dashboards and the SLO monitor (``observability/slo.py``) are built from
+    it, so a series that ships undocumented is invisible to both.  This is a
+    repo-level check (no pipeline or callable in hand): it AST-walks every
+    ``.py`` under ``serving_dir`` collecting string constants that *are* a
+    ``serving_decode_*`` name and flags any absent from the doc text.
+
+    Defaults resolve against the installed package: ``serving_dir`` is the
+    ``tpu_pipelines/serving`` package directory and ``doc_path`` is
+    ``docs/SERVING.md`` beside the package root — so CI can call this with
+    no arguments and tests can point both at tmp fixtures.  A missing doc
+    file is treated as an empty catalog (everything flags), not an error.
+    Per-line suppression works as for every code rule:
+    ``# tpp: disable=TPP211``.
+    """
+    import os
+
+    if serving_dir is None:
+        import tpu_pipelines.serving as _serving_pkg
+
+        serving_dir = os.path.dirname(os.path.abspath(_serving_pkg.__file__))
+    if doc_path is None:
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(serving_dir)))
+        doc_path = os.path.join(pkg_root, "docs", "SERVING.md")
+    try:
+        with open(doc_path, "r", encoding="utf-8") as fh:
+            doc_text = fh.read()
+    except OSError:
+        doc_text = ""
+
+    out: List[Finding] = []
+    for dirpath, _dirnames, filenames in sorted(os.walk(serving_dir)):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    source = fh.read()
+                tree = ast.parse(source)
+            except (OSError, SyntaxError):
+                continue
+            lines = source.splitlines()
+            seen_here: Set[str] = set()
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)):
+                    continue
+                name = node.value
+                if not _DECODE_METRIC_RE.match(name):
+                    continue
+                if name in doc_text or name in seen_here:
+                    continue
+                line_no = getattr(node, "lineno", 0)
+                text = lines[line_no - 1] if 0 < line_no <= len(lines) else ""
+                if suppressed_in_source(text, "TPP211"):
+                    continue
+                seen_here.add(name)
+                out.append(Finding(
+                    rule="TPP211", severity=WARN,
+                    node_id="<serving>",
+                    message=(
+                        f"metric {name!r} is emitted here but not listed "
+                        "in docs/SERVING.md — the decode metric catalog "
+                        "is the operator contract; an undocumented "
+                        "series is invisible to dashboards and the SLO "
+                        "monitor"
+                    ),
+                    file=path, line=line_no,
+                    fix=f"add {name!r} to the metric catalog table in "
+                        "docs/SERVING.md (name, type, labels, meaning)",
+                ))
+    return out

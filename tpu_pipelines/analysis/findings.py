@@ -160,6 +160,14 @@ RULES: Dict[str, Dict[str, str]] = {
                  "and drops the rows it doesn't feed, the silent "
                  "multi-chip input tax",
     },
+    "TPP211": {
+        "severity": WARN,
+        "title": "serving_decode_* metric emitted in serving/ but not "
+                 "listed in docs/SERVING.md — the decode metric catalog "
+                 "is the operator contract (dashboards and the SLO "
+                 "monitor are built from it); an undocumented series is "
+                 "invisible to both",
+    },
 }
 
 GRAPH_RULE_PREFIX = "TPP1"

@@ -429,6 +429,7 @@ class MultiHeadAttention(nn.Module):
                 (b, max_decode_len, self.n_heads, self.head_dim), v.dtype,
             )
             pos = jnp.asarray(decode_pos, jnp.int32)
+            verify_window = False
             if pos.ndim == 0:
                 cached_k.value = jax.lax.dynamic_update_slice_in_dim(
                     cached_k.value, k, pos, axis=1
@@ -441,12 +442,41 @@ class MultiHeadAttention(nn.Module):
                     (jnp.arange(max_decode_len) <= pos)[None, :],
                     (b, max_decode_len),
                 )
-            else:
+            elif q.shape[1] == 1:
                 rows = jnp.arange(b)
                 cached_k.value = cached_k.value.at[rows, pos].set(k[:, 0])
                 cached_v.value = cached_v.value.at[rows, pos].set(v[:, 0])
                 valid = jnp.arange(max_decode_len)[None, :] <= pos[:, None]
+            else:
+                # Speculative verify: ``qlen`` candidate tokens per row,
+                # row i's queries occupying positions
+                # ``pos[i] .. pos[i]+qlen-1`` — one scatter of a window
+                # per row, then per-QUERY causal validity (query j sees
+                # cache positions <= pos+j).  dense_attention's kv_mask
+                # is per-row, so the per-query window folds into the
+                # additive bias instead; same NEG_INF -> exact-zero
+                # weight semantics as every other mask here.
+                from tpu_pipelines.parallel.ring_attention import NEG_INF
+
+                rows = jnp.arange(b)
+                qlen = q.shape[1]
+                idx = pos[:, None] + jnp.arange(qlen)[None, :]  # [b, q]
+                cached_k.value = cached_k.value.at[rows[:, None], idx].set(k)
+                cached_v.value = cached_v.value.at[rows[:, None], idx].set(v)
+                win = (
+                    jnp.arange(max_decode_len)[None, None, :]
+                    <= idx[:, :, None]
+                )                                               # [b, q, kv]
+                wbias = jnp.where(win, 0.0, NEG_INF)[:, None]   # [b,1,q,kv]
+                bias = wbias if bias is None else bias + wbias
+                valid = None
+                verify_window = True
             impl = self.attn_impl
+            if verify_window:
+                # flash_decode_attention is a single-query kernel; the
+                # verify window runs dense (it is one fused step per
+                # round, not the per-token hot path).
+                impl = "dense"
             if impl == "auto":
                 # Decode-regime choice: the single-query step is bandwidth-
                 # bound on the KV cache, a different balance from training

@@ -191,10 +191,31 @@ class SLOMonitor:
                 "serving_decode_compiles_after_warm_total"
             ).values()
         )
+        # Decode-speed lever counters (informational, not burn inputs):
+        # windowed deltas let an operator read prefix-hit and speculative
+        # acceptance rates off the same evaluate() table the bench drill
+        # records as evidence.
+        prefix_hits = sum(
+            int(v) for v in series("serving_decode_prefix_hit_total").values()
+        )
+        prefix_misses = sum(
+            int(v)
+            for v in series("serving_decode_prefix_miss_total").values()
+        )
+        spec_proposed = sum(
+            int(v)
+            for v in series("serving_decode_spec_proposed_total").values()
+        )
+        spec_accepted = sum(
+            int(v)
+            for v in series("serving_decode_spec_accept_total").values()
+        )
         return {
             "lat_total": lat_total, "lat_bad": lat_bad,
             "req_total": req_total, "err_5xx": err_5xx,
             "shed": shed, "compiles": compiles,
+            "prefix_hits": prefix_hits, "prefix_misses": prefix_misses,
+            "spec_proposed": spec_proposed, "spec_accepted": spec_accepted,
         }
 
     # ------------------------------------------------------------ evaluate

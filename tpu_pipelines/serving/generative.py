@@ -46,16 +46,61 @@ static-shape substrate):
     evicts a sequence that blows it (``GenerationEvicted``), freeing its
     slot for work that can still meet SLO.
 
+Decode optimisations (ISSUE 16) — three composable levers behind the
+same ``make_decode_fns`` contract, each off by default:
+
+  * **Prefix caching** (``prefix_cache_entries > 0``).  Prompts are
+    hashed as a chain of ``page_size``-granular token blocks
+    (:meth:`PrefixCache.key_of`); a full-chain hit means an identical
+    (masked-inputs, mask) prompt already ran prefill, so ``_admit``
+    reuses the cached device-resident prefill result — cache row,
+    encoder output, first token — and skips the encoder pass entirely.
+    Entries are REFCOUNTED: every live sequence admitted from an entry
+    holds a reader reference, and an entry's pages are freed only when
+    its last reader retires (LRU eviction considers only entries with
+    zero readers).  Hits are bitwise-exact: the cached arrays are the
+    actual outputs of the same compiled prefill program on the same
+    input, so greedy logits equal the uncached path exactly (the ~1 ulp
+    cross-KV-bucket caveat above is unchanged).
+  * **Chunked prefill** (``prefill_chunk_pages > 0``).  Admission work
+    is metered in prompt pages: each decode step earns the scheduler
+    ``prefill_chunk_pages`` credits, and an admission costs the prompt's
+    page count (1 for a prefix-cache hit) — so a burst of long-prompt
+    arrivals is spread across decode steps instead of running
+    back-to-back and stalling every live sequence's token deadline.  On
+    this substrate one prompt's prefill is a single device program (the
+    encoder is bidirectional — not token-chunkable without changing the
+    math), so chunking bounds the admission work *between* steps; the
+    compiled programs are identical with the knob on or off, which keeps
+    token streams bitwise-identical either way.
+  * **Speculative decoding** (``spec_tokens k > 0``).  A draft model
+    (any ``make_decode_fns`` contract sharing the target's geometry;
+    ``draft_fns=None`` means self-draft — the target drafts for itself,
+    the trivial 100%%-acceptance case) runs ``k`` chained steps on its
+    own mirrored arena, then the target scores all ``k`` fed positions
+    (current token + the first k-1 proposals) in ONE bucketed program
+    (``fns.verify`` when the contract exports it, e.g. ``models/t5.py``;
+    otherwise ``k`` fused ``fns.step`` launches — same math) and
+    the engine emits the accepted prefix plus the target's own token at
+    the first mismatch — every emitted token is either verified equal to
+    the target's greedy choice or IS the target's greedy choice, so a
+    wrong draft costs speed, never correctness.  Rejected tail KV needs
+    no rollback: position validity masks it at exact zero weight and
+    later writes overwrite it.  Acceptance counters join the
+    ``serving_decode_*`` family (``serving_decode_spec_accept_*``).
+
 Metrics (``serving_decode_*``, labeled per replica; catalog in
 docs/SERVING.md): steps/s, tokens/s, batch occupancy, cache pages in
 use, active/queued sequences + outstanding tokens, per-token latency
-histogram, evictions, step-time EWMA (what the router reads).
+histogram, evictions, step-time EWMA (what the router reads), prefix
+cache hits/misses/resident pages, speculative proposals/acceptances.
 """
 
 from __future__ import annotations
 
 import collections
 import contextlib
+import hashlib
 import logging
 import threading
 import time
@@ -109,6 +154,9 @@ class _Sequence:
     # on the originating request's trace.
     ctx: Any = None
     arrival_wall_s: float = 0.0
+    # Prefix-cache entry this live sequence holds a reader reference on
+    # (None = admitted without the cache, or reference already released).
+    prefix_entry: Any = None
 
     def finish(self, error: Optional[BaseException] = None) -> None:
         if self._done.is_set():
@@ -132,6 +180,10 @@ def kv_bucket_sizes(max_decode_len: int, page_size: int) -> List[int]:
     full cache.  ``page_size <= 0`` means one bucket — the whole cache —
     which is also the bitwise-exact mode (see module docstring)."""
     max_decode_len = int(max_decode_len)
+    if max_decode_len <= 0:
+        raise ValueError(
+            f"max_decode_len must be positive, got {max_decode_len}"
+        )
     if page_size <= 0 or page_size >= max_decode_len:
         return [max_decode_len]
     out = []
@@ -147,6 +199,134 @@ def _is_enc_leaf(path) -> bool:
     """Cross-attention K/V leaves keep the ENCODER length on axis 1 (not
     the decode cache length) and are never written by a decode step."""
     return any("cached_enc" in str(getattr(p, "key", p)) for p in path)
+
+
+class _PrefixEntry:
+    """One cached prompt prefix: the device-resident prefill result plus
+    refcount/LRU bookkeeping.  ``pages`` is the prompt's page-granular
+    block count — the unit ``serving_decode_prefix_pages_in_use``
+    reports and admission credits are charged in."""
+
+    __slots__ = (
+        "key", "pages", "readers", "tok0", "cache", "encoded",
+        "draft_cache", "draft_encoded", "tick",
+    )
+
+    def __init__(self, key, pages, tok0, cache, encoded,
+                 draft_cache=None, draft_encoded=None):
+        self.key = key
+        self.pages = int(pages)
+        self.readers = 0
+        self.tok0 = int(tok0)
+        self.cache = cache
+        self.encoded = encoded
+        self.draft_cache = draft_cache
+        self.draft_encoded = draft_encoded
+        self.tick = 0
+
+
+class PrefixCache:
+    """Refcounted cache of prefill results keyed by page-granular block
+    hashes of the prompt.
+
+    The key is a CHAIN of block hashes — block ``i``'s digest folds the
+    previous block's digest with ``page`` positions of (masked-inputs,
+    mask) — so two prompts collide only when every block matches, i.e.
+    the model-visible prompt is identical (masked positions are zeroed
+    before hashing: their values never reach a logit — padding K/V is
+    masked at exact zero weight — so they must not split the key).
+
+    Refcounting is the page-lifetime contract: every live sequence
+    admitted from an entry holds a reader reference, ``trim`` may evict
+    only entries with ZERO readers (LRU among those), and an over-
+    capacity entry is therefore freed exactly when its last reader
+    retires.  Single-threaded by design: the engine's worker thread owns
+    every lookup/insert/acquire, and release happens on the worker or
+    after it has been joined (``close``)."""
+
+    def __init__(self, capacity: int, page: int):
+        self.capacity = max(1, int(capacity))
+        self.page = max(1, int(page))
+        self._entries: Dict[bytes, _PrefixEntry] = {}
+        self._tick = 0
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def key_of(
+        inputs: np.ndarray, input_mask: np.ndarray, page: int
+    ) -> Tuple[bytes, int]:
+        """(chain-tip digest, valid-prefix page count) for one padded
+        prompt.  Hashing covers the full padded width so mask structure
+        (including interior zeros, which shift relative positions) is
+        part of the identity; the page count covers only valid tokens —
+        the prefill work a hit actually skips."""
+        page = max(1, int(page))
+        mask = (np.asarray(input_mask) > 0)
+        toks = np.asarray(inputs, np.int64) * mask
+        m8 = mask.astype(np.int8)
+        n_valid = int(mask.sum())
+        pages = max(1, -(-n_valid // page))
+        h = b""
+        for i in range(0, max(toks.size, 1), page):
+            h = hashlib.blake2b(
+                h + toks[i:i + page].tobytes() + m8[i:i + page].tobytes(),
+                digest_size=16,
+            ).digest()
+        return h, pages
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def peek(self, key: bytes) -> Optional[_PrefixEntry]:
+        return self._entries.get(key)
+
+    def touch(self, entry: _PrefixEntry) -> None:
+        self._tick += 1
+        entry.tick = self._tick
+
+    def insert(
+        self, key, pages, tok0, cache, encoded,
+        draft_cache=None, draft_encoded=None,
+    ) -> _PrefixEntry:
+        entry = self._entries.get(key)
+        if entry is None:
+            entry = _PrefixEntry(
+                key, pages, tok0, cache, encoded, draft_cache, draft_encoded
+            )
+            self._entries[key] = entry
+        self.touch(entry)
+        self.trim()
+        return entry
+
+    def acquire(self, entry: _PrefixEntry) -> None:
+        entry.readers += 1
+
+    def release(self, entry: _PrefixEntry) -> None:
+        entry.readers = max(0, entry.readers - 1)
+        self.trim()
+
+    def trim(self) -> None:
+        """Evict LRU zero-reader entries down to capacity.  Entries with
+        live readers are PINNED — the cache may run over capacity while
+        readers hold pages, and shrinks the moment the last one lets go.
+        The most-recently-touched entry is never the victim: without that
+        rule a fresh insert into a cache whose capacity is held by pinned
+        entries would evict ITSELF (it is the only zero-reader), killing
+        the hot prompt's residency exactly when sharing is highest."""
+        while len(self._entries) > self.capacity:
+            newest = max(self._entries.values(), key=lambda e: e.tick)
+            victims = [
+                e for e in self._entries.values()
+                if e.readers == 0 and e is not newest
+            ]
+            if not victims:
+                return
+            victim = min(victims, key=lambda e: e.tick)
+            del self._entries[victim.key]
+
+    def pages_in_use(self) -> int:
+        return sum(e.pages for e in self._entries.values())
 
 
 class GenerativeEngine:
@@ -175,6 +355,11 @@ class GenerativeEngine:
         max_queue_tokens: int = 0,
         slo_ms_per_token: float = 0.0,
         hard_deadline: bool = False,
+        prefix_cache_entries: int = 0,
+        prefill_chunk_pages: int = 0,
+        spec_tokens: int = 0,
+        draft_fns: Any = None,
+        draft_params: Any = None,
         device: Any = None,
         telemetry: Optional["DecodeTelemetry"] = None,
         registry=None,
@@ -198,6 +383,45 @@ class GenerativeEngine:
             self.page_size if 0 < self.page_size < self.max_decode_len
             else self.max_decode_len
         )
+        # Prompt-side page unit (prefix hashing + admission credits):
+        # the configured page size, or the whole prompt when unpaged.
+        self._ppage = (
+            self.page_size if self.page_size > 0 else self.max_input_len
+        )
+        self.prefix_cache_entries = max(0, int(prefix_cache_entries))
+        self._prefix = (
+            PrefixCache(self.prefix_cache_entries, self._ppage)
+            if self.prefix_cache_entries > 0 else None
+        )
+        self.prefill_chunk_pages = max(0, int(prefill_chunk_pages))
+        self._admit_credits = 0
+        self.spec_tokens = max(0, int(spec_tokens))
+        self._spec = self.spec_tokens > 0
+        if self._spec:
+            # draft_fns=None = self-draft: the target proposes for itself
+            # on a mirrored arena — zero speedup, 100% acceptance, the
+            # machinery's trivial correctness case.
+            self.draft_fns = draft_fns if draft_fns is not None else fns
+            self.draft_params = (
+                draft_params if draft_params is not None else params
+            )
+            d = self.draft_fns
+            if (
+                int(d.max_decode_len) != self.max_decode_len
+                or int(d.eos_id) != self.eos_id
+                or int(d.pad_id) != self.pad_id
+                or int(getattr(d, "max_input_len", self.max_input_len))
+                != self.max_input_len
+            ):
+                raise ValueError(
+                    "draft decode contract must share the target's "
+                    "geometry (max_decode_len/eos_id/pad_id/max_input_len)"
+                )
+        else:
+            self.draft_fns = None
+            self.draft_params = None
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         self.telemetry = telemetry or DecodeTelemetry(registry, replica)
 
         self._lock = threading.Lock()
@@ -219,6 +443,16 @@ class GenerativeEngine:
         self._jit_insert = None
         self._jit_move = None
         self._jit_clear = None
+        self._jit_accept = None
+        # Draft lane (speculative decoding): a second arena mirroring
+        # every slot, stepped by the draft contract's own programs.
+        self._d_arena = None
+        self._d_step_fns: Dict[Tuple[int, int], Any] = {}
+        self._verify_fns: Dict[Tuple[int, int], Any] = {}
+        self._d_jit_prefill = None
+        self._d_jit_insert = None
+        self._d_jit_move = None
+        self._d_jit_clear = None
 
         self._worker = threading.Thread(target=self._run, daemon=True)
         self._worker.start()
@@ -234,11 +468,11 @@ class GenerativeEngine:
 
     # ------------------------------------------------------- compiled fns
 
-    def _build_jits(self) -> None:
+    def _lane_jits(self, fns) -> Tuple[Any, Any, Any, Any]:
+        """(prefill, insert, move, clear) jits for one decode contract —
+        the target lane always, plus the draft lane when speculative."""
         import jax
         import jax.numpy as jnp
-
-        fns = self.fns
 
         def prefill(params, inputs, input_mask):
             cache, encoded, logits = fns.prefill(params, inputs, input_mask)
@@ -276,16 +510,53 @@ class GenerativeEngine:
                 mask,
             )
 
-        self._jit_prefill = jax.jit(prefill)
-        self._jit_insert = jax.jit(insert)
-        self._jit_move = jax.jit(move)
-        self._jit_clear = jax.jit(clear)
+        return (
+            jax.jit(prefill), jax.jit(insert), jax.jit(move), jax.jit(clear)
+        )
 
-    def _build_step(self, b: int, kv: int):
+    def _build_jits(self) -> None:
+        import jax
+
+        (
+            self._jit_prefill, self._jit_insert,
+            self._jit_move, self._jit_clear,
+        ) = self._lane_jits(self.fns)
+        if self._spec:
+            (
+                self._d_jit_prefill, self._d_jit_insert,
+                self._d_jit_move, self._d_jit_clear,
+            ) = self._lane_jits(self.draft_fns)
+
+        import jax.numpy as jnp
+
+        def accept(state, new_tok, new_pos):
+            # Speculative accept / step-sync: replace the whole tok/pos
+            # vectors with host-composed values (dead rows carry
+            # pad_id/0, matching clear's convention), and SCRUB cache
+            # positions >= new_pos to exact zero.  Attention already
+            # masks those positions, so for a masked contract this is a
+            # value-level no-op (kept entries multiply by 1) — but it
+            # makes "rejected speculative KV never reaches a logit" an
+            # enforced invariant of the arena rather than a property
+            # each decode contract must supply.
+            cache, tok, pos, live, enc, mask = state
+
+            def scrub(path, a):
+                if _is_enc_leaf(path):
+                    return a
+                valid = jnp.arange(a.shape[1]) < new_pos[:, None]
+                v = valid.reshape(valid.shape + (1,) * (a.ndim - 2))
+                return a * v.astype(a.dtype)
+
+            cache = jax.tree_util.tree_map_with_path(scrub, cache)
+            return (cache, new_tok, new_pos, live, enc, mask)
+
+        self._jit_accept = jax.jit(accept)
+
+    def _build_step(self, b: int, kv: int, fns):
         import jax
         import jax.numpy as jnp
 
-        fns = self.fns
         pad = self.pad_id
 
         def run(params, state):
@@ -309,8 +580,53 @@ class GenerativeEngine:
 
         return jax.jit(run)
 
-    def _step_for(self, b: int, kv: int):
-        fn = self._step_fns.get((b, kv))
+    def _build_verify(self, b: int, kv: int):
+        """One bucketed target-verify program: score ``k = spec_tokens``
+        candidate positions in ONE device step via the contract's
+        ``verify`` (or ``k`` fused single-steps when the contract lacks
+        it — same math, k launches).  Returns the updated cache plus
+        greedy picks ``g[b, k]`` where ``g[:, j]`` is the target's choice
+        at position ``pos + j`` given the fed tokens."""
+        import jax
+        import jax.numpy as jnp
+
+        fns = self.fns
+        k = self.spec_tokens
+        verify = getattr(fns, "verify", None)
+
+        def run(params, state, toks):
+            # toks[b, k]: column 0 is each row's current last emitted
+            # token, columns 1..k-1 the draft's first k-1 proposals.
+            cache, tok, pos, live, encoded, enc_mask = state
+            sub = jax.tree_util.tree_map_with_path(
+                lambda p, x: x[:b] if _is_enc_leaf(p) else x[:b, :kv], cache
+            )
+            if verify is not None:
+                new_sub, logits = verify(
+                    params, sub, toks[:b], pos[:b],
+                    encoded[:b], enc_mask[:b], kv,
+                )
+            else:
+                outs = []
+                new_sub = sub
+                for j in range(k):
+                    new_sub, lg = fns.step(
+                        params, new_sub, toks[:b, j], pos[:b] + j,
+                        encoded[:b], enc_mask[:b], kv,
+                    )
+                    outs.append(lg)
+                logits = jnp.stack(outs, axis=1)
+            g = jnp.argmax(logits, -1).astype(jnp.int32)  # [b, k]
+            cache = jax.tree_util.tree_map_with_path(
+                lambda p, a, n: a if _is_enc_leaf(p) else a.at[:b, :kv].set(n),
+                cache, new_sub,
+            )
+            return (cache, tok, pos, live, encoded, enc_mask), g
+
+        return jax.jit(run)
+
+    def _program_for(self, cache, build, kind, b: int, kv: int):
+        fn = cache.get((b, kv))
         if fn is None:
             if self._warmed:
                 # The warmup contract: every (batch, kv) bucket program is
@@ -320,12 +636,30 @@ class GenerativeEngine:
                 self.compiles_after_warm += 1
                 self.telemetry.on_compile_after_warm()
                 log.warning(
-                    "generative engine: compiling step (%d, %d) AFTER "
-                    "warmup — bucket missed by warm()", b, kv,
+                    "generative engine: compiling %s (%d, %d) AFTER "
+                    "warmup — bucket missed by warm()", kind, b, kv,
                 )
-            fn = self._build_step(b, kv)
-            self._step_fns[(b, kv)] = fn
+            fn = build(b, kv)
+            cache[(b, kv)] = fn
         return fn
+
+    def _step_for(self, b: int, kv: int):
+        return self._program_for(
+            self._step_fns, lambda b, kv: self._build_step(b, kv, self.fns),
+            "step", b, kv,
+        )
+
+    def _d_step_for(self, b: int, kv: int):
+        return self._program_for(
+            self._d_step_fns,
+            lambda b, kv: self._build_step(b, kv, self.draft_fns),
+            "draft step", b, kv,
+        )
+
+    def _verify_for(self, b: int, kv: int):
+        return self._program_for(
+            self._verify_fns, self._build_verify, "verify", b, kv,
+        )
 
     # ------------------------------------------------------------- arena
 
@@ -354,22 +688,32 @@ class GenerativeEngine:
             self.params = jax.device_put(self.params, dev)
             zin = jnp.full((1, self.max_input_len), self.pad_id, jnp.int32)
             zmask = jnp.zeros((1, self.max_input_len), jnp.int32)
-            cache1, encoded1, _ = self._jit_prefill(self.params, zin, zmask)
             B = self.max_batch_size
-            cache = jax.tree_util.tree_map(
-                lambda x: jnp.zeros((B,) + x.shape[1:], x.dtype), cache1
-            )
-            # Free rows keep an all-ONES encoder mask: cross-attention over
-            # their zero K/V then averages zeros instead of softmaxing an
-            # all-masked row into NaN.  Live rows overwrite it on insert.
-            self._arena = jax.device_put((
-                cache,
-                jnp.full((B,), self.pad_id, jnp.int32),
-                jnp.zeros((B,), jnp.int32),
-                jnp.zeros((B,), bool),
-                jnp.zeros((B,) + encoded1.shape[1:], encoded1.dtype),
-                jnp.ones((B, self.max_input_len), jnp.int32),
-            ), dev)
+
+            def blank_arena(prefill_jit, params):
+                cache1, encoded1, _ = prefill_jit(params, zin, zmask)
+                cache = jax.tree_util.tree_map(
+                    lambda x: jnp.zeros((B,) + x.shape[1:], x.dtype), cache1
+                )
+                # Free rows keep an all-ONES encoder mask: cross-attention
+                # over their zero K/V then averages zeros instead of
+                # softmaxing an all-masked row into NaN.  Live rows
+                # overwrite it on insert.
+                return jax.device_put((
+                    cache,
+                    jnp.full((B,), self.pad_id, jnp.int32),
+                    jnp.zeros((B,), jnp.int32),
+                    jnp.zeros((B,), bool),
+                    jnp.zeros((B,) + encoded1.shape[1:], encoded1.dtype),
+                    jnp.ones((B, self.max_input_len), jnp.int32),
+                ), dev)
+
+            self._arena = blank_arena(self._jit_prefill, self.params)
+            if self._spec:
+                self.draft_params = jax.device_put(self.draft_params, dev)
+                self._d_arena = blank_arena(
+                    self._d_jit_prefill, self.draft_params
+                )
 
     def warm(self) -> None:
         """Pre-compile every program traffic can pose: prefill, insert /
@@ -388,14 +732,44 @@ class GenerativeEngine:
             cache1, encoded1, tok0 = self._jit_prefill(
                 self.params, zin, zmask
             )
+            # tok0 goes to insert as a HOST int32: the prefix-cache hit
+            # path has only the entry's host token, and warm/miss/hit
+            # must all land on the same insert program cache key.
             self._jit_insert(
-                self._arena, cache1, encoded1, zmask, tok0, np.int32(0)
+                self._arena, cache1, encoded1, zmask,
+                np.int32(int(tok0)), np.int32(0),
             )
             self._jit_move(self._arena, np.int32(0), np.int32(0))
             self._jit_clear(self._arena, np.int32(0))
             for b in self.batch_buckets:
                 for kv in self.kv_buckets:
                     self._step_for(b, kv)(self.params, self._arena)
+            B = self.max_batch_size
+            ztok = np.full((B,), self.pad_id, np.int32)
+            zpos = np.zeros((B,), np.int32)
+            self._jit_accept(self._arena, ztok, zpos)
+            if self._spec:
+                dc1, de1, dt0 = self._d_jit_prefill(
+                    self.draft_params, zin, zmask
+                )
+                self._d_jit_insert(
+                    self._d_arena, dc1, de1, zmask,
+                    np.int32(int(dt0)), np.int32(0),
+                )
+                self._d_jit_move(self._d_arena, np.int32(0), np.int32(0))
+                self._d_jit_clear(self._d_arena, np.int32(0))
+                self._jit_accept(self._d_arena, ztok, zpos)
+                zk = np.full(
+                    (B, self.spec_tokens), self.pad_id, np.int32
+                )
+                for b in self.batch_buckets:
+                    for kv in self.kv_buckets:
+                        self._d_step_for(b, kv)(
+                            self.draft_params, self._d_arena
+                        )
+                        self._verify_for(b, kv)(
+                            self.params, self._arena, zk
+                        )
         self._warmed = True
 
     # ------------------------------------------------------------- client
@@ -513,6 +887,7 @@ class GenerativeEngine:
             self._n_live = 0
             self._slots = [None] * self.max_batch_size
         for seq in pending:
+            self._release_prefix(seq)
             self._trace_end(seq, "evicted")
             seq.finish(GenerationEvicted("engine closed"))
 
@@ -532,7 +907,20 @@ class GenerativeEngine:
                         return
                 self._admit()
                 if self._n_live:
-                    self._step_once()
+                    self._decode_round()
+                    if self.prefill_chunk_pages > 0:
+                        # Each decode round EARNS admission credits
+                        # (chunked prefill's meter), capped at one full
+                        # prompt so idle decode can't bank a stall-sized
+                        # prefill burst.
+                        cap = max(
+                            self.prefill_chunk_pages,
+                            -(-self.max_input_len // self._ppage),
+                        )
+                        self._admit_credits = min(
+                            cap,
+                            self._admit_credits + self.prefill_chunk_pages,
+                        )
         except Exception as e:  # noqa: BLE001 — device fault: fail loudly
             log.exception("generative engine worker died")
             with self._lock:
@@ -542,44 +930,241 @@ class GenerativeEngine:
                 self._queue.clear()
                 self._n_live = 0
             for seq in pending:
+                self._release_prefix(seq)
                 self._trace_end(seq, "error")
                 seq.finish(e)
+
+    def _decode_round(self) -> None:
+        """One scheduling round: a speculative draft/verify round when
+        enabled and every live position has ``spec_tokens`` of cache
+        headroom, else one fused single-token step."""
+        if self._spec:
+            n = self._n_live
+            deepest = max(
+                len(s.tokens) for s in self._slots[:n] if s is not None
+            )
+            if deepest + self.spec_tokens <= self.max_decode_len:
+                self._spec_round()
+                return
+        self._step_once()
+
+    def _prompt_pages(self, seq: _Sequence) -> int:
+        n_valid = int((seq.input_mask > 0).sum())
+        return max(1, -(-n_valid // self._ppage))
 
     def _admit(self) -> None:
         """Iteration-level admission: fill free slots from the queue NOW —
         between two decode steps — instead of waiting for the batch to
         drain.  One prefill (encoder + step-0 decode, the greedy math)
-        per admitted sequence, then one scatter into the arena."""
+        per admitted sequence — or an arena scatter alone when the
+        prefix cache already holds this prompt — metered by chunked-
+        prefill credits when live sequences could starve."""
         while True:
             with self._lock:
                 if not self._queue or self._n_live >= self.max_batch_size:
                     return
-                seq = self._queue.popleft()
+                seq = self._queue[0]
+                entry = key = None
+                if self._prefix is not None:
+                    key, pages = PrefixCache.key_of(
+                        seq.inputs, seq.input_mask, self._ppage
+                    )
+                    entry = self._prefix.peek(key)
+                else:
+                    pages = self._prompt_pages(seq)
+                cost = 1 if entry is not None else pages
+                if (
+                    self.prefill_chunk_pages > 0
+                    and self._n_live > 0
+                    and cost > self._admit_credits
+                ):
+                    # Not enough credits between steps: leave the head
+                    # queued, decode earns more, admission resumes next
+                    # round — a long prompt never skips a live
+                    # sequence's token deadline.
+                    return
+                self._queue.popleft()
+                if self.prefill_chunk_pages > 0 and self._n_live > 0:
+                    self._admit_credits -= cost
             with self._dev():
                 self._ensure_arena()
-                cache1, enc1, tok0 = self._jit_prefill(
-                    self.params, seq.inputs[None], seq.input_mask[None]
-                )
-                t0 = int(tok0)
+                d_cache1 = d_enc1 = None
+                if entry is not None:
+                    self._prefix.hits += 1
+                    self._prefix.touch(entry)
+                    self.telemetry.on_prefix_hit(entry.pages)
+                    cache1, enc1 = entry.cache, entry.encoded
+                    d_cache1, d_enc1 = entry.draft_cache, entry.draft_encoded
+                    t0 = entry.tok0
+                else:
+                    cache1, enc1, tok0 = self._jit_prefill(
+                        self.params, seq.inputs[None], seq.input_mask[None]
+                    )
+                    t0 = int(tok0)
+                    if self._spec:
+                        d_cache1, d_enc1, _ = self._d_jit_prefill(
+                            self.draft_params,
+                            seq.inputs[None], seq.input_mask[None],
+                        )
+                    if self._prefix is not None:
+                        self._prefix.misses += 1
+                        self.telemetry.on_prefix_miss()
+                        entry = self._prefix.insert(
+                            key, pages, t0, cache1, enc1, d_cache1, d_enc1
+                        )
                 seq.tokens.append(t0)
                 if t0 == self.eos_id or seq.max_new_tokens <= 1:
+                    if self._prefix is not None:
+                        self.telemetry.on_prefix_pages(
+                            self._prefix.pages_in_use()
+                        )
                     self._complete(seq)
                     continue
+                if entry is not None:
+                    self._prefix.acquire(entry)
+                    seq.prefix_entry = entry
+                    self.telemetry.on_prefix_pages(
+                        self._prefix.pages_in_use()
+                    )
                 slot = self._n_live
                 self._arena = self._jit_insert(
-                    self._arena, cache1, enc1, seq.input_mask[None], tok0,
-                    np.int32(slot),
+                    self._arena, cache1, enc1, seq.input_mask[None],
+                    np.int32(t0), np.int32(slot),
                 )
+                if self._spec:
+                    # The draft lane mirrors the slot: its own prefill
+                    # cache, but the TARGET's first token — the draft
+                    # always consumes the verified stream.
+                    self._d_arena = self._d_jit_insert(
+                        self._d_arena, d_cache1, d_enc1,
+                        seq.input_mask[None], np.int32(t0), np.int32(slot),
+                    )
             if seq.ctx is not None:
                 # Slot event: the sequence joined the continuous batch —
                 # the wait it paid in the queue is arrival -> now.
                 seq.ctx.span_from_mono(
                     "decode.join", seq.arrival_s,
                     slot=slot, budget_tokens=seq.max_new_tokens,
+                    prefix_hit=seq.prefix_entry is not None,
                 )
             with self._lock:
                 self._slots[slot] = seq
                 self._n_live += 1
+
+    def _spec_round(self) -> None:
+        """One speculative round: ``k`` chained draft steps propose,
+        ONE bucketed target program scores all ``k`` fed positions
+        (``_build_verify``), and each row emits the accepted draft
+        prefix plus the target's own token at the first mismatch —
+        1..k verified-greedy tokens per target step.  The k-th draft
+        proposal is never judged (the verify window is full): its step
+        runs anyway so the draft cache covers every position the round
+        can emit — without it the draft lane keeps a permanent KV hole
+        at the last emitted position and acceptance collapses.
+        Rejected-tail KV in both arenas is scrubbed to exact zero by
+        the accept program (see ``_build_jits``)."""
+        n = self._n_live
+        k = self.spec_tokens
+        b = next(bk for bk in self.batch_buckets if bk >= n)
+        deepest = max(
+            len(s.tokens) for s in self._slots[:n] if s is not None
+        )
+        kv = next(kb for kb in self.kv_buckets if kb >= deepest + k)
+        B = self.max_batch_size
+        toks = np.full((B, k), self.pad_id, np.int32)
+        for i in range(n):
+            s = self._slots[i]
+            if s is not None:
+                toks[i, 0] = s.tokens[-1]
+        t_start = time.perf_counter()
+        with self._dev():
+            d_fn = self._d_step_for(b, kv)
+            for j in range(1, k + 1):
+                self._d_arena, nxt = d_fn(self.draft_params, self._d_arena)
+                if j < k:
+                    toks[:b, j] = np.asarray(nxt)
+            self._arena, g = self._verify_for(b, kv)(
+                self.params, self._arena, toks
+            )
+            gh = np.asarray(g)  # [b, k] — the device->host sync
+        dt = time.perf_counter() - t_start
+        if self.step_ewma_s is None:
+            self.step_ewma_s = dt
+        else:
+            a_ = self.STEP_EWMA_ALPHA
+            self.step_ewma_s = (1 - a_) * self.step_ewma_s + a_ * dt
+        self.steps_run += 1
+        now = time.monotonic()
+        proposed = accepted = 0
+        new_tok = np.full((B,), self.pad_id, np.int32)
+        new_pos = np.zeros((B,), np.int32)
+        for i in range(n):
+            seq = self._slots[i]
+            a = 0
+            while a < k - 1 and toks[i, a + 1] == gh[i, a]:
+                a += 1
+            proposed += k - 1
+            accepted += a
+            emitted = 0
+            for j in range(a + 1):
+                t = int(gh[i, j])
+                seq.tokens.append(t)
+                emitted += 1
+                self.telemetry.on_token()
+                if (
+                    t == self.eos_id
+                    or len(seq.tokens) >= seq.max_new_tokens
+                ):
+                    break
+            new_tok[i] = seq.tokens[-1]
+            new_pos[i] = len(seq.tokens)
+            if seq.ctx is not None:
+                seq.ctx.instant(
+                    "decode.spec", slot=i, token=len(seq.tokens),
+                    accepted=a, emitted=emitted,
+                    batch_bucket=b, kv_bucket=kv, live=n,
+                    step_s=round(dt, 6),
+                )
+        self.spec_proposed += proposed
+        self.spec_accepted += accepted
+        self.telemetry.on_spec(proposed, accepted)
+        pages = sum(
+            -(-(len(s.tokens) + 1) // self._page)
+            for s in self._slots[:n] if s is not None
+        )
+        self.telemetry.on_step(dt, self.step_ewma_s, n, b, pages, int(n))
+        with self._dev():
+            # Wholesale tok/pos sync of BOTH lanes to the emitted stream
+            # (rows past n carry pad/0, clear's convention).
+            self._arena = self._jit_accept(self._arena, new_tok, new_pos)
+            self._d_arena = self._jit_accept(
+                self._d_arena, new_tok, new_pos
+            )
+        for slot in range(n - 1, -1, -1):
+            seq = self._slots[slot]
+            t = seq.tokens[-1]
+            done = (
+                t == self.eos_id or len(seq.tokens) >= seq.max_new_tokens
+            )
+            if done:
+                if seq.ctx is not None and t == self.eos_id:
+                    seq.ctx.instant(
+                        "decode.eos", slot=slot, tokens=len(seq.tokens)
+                    )
+                self._retire(slot)
+                self._complete(seq)
+            elif (
+                self.hard_deadline
+                and seq.deadline_s is not None
+                and now > seq.deadline_s
+            ):
+                self.telemetry.on_evicted()
+                self._retire(slot)
+                self._evict_seq(
+                    seq, slot,
+                    f"per-token SLO deadline exceeded after "
+                    f"{len(seq.tokens)}/{seq.max_new_tokens} tokens",
+                )
 
     def _step_once(self) -> None:
         n = self._n_live
@@ -592,7 +1177,28 @@ class GenerativeEngine:
         t0 = time.perf_counter()
         with self._dev():
             self._arena, nxt = fn(self.params, self._arena)
+            if self._spec:
+                # Keep the draft lane's KV stream gap-free even on the
+                # single-step fallback path (headroom near the cache
+                # end): the draft consumes the same tok/pos mirror, its
+                # own next-token guess is then overwritten by the
+                # accept-sync below.
+                self._d_arena, _ = self._d_step_for(b, kv)(
+                    self.draft_params, self._d_arena
+                )
             toks = np.asarray(nxt)  # the one device->host sync per step
+        if self._spec:
+            new_tok = np.full((self.max_batch_size,), self.pad_id, np.int32)
+            new_pos = np.zeros((self.max_batch_size,), np.int32)
+            for i in range(n):
+                s = self._slots[i]
+                if s is not None:
+                    new_tok[i] = int(toks[i])
+                    new_pos[i] = len(s.tokens) + 1
+            with self._dev():
+                self._d_arena = self._jit_accept(
+                    self._d_arena, new_tok, new_pos
+                )
         dt = time.perf_counter() - t0
         if self.step_ewma_s is None:
             self.step_ewma_s = dt
@@ -653,20 +1259,42 @@ class GenerativeEngine:
                 self._arena = self._jit_move(
                     self._arena, np.int32(last), np.int32(slot)
                 )
+                if self._spec:
+                    self._d_arena = self._d_jit_move(
+                        self._d_arena, np.int32(last), np.int32(slot)
+                    )
             self._arena = self._jit_clear(self._arena, np.int32(last))
+            if self._spec:
+                self._d_arena = self._d_jit_clear(
+                    self._d_arena, np.int32(last)
+                )
         with self._lock:
             if slot != self._n_live - 1:
                 self._slots[slot] = self._slots[self._n_live - 1]
             self._slots[self._n_live - 1] = None
             self._n_live -= 1
 
+    def _release_prefix(self, seq: _Sequence) -> None:
+        """Drop this sequence's reader reference on its prefix-cache
+        entry (no-op when it holds none).  The LAST reader's release is
+        what makes an over-capacity entry evictable — the refcount
+        contract the accounting test pins."""
+        entry = seq.prefix_entry
+        if entry is None or self._prefix is None:
+            return
+        seq.prefix_entry = None
+        self._prefix.release(entry)
+        self.telemetry.on_prefix_pages(self._prefix.pages_in_use())
+
     def _complete(self, seq: _Sequence) -> None:
+        self._release_prefix(seq)
         latency = time.monotonic() - seq.arrival_s
         self.telemetry.on_done(latency, len(seq.tokens))
         self._trace_end(seq, "complete")
         seq.finish()
 
     def _evict_seq(self, seq: _Sequence, slot: int, reason: str) -> None:
+        self._release_prefix(seq)
         if seq.ctx is not None:
             seq.ctx.instant(
                 "decode.evict", slot=slot, tokens=len(seq.tokens),
@@ -700,6 +1328,10 @@ class DecodeTelemetry:
         self._shed = self._occ = self._pages = self._active = None
         self._queue_tokens = self._step_s = self._per_token = None
         self._compiles = None
+        self._prefix_hits = self._prefix_misses = None
+        self._prefix_hit_pages = self._prefix_pages = None
+        self._spec_proposed = self._spec_accept = None
+        self._spec_ratio = None
         if registry is None:
             return
         from tpu_pipelines.observability.metrics import fine_latency_buckets
@@ -769,6 +1401,40 @@ class DecodeTelemetry:
             "the SLO monitor treats any increase as a breach.",
             labels=lab,
         ).labels(self.replica)
+        self._prefix_hits = registry.counter(
+            "serving_decode_prefix_hit_total",
+            "Admissions served from the prefix cache (prefill skipped).",
+            labels=lab,
+        ).labels(self.replica)
+        self._prefix_misses = registry.counter(
+            "serving_decode_prefix_miss_total",
+            "Admissions that ran a full prefill with the prefix cache "
+            "enabled.", labels=lab,
+        ).labels(self.replica)
+        self._prefix_hit_pages = registry.counter(
+            "serving_decode_prefix_hit_pages_total",
+            "Prompt pages whose prefill was skipped via prefix-cache "
+            "hits — the work the cache saved.", labels=lab,
+        ).labels(self.replica)
+        self._prefix_pages = registry.gauge(
+            "serving_decode_prefix_pages_in_use",
+            "Prompt pages resident in the prefix cache (readers pin "
+            "entries past capacity until the last one retires).",
+            labels=lab,
+        ).labels(self.replica)
+        self._spec_proposed = registry.counter(
+            "serving_decode_spec_proposed_total",
+            "Draft tokens proposed by speculative decoding.", labels=lab,
+        ).labels(self.replica)
+        self._spec_accept = registry.counter(
+            "serving_decode_spec_accept_total",
+            "Draft tokens the target verified and accepted.", labels=lab,
+        ).labels(self.replica)
+        self._spec_ratio = registry.gauge(
+            "serving_decode_spec_accept_ratio",
+            "Lifetime speculative acceptance rate (accepted / proposed).",
+            labels=lab,
+        ).labels(self.replica)
 
     def on_step(self, dt, ewma, live, bucket, pages, active) -> None:
         if self._steps is None:
@@ -804,3 +1470,27 @@ class DecodeTelemetry:
     def on_compile_after_warm(self) -> None:
         if self._compiles is not None:
             self._compiles.inc()
+
+    def on_prefix_hit(self, pages: int) -> None:
+        if self._prefix_hits is not None:
+            self._prefix_hits.inc()
+            self._prefix_hit_pages.inc(pages)
+
+    def on_prefix_miss(self) -> None:
+        if self._prefix_misses is not None:
+            self._prefix_misses.inc()
+
+    def on_prefix_pages(self, pages: int) -> None:
+        if self._prefix_pages is not None:
+            self._prefix_pages.set(pages)
+
+    def on_spec(self, proposed: int, accepted: int) -> None:
+        if self._spec_proposed is None:
+            return
+        if proposed:
+            self._spec_proposed.inc(proposed)
+        if accepted:
+            self._spec_accept.inc(accepted)
+        p = self._spec_proposed.get()
+        if p:
+            self._spec_ratio.set(self._spec_accept.get() / p)

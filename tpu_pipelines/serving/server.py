@@ -70,6 +70,15 @@ ENV_MODEL_TYPE = "TPP_SERVING_MODEL_TYPE"
 ENV_PAGE_SIZE = "TPP_SERVING_PAGE_SIZE"
 ENV_MAX_TOKENS = "TPP_SERVING_MAX_TOKENS"
 ENV_SLO_MS_PER_TOKEN = "TPP_SERVING_SLO_MS_PER_TOKEN"
+# Decode-speed levers (serving/generative.py, all off at 0): resident
+# prefix-cache entries (refcounted prefill reuse for shared prompts),
+# prefill pages admitted per decode step (chunked prefill's credit
+# meter), and the speculative-decoding window (draft proposals verified
+# per target step; the payload's make_draft_decode_fns supplies the
+# draft, else the engine self-drafts).
+ENV_PREFIX_CACHE = "TPP_SERVING_PREFIX_CACHE"
+ENV_PREFILL_CHUNK = "TPP_SERVING_PREFILL_CHUNK"
+ENV_SPEC_TOKENS = "TPP_SERVING_SPEC_TOKENS"
 # Observability knobs (docs/OBSERVABILITY.md "Request tracing & SLO burn
 # rates"): request-scoped tracing mode (off | sample:N | all — default
 # off: zero files, byte-identical /metrics), where sampled spans flush
@@ -142,6 +151,9 @@ class ModelServer:
         decode_page_size: int = 0,
         max_queue_tokens: int = 0,
         slo_ms_per_token: float = -1.0,
+        prefix_cache_entries: int = 0,
+        prefill_chunk_pages: int = 0,
+        spec_tokens: int = 0,
         request_trace_mode: str = "",
         trace_dir: str = "",
         slo_monitor_interval_s: float = -1.0,
@@ -169,6 +181,12 @@ class ModelServer:
             max_queue_tokens = int(_env_number(ENV_MAX_TOKENS, 0))
         if slo_ms_per_token < 0:
             slo_ms_per_token = _env_number(ENV_SLO_MS_PER_TOKEN, 0.0)
+        if prefix_cache_entries <= 0:
+            prefix_cache_entries = int(_env_number(ENV_PREFIX_CACHE, 0))
+        if prefill_chunk_pages <= 0:
+            prefill_chunk_pages = int(_env_number(ENV_PREFILL_CHUNK, 0))
+        if spec_tokens <= 0:
+            spec_tokens = int(_env_number(ENV_SPEC_TOKENS, 0))
         self.replicas = max(1, replicas)
         self.max_versions = max(1, max_versions)
         self.slo_p99_ms = max(0.0, slo_p99_ms)
@@ -176,6 +194,9 @@ class ModelServer:
         self.decode_page_size = max(0, decode_page_size)
         self.max_queue_tokens = max(0, max_queue_tokens)
         self.slo_ms_per_token = max(0.0, slo_ms_per_token)
+        self.prefix_cache_entries = max(0, prefix_cache_entries)
+        self.prefill_chunk_pages = max(0, prefill_chunk_pages)
+        self.spec_tokens = max(0, spec_tokens)
         self._lock = threading.Lock()
         # Serializes reload(): concurrent version swaps would race the
         # load-outside-lock / swap-under-lock dance.  Never held while
@@ -280,6 +301,9 @@ class ModelServer:
                 decode_page_size=self.decode_page_size,
                 max_queue_tokens=self.max_queue_tokens,
                 slo_ms_per_token=self.slo_ms_per_token,
+                prefix_cache_entries=self.prefix_cache_entries,
+                prefill_chunk_pages=self.prefill_chunk_pages,
+                spec_tokens=self.spec_tokens,
                 swap_probation_s=swap_probation_s,
                 registry=self.metrics,
             )
