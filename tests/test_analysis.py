@@ -919,6 +919,58 @@ def test_tpp209_whole_request_decode(tmp_path):
             assert 'model_type="generative"' in f209[0].fix
 
 
+def test_tpp212_unsupervised_fleet(tmp_path):
+    """TPP212: replicas > 1 with no SLO and no supervisor knobs fires
+    WARN; a single replica, an slo_p99_ms, an explicit supervisor knob,
+    a dynamic replica count, and a suppression comment all stay silent."""
+    from tpu_pipelines.utils.module_loader import load_fn
+
+    mod = tmp_path / "fleety.py"
+    mod.write_text(textwrap.dedent('''
+        def bare_fleet():
+            return {"replicas": 2, "model_type": "predict"}
+
+
+        def call_bare_fleet():
+            from tpu_pipelines.serving import ModelServer
+
+            return ModelServer("m", "/m", replicas=4)
+
+
+        def fleet_with_slo():
+            return {"replicas": 2, "slo_p99_ms": 50}
+
+
+        def fleet_with_supervisor():
+            from tpu_pipelines.serving import ModelServer
+
+            return ModelServer("m", "/m", replicas=2,
+                               supervisor_interval_s=0.25)
+
+
+        def single_replica():
+            return {"replicas": 1}
+
+
+        def dynamic_replicas(n):
+            return {"replicas": n}
+
+
+        def suppressed_fleet():
+            return {"replicas": 2}  # tpp: disable=TPP212
+    '''))
+    for fn, n in (("bare_fleet", 1), ("call_bare_fleet", 1),
+                  ("fleet_with_slo", 0), ("fleet_with_supervisor", 0),
+                  ("single_replica", 0), ("dynamic_replicas", 0),
+                  ("suppressed_fleet", 0)):
+        findings = check_callable(load_fn(str(mod), fn), "Server")
+        f212 = [f for f in findings if f.rule == "TPP212"]
+        assert len(f212) == n, (fn, findings)
+        if n:
+            assert f212[0].severity == "warn"
+            assert "supervisor_interval_s" in f212[0].fix
+
+
 def test_tpp210_mesh_without_per_host_input(tmp_path):
     """TPP210: a configured mesh next to an unsharded InputConfig fires
     WARN; explicit shard kwargs, the per_host_input_config helper, an
@@ -1470,6 +1522,17 @@ def ServeGen(ctx):
 
 def create_pipeline():
     gen = ServeGen()
+    return _pipe([gen, Sink(examples=gen.outputs["examples"])])
+''',
+    "TPP212": '''
+@component(outputs={{"examples": "Examples"}}, name="FleetGen")
+def FleetGen(ctx):
+    serving = {{"replicas": 2, "model_type": "predict"}}
+    return serving
+
+
+def create_pipeline():
+    gen = FleetGen()
     return _pipe([gen, Sink(examples=gen.outputs["examples"])])
 ''',
     "TPP210": '''

@@ -325,6 +325,28 @@ def test_bench_smoke_emits_compact_stdout_and_full_report():
     assert compact["retries_total"] == chaos["retries_total"]
     assert compact["shards_quarantined"] == chaos["shards_quarantined"]
     assert compact["shed_requests"] == chaos["shed_requests"]
+    # Self-healing fleet chaos leg (ISSUE 17): kill 1-of-2 replicas
+    # mid-hammer — zero lost requests, the victim's breaker opens and
+    # closes, full-capacity recovery, bounded incident p99, and the
+    # recovered decode streams bitwise-identical — all judged from the
+    # fleet's own scrape and surfaced on the compact line.
+    schaos = report["robustness"]["serving_chaos"]
+    assert schaos["green"] is True, schaos
+    assert schaos["lost_requests"] == 0
+    assert schaos["served_5xx"] == 0
+    assert len(schaos["killed"]) == 1
+    assert schaos["failovers"] >= 1
+    assert schaos["breaker_transitions"] >= 2
+    assert schaos["recovered_full_capacity"] is True
+    assert schaos["incident_p99_ms"] < 5000.0
+    assert schaos["sessions_recovered"] >= 1
+    assert schaos["recovered_streams_identical"] is True
+    assert schaos["host_cpus"] >= 1  # the 1-core p99 honesty caveat
+    assert compact["chaos_serving_green"] is True
+    assert compact["failovers"] == schaos["failovers"]
+    assert compact["sessions_recovered"] == schaos["sessions_recovered"]
+    assert compact["incident_p99_ms"] == schaos["incident_p99_ms"]
+    assert compact["lost_requests"] == 0
     # And the resume leg still reports alongside it.
     robust = report["robustness"]["taxi_faults"]
     assert robust["green"] is True, robust

@@ -591,6 +591,59 @@ def _check_whole_request_decode(
     return out
 
 
+# Keys whose presence in a multi-replica serving config declares a health
+# story: either an SLO the batcher can enforce (slo_p99_ms/_s) or an
+# explicit supervisor knob.  Any one of them silences TPP212.
+_SUPERVISION_KEYS = (
+    "slo_p99_ms", "slo_p99_s",
+    "supervisor_interval_s", "supervisor_queue_age_s",
+    "supervisor_breaker_failures", "supervisor_breaker_open_s",
+)
+
+
+def _check_unsupervised_fleet(
+    src: _Source, node_id: str, fn_label: str
+) -> List[Finding]:
+    """TPP212: a multi-replica serving fleet configured with no SLO and
+    no supervision.
+
+    ``replicas > 1`` buys redundancy only if something notices when a
+    replica stops answering — otherwise the latency-aware router keeps
+    offering traffic to a wedged or dead peer and the fleet degrades to
+    "N-1 replicas plus a tarpit".  Fires when one call / dict literal
+    pins ``replicas`` to an int constant above 1 and names neither an
+    SLO (``slo_p99_ms``/``slo_p99_s``) nor any supervisor knob
+    (``supervisor_*``) in the same mapping.  Single-replica configs and
+    dynamic replica counts stay silent.
+    """
+    out: List[Finding] = []
+    for node in ast.walk(src.tree):
+        pairs = dict(_const_str_pairs(node))
+        reps = pairs.get("replicas")
+        if not (
+            isinstance(reps, ast.Constant)
+            and isinstance(reps.value, int)
+            and reps.value > 1
+        ):
+            continue
+        if any(name in pairs for name in _SUPERVISION_KEYS):
+            continue
+        f = _finding(
+            src, reps, "TPP212", WARN, node_id,
+            f"{fn_label}: replicas={reps.value} with no slo_p99_ms and no "
+            "supervisor knobs — nothing detects a wedged or dead replica, "
+            "so the router keeps offering it traffic and redundancy buys "
+            "nothing",
+            "set supervisor_interval_s (heartbeat + queue-age probes, "
+            "circuit breaking, in-place rebuild; docs/SERVING.md "
+            '"Self-healing fleet") or at least slo_p99_ms so queue-age '
+            "wedge detection has a budget",
+        )
+        if f:
+            out.append(f)
+    return out
+
+
 def _check_closure_staleness(
     src: _Source, node_id: str, fn_label: str, fn: Callable
 ) -> List[Finding]:
@@ -642,6 +695,7 @@ def check_callable(
     out.extend(_check_window_host_traffic(src, node_id, label))
     out.extend(_check_flash_below_crossover(src, node_id, label))
     out.extend(_check_whole_request_decode(src, node_id, label))
+    out.extend(_check_unsupervised_fleet(src, node_id, label))
     out.extend(_check_mesh_unsharded_input(src, node_id, label))
     return out
 

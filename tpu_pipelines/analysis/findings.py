@@ -168,6 +168,13 @@ RULES: Dict[str, Dict[str, str]] = {
                  "monitor are built from it); an undocumented series is "
                  "invisible to both",
     },
+    "TPP212": {
+        "severity": WARN,
+        "title": "multi-replica serving fleet with no slo_p99_ms and no "
+                 "supervisor knobs — nothing detects a wedged or dead "
+                 "replica, so the router keeps offering it traffic and "
+                 "the redundancy buys nothing",
+    },
 }
 
 GRAPH_RULE_PREFIX = "TPP1"

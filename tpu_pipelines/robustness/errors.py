@@ -113,6 +113,19 @@ def classify_error(exc: BaseException) -> str:
     except ImportError:  # pragma: no cover
         pass
 
+    # Device runtime (jaxlib XlaRuntimeError — matched by name so this
+    # module never imports jaxlib): RESOURCE_EXHAUSTED means the program
+    # does not FIT — an equally-sized replica or a retry reproduces it,
+    # so failover is futile and the verdict is permanent.  Transfer and
+    # comms failures (host<->device DMA, cross-host collectives, DATA_LOSS
+    # from a preempted peer) clear on a different replica or a retry.
+    for klass in type(exc).__mro__:
+        if klass.__name__ == "XlaRuntimeError":
+            msg = str(exc)
+            if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg:
+                return PERMANENT
+            return TRANSIENT
+
     # Network: an HTTP *response* is an answer (the server spoke; its
     # verdict stands — the _urlopen_backoff contract); a connection-level
     # failure is not.

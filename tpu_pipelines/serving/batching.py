@@ -208,6 +208,11 @@ class RequestBatcher:
         # would otherwise leave submit() callers hanging to their full
         # timeout_s).  Written only by the worker thread.
         self._inflight: List["Future[np.ndarray]"] = []
+        # Enqueue instant of the oldest request in the group currently
+        # inside predict_fn — the supervisor's wedge signal: queued work
+        # ages visibly while a device call never returns.  Written only
+        # by the worker thread.
+        self._inflight_since: Optional[float] = None
         # Live telemetry (observability/metrics.py), opt-in via registry:
         # queue depth is read at scrape time (the gauge calls qsize()),
         # batch sizes/counts update per device call.
@@ -307,6 +312,26 @@ class RequestBatcher:
             # second full window.
             self._queue.put((batch, n_rows, fut, time.monotonic(), ctx))
         return fut.result(timeout=timeout_s)
+
+    def oldest_work_age_s(self) -> float:
+        """Age of the oldest request this batcher owes an answer —
+        queued OR inside the current device call.  A healthy batcher
+        keeps this near the gather window; a wedged predict (dead
+        device, stuck transfer) lets it grow without bound, which is the
+        supervisor's wedge-detection signal.  Lock-free on the hot
+        fields; the queue peek holds the queue mutex only long enough to
+        read the head entry's enqueue instant."""
+        oldest = self._inflight_since
+        with self._queue.mutex:
+            for item in self._queue.queue:
+                if item is not None:  # skip the close sentinel
+                    t = item[3]
+                    if oldest is None or t < oldest:
+                        oldest = t
+                    break  # FIFO: the first real entry is the oldest
+        if oldest is None:
+            return 0.0
+        return max(0.0, time.monotonic() - oldest)
 
     def close(self, timeout_s: float = 5.0) -> None:
         """Shut down: reject new submits, serve-or-fail everything queued.
@@ -410,10 +435,12 @@ class RequestBatcher:
                 group.append(nxt)
                 rows += nxt[1]
             self._inflight = [entry[2] for entry in group]
+            self._inflight_since = group[0][3]
             try:
                 self._execute(group)
             finally:
                 self._inflight = []
+                self._inflight_since = None
 
     def _predict_group(self, group) -> None:
         merged = {

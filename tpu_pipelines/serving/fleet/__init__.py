@@ -23,6 +23,12 @@ request surfaces (docs/SERVING.md):
   - :class:`~tpu_pipelines.serving.fleet.fleet.ServingFleet` — the facade
     ``ModelServer`` front-ends route through (``replicas=``/
     ``max_versions=`` knobs; REST/gRPC surfaces unchanged).
+  - :class:`~tpu_pipelines.serving.fleet.supervisor.ReplicaSupervisor` —
+    opt-in self-healing (``supervisor_interval_s``): heartbeat +
+    queue-age probes drive HEALTHY/DEGRADED/EJECTED per replica, a
+    circuit breaker gates routing, failed replicas rebuild in place,
+    and all-replicas-down surfaces as :class:`FleetUnavailable`
+    (HTTP 503 + Retry-After / gRPC UNAVAILABLE).
 
 SLO-driven batch deadlines (``slo_p99_ms``) live in
 serving/batching.py — every replica batcher computes its gather window
@@ -33,6 +39,11 @@ from tpu_pipelines.serving.fleet.fleet import ServingFleet  # noqa: F401
 from tpu_pipelines.serving.fleet.pool import ReplicaPool  # noqa: F401
 from tpu_pipelines.serving.fleet.replica import Replica  # noqa: F401
 from tpu_pipelines.serving.fleet.router import LatencyAwareRouter  # noqa: F401
+from tpu_pipelines.serving.fleet.supervisor import (  # noqa: F401
+    CircuitBreaker,
+    FleetUnavailable,
+    ReplicaSupervisor,
+)
 from tpu_pipelines.serving.fleet.versions import (  # noqa: F401
     CanaryRefused,
     ModelVersionManager,

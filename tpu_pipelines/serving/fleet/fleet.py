@@ -72,6 +72,10 @@ class ServingFleet:
         prefill_chunk_pages: int = 0,
         spec_tokens: int = 0,
         swap_probation_s: float = -1.0,
+        supervisor_interval_s: float = 0.0,
+        supervisor_queue_age_s: float = 0.0,
+        supervisor_breaker_failures: int = 3,
+        supervisor_breaker_open_s: float = 0.0,
         registry=None,
         loader: Optional[Callable[[str], Any]] = None,
     ):
@@ -159,6 +163,12 @@ class ServingFleet:
                     "spec_tokens": spec_tokens,
                 },
             }
+        supervised = supervisor_interval_s > 0
+        if generative_cfg is not None and supervised:
+            # Supervised fleets recover in-flight generations: a dying
+            # replica's decode failures surface as DecodeSessionLost
+            # (progress attached) instead of the raw worker-death error.
+            generative_cfg["recover"] = True
         devices = _local_devices()
         n = max(1, int(replicas))
         self.pool = ReplicaPool([
@@ -174,6 +184,55 @@ class ServingFleet:
             )
             for i in range(n)
         ])
+        # Self-healing layer (ISSUE 17), opt-in via supervisor_interval_s:
+        # OFF (the default) leaves the router ungated, the pool without
+        # failover, and none of the serving_replica_state /
+        # serving_breaker_transitions_total / serving_failovers_total /
+        # serving_fleet_unavailable_total /
+        # serving_decode_sessions_recovered_total families registered —
+        # the disabled fleet is byte-identical to the pre-supervision one.
+        self.supervisor = None
+        self._m_failovers = self._m_unavailable = None
+        self._m_sessions_recovered = None
+        if supervised:
+            from tpu_pipelines.serving.fleet.supervisor import (
+                ReplicaSupervisor,
+            )
+
+            slo_age = 10.0 * slo_p99_s if slo_p99_s > 0 else 0.0
+            self.supervisor = ReplicaSupervisor(
+                self.pool,
+                interval_s=supervisor_interval_s,
+                queue_age_s=(
+                    supervisor_queue_age_s if supervisor_queue_age_s > 0
+                    else max(slo_age, 2.0)
+                ),
+                breaker_failures=supervisor_breaker_failures,
+                breaker_open_s=supervisor_breaker_open_s,
+                registry=registry,
+            )
+            self.pool.router.gate = self.supervisor.allow
+            self.pool.supervisor = self.supervisor
+            if registry is not None:
+                self._m_failovers = registry.counter(
+                    "serving_failovers_total",
+                    "Requests transparently retried on a healthy replica "
+                    "after a transient failure on the routed one.",
+                )
+                self._m_unavailable = registry.counter(
+                    "serving_fleet_unavailable_total",
+                    "Requests refused because every replica was ejected "
+                    "or breaker-open (HTTP 503 + Retry-After / gRPC "
+                    "UNAVAILABLE).",
+                )
+                self._m_sessions_recovered = registry.counter(
+                    "serving_decode_sessions_recovered_total",
+                    "In-flight generations re-prefilled onto a surviving "
+                    "replica after their replica died, continued with "
+                    "bitwise-identical greedy tokens.",
+                )
+                self.pool.on_failover = self._m_failovers.inc
+            self.supervisor.start()
 
     @property
     def generative(self) -> bool:
@@ -204,7 +263,13 @@ class ServingFleet:
     ) -> np.ndarray:
         if ctx is None:
             ctx = request_trace.current()
-        result = self.pool.submit(batch, n_rows, timeout_s=timeout_s, ctx=ctx)
+        try:
+            result = self.pool.submit(
+                batch, n_rows, timeout_s=timeout_s, ctx=ctx
+            )
+        except Exception as e:  # noqa: BLE001 — count + re-raise
+            self._note_unavailable(e)
+            raise
         if self._canary_batch is None:
             with self._canary_lock:
                 if self._canary_batch is None:
@@ -252,16 +317,99 @@ class ServingFleet:
                 row["input_mask"] = np.asarray(mask)[i]
             rows.append(row)
         ctx = request_trace.current()
-        if ctx is None:
-            replica = self.pool.router.pick(self.pool.replicas)
-        else:
-            replica, costs = self.pool.router.pick_with_costs(
-                self.pool.replicas
+        try:
+            if ctx is None:
+                replica = self.pool.router.pick(self.pool.replicas)
+            else:
+                replica, costs = self.pool.router.pick_with_costs(
+                    self.pool.replicas
+                )
+                ctx.instant("route", replica=replica.name, costs=costs)
+        except Exception as e:  # noqa: BLE001 — count + re-raise
+            self._note_unavailable(e)
+            raise
+        try:
+            return replica.decode_submit(
+                rows, dict(gen_params or {}), timeout_s=timeout_s, ctx=ctx
             )
-            ctx.instant("route", replica=replica.name, costs=costs)
-        return replica.decode_submit(
-            rows, dict(gen_params or {}), timeout_s=timeout_s, ctx=ctx
+        except Exception as e:  # noqa: BLE001 — classified below
+            from tpu_pipelines.serving.generative import DecodeSessionLost
+
+            if not isinstance(e, DecodeSessionLost):
+                raise
+            return self._recover_decode(
+                replica, e, rows, dict(gen_params or {}), timeout_s, ctx
+            )
+
+    def _recover_decode(
+        self,
+        dead,
+        lost,
+        rows: List[Dict[str, Any]],
+        gen_params: Dict[str, Any],
+        timeout_s: float,
+        ctx,
+    ) -> np.ndarray:
+        """Decode-session recovery: the routed replica died with this
+        request's generations in flight.  Greedy decode is deterministic,
+        so re-prefilling prompt (+ the accepted tokens the engine had
+        committed, re-derived by replay) onto a surviving replica
+        continues every stream bitwise-identically — the caller sees the
+        exact token arrays an uninterrupted run would have produced, at
+        the cost of one extra prefill (prefix-cache-assisted when
+        enabled).  One recovery per request: a second death surfaces."""
+        sup = self.supervisor
+        if sup is None:
+            raise lost.cause
+        sup.on_request_error(dead, lost.cause)
+        survivors = [
+            r for r in self.pool.replicas if r is not dead and sup.allow(r)
+        ]
+        if not survivors:
+            from tpu_pipelines.serving.fleet.supervisor import (
+                FleetUnavailable,
+            )
+
+            err = FleetUnavailable(
+                "decode session lost and no healthy replica remains"
+            )
+            self._note_unavailable(err)
+            raise err from lost.cause
+        replica = self.pool.router.pick(survivors)
+        if ctx is not None:
+            ctx.instant(
+                "decode_recover", from_replica=dead.name,
+                to_replica=replica.name, unfinished=lost.unfinished,
+                error=f"{type(lost.cause).__name__}: {lost.cause}",
+            )
+        out = replica.decode_submit(
+            rows, gen_params, timeout_s=timeout_s, ctx=ctx
         )
+        # Soft continuity audit: each recovered stream must extend the
+        # tokens the dead engine had already committed (determinism is
+        # the recovery contract; a mismatch means the survivor decoded a
+        # DIFFERENT stream and the client-visible guarantee broke).
+        for i, partial in enumerate(lost.partial_tokens[: len(out)]):
+            got = [int(t) for t in out[i][: len(partial)]]
+            if partial and got != partial:
+                log.warning(
+                    "fleet: %s recovered stream %d diverged from the "
+                    "accepted prefix (%r -> %r)",
+                    self.model_name, i, partial, got,
+                )
+        if self._m_sessions_recovered is not None:
+            self._m_sessions_recovered.inc(max(lost.unfinished, 1))
+        sup.on_request_success(replica)
+        return out
+
+    def _note_unavailable(self, exc: BaseException) -> None:
+        if self._m_unavailable is not None:
+            from tpu_pipelines.serving.fleet.supervisor import (
+                FleetUnavailable,
+            )
+
+            if isinstance(exc, FleetUnavailable):
+                self._m_unavailable.inc()
 
     def outstanding_tokens(self) -> int:
         """Fleet-wide decode work owed (token-level admission input)."""
@@ -447,7 +595,13 @@ class ServingFleet:
             health["quarantined_versions"] = sorted(quarantined)
         if self.generative:
             health["outstanding_decode_tokens"] = self.outstanding_tokens()
+        if self.supervisor is not None:
+            health["replica_states"] = {
+                r.name: self.supervisor.state(r) for r in self.pool.replicas
+            }
         return health
 
     def close(self, timeout_s: float = 5.0) -> None:
+        if self.supervisor is not None:
+            self.supervisor.stop()
         self.pool.close(timeout_s=timeout_s)

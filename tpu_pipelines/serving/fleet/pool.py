@@ -28,6 +28,12 @@ class ReplicaPool:
         self.replicas = list(replicas)
         self.router = router or LatencyAwareRouter()
         self._closed = False
+        # Supervision (set by the fleet when supervisor knobs are on):
+        # request outcomes feed the breakers, and a transient failure
+        # fails over ONCE to a healthy replica.  Both None = the
+        # pre-supervision pool, bit for bit.
+        self.supervisor = None
+        self.on_failover = None
 
     def __len__(self) -> int:
         return len(self.replicas)
@@ -51,7 +57,48 @@ class ReplicaPool:
             # at that instant.
             replica, costs = self.router.pick_with_costs(self.replicas)
             ctx.instant("route", replica=replica.name, costs=costs)
-        return replica.submit(batch, n_rows, timeout_s=timeout_s, ctx=ctx)
+        sup = self.supervisor
+        if sup is None:
+            return replica.submit(batch, n_rows, timeout_s=timeout_s, ctx=ctx)
+        try:
+            out = replica.submit(batch, n_rows, timeout_s=timeout_s, ctx=ctx)
+        except Exception as e:  # noqa: BLE001 — classified below
+            sup.on_request_error(replica, e)
+            from tpu_pipelines.robustness.errors import PERMANENT, \
+                classify_error
+
+            if classify_error(e) == PERMANENT:
+                # The request's own fault (or an error an equally-sized
+                # replica would reproduce): no futile failover.
+                raise
+            survivors = [
+                r for r in self.replicas if r is not replica and sup.allow(r)
+            ]
+            if not survivors:
+                from tpu_pipelines.serving.fleet.supervisor import (
+                    FleetUnavailable,
+                )
+
+                raise FleetUnavailable(
+                    "request failed and no healthy replica remains"
+                ) from e
+            # Predict is idempotent: retry exactly once on a healthy
+            # survivor.  A second failure surfaces — one failover absorbs
+            # a dying replica, it must not mask a systemic outage.
+            retry = self.router.pick(survivors)
+            if ctx is not None:
+                ctx.instant(
+                    "failover", from_replica=replica.name,
+                    to_replica=retry.name,
+                    error=f"{type(e).__name__}: {e}",
+                )
+            if self.on_failover is not None:
+                self.on_failover()
+            out = retry.submit(batch, n_rows, timeout_s=timeout_s, ctx=ctx)
+            sup.on_request_success(retry)
+            return out
+        sup.on_request_success(replica)
+        return out
 
     @property
     def closed(self) -> bool:

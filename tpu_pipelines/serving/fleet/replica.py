@@ -29,10 +29,28 @@ from typing import Any, Callable, Dict, Optional
 import numpy as np
 
 from tpu_pipelines.serving.batching import RequestBatcher
+from tpu_pipelines.testing import faults as _faults
 
 # Routing cost for a replica nothing has been observed on yet: small but
 # non-zero, so fresh replicas attract traffic without dividing by zero.
 DEFAULT_LATENCY_S = 1e-3
+
+
+def _recoverable_decode_error(exc: BaseException) -> bool:
+    """Is this decode failure the *replica's* fault (recover the streams
+    elsewhere) rather than the request's (return to caller)?  Overload
+    and deliberate eviction keep their 429/503 semantics, validation
+    errors stay 4xx, and a still-decoding client timeout is not a dead
+    replica; anything else — an engine worker death, a device error —
+    means the sequences need a new home."""
+    from tpu_pipelines.serving.generative import (
+        EngineOverloaded,
+        GenerationEvicted,
+    )
+
+    if isinstance(exc, (EngineOverloaded, GenerationEvicted)):
+        return False
+    return not isinstance(exc, (TimeoutError, ValueError, TypeError, KeyError))
 
 
 class LatencyTracker:
@@ -90,6 +108,10 @@ class Replica:
         self.index = index
         self.name = str(index)
         self.device = device
+        # Rebuild epoch: bumped by rebuild() so anything latched to the
+        # OLD incarnation (an injected replica kill, a wedged worker's
+        # stale future) stops applying to the new one.
+        self.generation = 0
         self.latency = LatencyTracker()
         self._inflight = 0
         self._inflight_lock = threading.Lock()
@@ -115,14 +137,27 @@ class Replica:
                 with jax.default_device(_dev):
                     return _inner(batch)
 
-        self.batcher = RequestBatcher(
-            predict_fn,
+        def _hooked_predict(batch, _inner=predict_fn):
+            # Fault-injection seam (KILL_REPLICA / WEDGE_PREDICT /
+            # DEVICE_ERROR): one module-global read when no plan is
+            # active, same cost contract as the other hooks.
+            _faults.replica_predict(self.name, self.generation)
+            return _inner(batch)
+
+        self._predict_fn = _hooked_predict
+        # Kept so rebuild() can re-create the private batcher with the
+        # exact knobs this replica was born with.
+        self._batcher_kwargs = dict(
             max_batch_size=max_batch_size,
             batch_timeout_s=batch_timeout_s,
             slo_p99_s=slo_p99_s,
+        )
+        self.batcher = RequestBatcher(
+            self._predict_fn,
             registry=None,  # per-replica series below; shared batcher
             #               gauges would collide across replicas
             name=self.name,
+            **self._batcher_kwargs,
         )
         self._m_depth = self._m_p99 = self._m_ewma = self._m_requests = None
         self._m_deadline = self._m_step = self._m_latency_h = None
@@ -213,6 +248,65 @@ class Replica:
             return (tokens + 1) * (step or DEFAULT_LATENCY_S)
         return (self.queue_depth() + 1) * self.ewma_p99_s()
 
+    # -------------------------------------------------------------- health
+
+    def heartbeat(self) -> None:
+        """Supervisor probe: a tiny device-committed no-op on this
+        replica's device.  Bypasses the batcher deliberately — a wedged
+        batcher would swallow a queued probe, and the queue-age check
+        covers that axis; this one answers "is the device itself alive".
+        The fault hook fires first so an injected replica kill fails the
+        heartbeat exactly like a dead device would."""
+        _faults.replica_predict(self.name, self.generation)
+        import jax
+        import jax.numpy as jnp
+
+        if self.device is not None:
+            with jax.default_device(self.device):
+                jax.block_until_ready(jnp.zeros((), jnp.float32) + 1.0)
+        else:
+            jax.block_until_ready(jnp.zeros((), jnp.float32) + 1.0)
+
+    def rebuild(self, timeout_s: float = 2.0) -> None:
+        """Rebuild this replica in place after ejection: fail the old
+        batcher's wedged work so callers unblock (and fail over), bump
+        the generation, then re-create the private batcher and — for
+        generative replicas — one engine per RESIDENT version from the
+        version manager.  With the AOT executable cache warm, the engine
+        re-warm is a deserialize, not a compile storm.  The Replica
+        object (and its labeled metric series) survives; only the
+        machinery inside is new."""
+        old = self.batcher
+        old.request_close()
+        old.join_close(timeout_s)
+        self.generation += 1
+        self.batcher = RequestBatcher(
+            self._predict_fn,
+            registry=None,
+            name=self.name,
+            **self._batcher_kwargs,
+        )
+        # Fresh latency window: the dead incarnation's tail latencies
+        # must not deter the router from the rebuilt replica.
+        self.latency = LatencyTracker()
+        cfg = self._generative_cfg
+        if cfg is not None:
+            final_error = None
+            if cfg.get("recover"):
+                final_error = RuntimeError(
+                    "replica rebuilt while generation was in flight"
+                )
+            with self._engines_lock:
+                engines = list(self._engines.values())
+                self._engines.clear()
+            for e in engines:
+                e.close(timeout_s=timeout_s, final_error=final_error)
+            versions = cfg["versions"]
+            for version in versions.resident_versions():
+                loaded = versions.loaded_for(version)
+                if loaded is not None:
+                    self.prepare_engine(version, loaded)
+
     # ------------------------------------------------------------- serving
 
     def submit(self, batch, n_rows: int, timeout_s: float = 300.0, ctx=None):
@@ -275,11 +369,19 @@ class Replica:
             # fleet still serves payloads without a draft model.
             kwargs["draft_fns"] = getattr(loaded, "draft_decode_fns", None)
             kwargs["draft_params"] = getattr(loaded, "draft_params", None)
+        def _engine_fault_hook(_self=self):
+            # Generative traffic never touches the batcher's predict
+            # path, so the engine carries its own injection seam — a
+            # latched replica kill fails decode rounds here until the
+            # rebuild bumps the generation.
+            _faults.replica_predict(_self.name, _self.generation)
+
         engine = GenerativeEngine(
             fns,
             loaded.params,
             device=self.device,
             telemetry=self._decode_telemetry,
+            fault_hook=_engine_fault_hook,
             **kwargs,
         )
         engine.warm()
@@ -338,16 +440,36 @@ class Replica:
                 gp = validate_generation_params(
                     gen_params, max_decode_len=engine.max_decode_len
                 )
-                handles = [
-                    engine.submit_nowait(
-                        row["inputs"],
-                        input_mask=row.get("input_mask"),
-                        max_new_tokens=gp["max_new_tokens"],
-                        ctx=ctx,
-                    )
-                    for row in rows
-                ]
-                outs = [h.wait(timeout_s) for h in handles]
+                handles = []
+                try:
+                    for row in rows:
+                        handles.append(engine.submit_nowait(
+                            row["inputs"],
+                            input_mask=row.get("input_mask"),
+                            max_new_tokens=gp["max_new_tokens"],
+                            ctx=ctx,
+                        ))
+                    outs = [h.wait(timeout_s) for h in handles]
+                except Exception as e:
+                    if cfg.get("recover") and _recoverable_decode_error(e):
+                        # Supervised fleet: surface the sequences' progress
+                        # (prompt is the caller's; accepted tokens are on
+                        # the handles) so the fleet can re-prefill onto a
+                        # surviving replica and continue the streams.
+                        from tpu_pipelines.serving.generative import (
+                            DecodeSessionLost,
+                        )
+
+                        raise DecodeSessionLost(
+                            e,
+                            partial_tokens=[
+                                [int(t) for t in h.tokens] for h in handles
+                            ],
+                            unfinished=sum(
+                                1 for h in handles if h.result is None
+                            ),
+                        ) from e
+                    raise
         finally:
             with self._inflight_lock:
                 self._inflight -= 1

@@ -20,16 +20,32 @@ from tpu_pipelines.serving.fleet.replica import Replica
 
 class LatencyAwareRouter:
     """Pick-min-cost over the replica set; thread-safe, stateless apart
-    from the tie-break rotation counter."""
+    from the tie-break rotation counter.
 
-    def __init__(self):
+    ``gate`` is the supervision hook: when the fleet runs a
+    :class:`ReplicaSupervisor`, the supervisor's ``allow`` is installed
+    here so an ejected replica or an open circuit breaker sheds routing
+    *before* its queue grows.  ``gate=None`` (the default, and the
+    supervisor-off mode) keeps every decision identical to the ungated
+    router."""
+
+    def __init__(self, gate=None):
         self._rr = 0
         self._lock = threading.Lock()
+        self.gate = gate
 
     def pick(self, replicas: Sequence[Replica]) -> Replica:
         if not replicas:
             raise RuntimeError("replica pool is empty")
         if len(replicas) == 1:
+            if self.gate is not None and not self.gate(replicas[0]):
+                from tpu_pipelines.serving.fleet.supervisor import (
+                    FleetUnavailable,
+                )
+
+                raise FleetUnavailable(
+                    "the only replica is ejected or breaker-open"
+                )
             return replicas[0]
         return self.pick_with_costs(replicas)[0]
 
@@ -52,8 +68,21 @@ class LatencyAwareRouter:
         # lowest index.
         for off in range(len(replicas)):
             r = replicas[(start + off) % len(replicas)]
+            if self.gate is not None and not self.gate(r):
+                # Shed, not costed: an open breaker means "do not wait
+                # out a timeout here", so its stale cost must not win.
+                costs[r.name] = None
+                continue
             cost = r.routing_cost()
             costs[r.name] = round(cost, 6)
             if cost < best_cost:
                 best, best_cost = r, cost
+        if best is None:
+            from tpu_pipelines.serving.fleet.supervisor import (
+                FleetUnavailable,
+            )
+
+            raise FleetUnavailable(
+                "every replica is ejected or breaker-open"
+            )
         return best, costs

@@ -146,6 +146,14 @@ class ModelVersionManager:
                 v for v in self._versions if v not in self._evict_pending
             ]
 
+    def loaded_for(self, version: str):
+        """The resident payload for one version (None if not resident) —
+        what a replica rebuild re-creates its engines from."""
+        with self._lock:
+            if version in self._evict_pending:
+                return None
+            return self._versions.get(version)
+
     def lease_count(self, version: str) -> int:
         with self._lock:
             return self._leases.get(version, 0)

@@ -134,6 +134,24 @@ class GenerationEvicted(RuntimeError):
     its latency race."""
 
 
+class DecodeSessionLost(RuntimeError):
+    """A replica died with generations in flight.  Raised by the
+    supervised fleet's decode path instead of the raw worker-death
+    exception, carrying each sequence's progress (the tokens the engine
+    had already committed) so the fleet can re-prefill prompt + accepted
+    tokens onto a surviving replica and continue the streams — greedy
+    decode is deterministic, so the recovered stream is bitwise
+    identical to an uninterrupted one."""
+
+    def __init__(self, cause, partial_tokens=None, unfinished=0):
+        super().__init__(
+            f"decode session lost: {type(cause).__name__}: {cause}"
+        )
+        self.cause = cause
+        self.partial_tokens = list(partial_tokens or [])
+        self.unfinished = int(unfinished)
+
+
 @dataclass
 class _Sequence:
     """Host-side bookkeeping for one generation (the engine's unit of
@@ -364,7 +382,12 @@ class GenerativeEngine:
         telemetry: Optional["DecodeTelemetry"] = None,
         registry=None,
         replica: str = "0",
+        fault_hook: Any = None,
     ):
+        # Supervision seam: called once per worker-loop round while work
+        # is live; an exception here kills the worker exactly like a
+        # device fault (the fleet's injected-kill path for decode).
+        self._fault_hook = fault_hook
         self.fns = fns
         self.params = params
         self.max_decode_len = int(fns.max_decode_len)
@@ -432,6 +455,9 @@ class GenerativeEngine:
         )
         self._n_live = 0
         self._closed = False
+        # Worker died (device fault / injected kill): reject new submits
+        # immediately instead of queueing work nothing will ever serve.
+        self._dead = False
         self._arena = None
         self._warmed = False
         self.compiles_after_warm = 0
@@ -842,6 +868,8 @@ class GenerativeEngine:
         with self._cond:
             if self._closed:
                 raise RuntimeError("engine is closed")
+            if self._dead:
+                raise RuntimeError("engine worker died")
             self._queue.append(seq)
             self.telemetry.on_queue(self.outstanding_tokens_locked())
             self._cond.notify_all()
@@ -869,10 +897,13 @@ class GenerativeEngine:
             inputs, max_new_tokens=max_new_tokens, input_mask=input_mask
         ).wait(timeout_s)
 
-    def close(self, timeout_s: float = 5.0) -> None:
+    def close(self, timeout_s: float = 5.0, *, final_error=None) -> None:
         """Reject new submits and fail everything unfinished.  Sequences
         mid-decode get ``GenerationEvicted`` (the zero-drop contract is
-        the fleet's: it only closes engines after the drain)."""
+        the fleet's: it only closes engines after the drain) —
+        ``final_error`` overrides that verdict, which the supervised
+        rebuild uses so racing waiters recover instead of surfacing a
+        503."""
         with self._cond:
             if self._closed:
                 return
@@ -889,7 +920,7 @@ class GenerativeEngine:
         for seq in pending:
             self._release_prefix(seq)
             self._trace_end(seq, "evicted")
-            seq.finish(GenerationEvicted("engine closed"))
+            seq.finish(final_error or GenerationEvicted("engine closed"))
 
     # ------------------------------------------------------------- worker
 
@@ -905,6 +936,8 @@ class GenerativeEngine:
                         self._cond.wait()
                     if self._closed:
                         return
+                if self._fault_hook is not None:
+                    self._fault_hook()
                 self._admit()
                 if self._n_live:
                     self._decode_round()
@@ -924,6 +957,7 @@ class GenerativeEngine:
         except Exception as e:  # noqa: BLE001 — device fault: fail loudly
             log.exception("generative engine worker died")
             with self._lock:
+                self._dead = True
                 pending = list(self._queue) + [
                     s for s in self._slots[: self._n_live] if s is not None
                 ]

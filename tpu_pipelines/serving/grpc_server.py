@@ -163,6 +163,7 @@ class GrpcPredictionService:
 
         from tpu_pipelines.serving.server import GenerateUnsupported
 
+        from tpu_pipelines.serving.fleet.supervisor import FleetUnavailable
         from tpu_pipelines.serving.generative import (
             EngineOverloaded,
             GenerationEvicted,
@@ -170,6 +171,12 @@ class GrpcPredictionService:
 
         try:
             return fn(batch)
+        except FleetUnavailable as e:
+            # Every replica ejected or breaker-open: capacity is being
+            # rebuilt — the gRPC twin of HTTP 503 + Retry-After.
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE, f"{type(e).__name__}: {e}"
+            )
         except GenerateUnsupported as e:
             # Typed contract with ModelServer: the deployment cannot serve
             # this RPC at all — not retryable, not the request's fault.

@@ -79,6 +79,13 @@ ENV_SLO_MS_PER_TOKEN = "TPP_SERVING_SLO_MS_PER_TOKEN"
 ENV_PREFIX_CACHE = "TPP_SERVING_PREFIX_CACHE"
 ENV_PREFILL_CHUNK = "TPP_SERVING_PREFILL_CHUNK"
 ENV_SPEC_TOKENS = "TPP_SERVING_SPEC_TOKENS"
+# Self-healing fleet (ISSUE 17): probe interval > 0 turns the
+# ReplicaSupervisor on (heartbeat + queue-age probes, circuit breakers,
+# failover, rebuild-in-place); queue-age is the wedge threshold (0 =
+# derived from the SLO).  Off by default: the unsupervised fleet is
+# byte-identical to the pre-supervision one.
+ENV_SUPERVISOR_S = "TPP_SERVING_SUPERVISOR_S"
+ENV_SUPERVISOR_QUEUE_AGE_S = "TPP_SERVING_SUPERVISOR_QUEUE_AGE_S"
 # Observability knobs (docs/OBSERVABILITY.md "Request tracing & SLO burn
 # rates"): request-scoped tracing mode (off | sample:N | all — default
 # off: zero files, byte-identical /metrics), where sampled spans flush
@@ -158,6 +165,8 @@ class ModelServer:
         trace_dir: str = "",
         slo_monitor_interval_s: float = -1.0,
         swap_probation_s: float = -1.0,
+        supervisor_interval_s: float = -1.0,
+        supervisor_queue_age_s: float = -1.0,
     ):
         self.model_name = model_name
         self.base_dir = base_dir
@@ -187,6 +196,14 @@ class ModelServer:
             prefill_chunk_pages = int(_env_number(ENV_PREFILL_CHUNK, 0))
         if spec_tokens <= 0:
             spec_tokens = int(_env_number(ENV_SPEC_TOKENS, 0))
+        if supervisor_interval_s < 0:
+            supervisor_interval_s = _env_number(ENV_SUPERVISOR_S, 0.0)
+        if supervisor_queue_age_s < 0:
+            supervisor_queue_age_s = _env_number(
+                ENV_SUPERVISOR_QUEUE_AGE_S, 0.0
+            )
+        self.supervisor_interval_s = max(0.0, supervisor_interval_s)
+        self.supervisor_queue_age_s = max(0.0, supervisor_queue_age_s)
         self.replicas = max(1, replicas)
         self.max_versions = max(1, max_versions)
         self.slo_p99_ms = max(0.0, slo_p99_ms)
@@ -305,6 +322,8 @@ class ModelServer:
                 prefill_chunk_pages=self.prefill_chunk_pages,
                 spec_tokens=self.spec_tokens,
                 swap_probation_s=swap_probation_s,
+                supervisor_interval_s=self.supervisor_interval_s,
+                supervisor_queue_age_s=self.supervisor_queue_age_s,
                 registry=self.metrics,
             )
             if self._slo_interval_s > 0:
@@ -762,10 +781,24 @@ class ModelServer:
                     # "anything went wrong"): caller mistakes are 4xx,
                     # not-ready is a retriable 503, everything else is an
                     # honest 500.
+                    from tpu_pipelines.serving.fleet.supervisor import (
+                        FleetUnavailable,
+                    )
                     from tpu_pipelines.serving.fleet.versions import (
                         CanaryRefused,
                     )
 
+                    if isinstance(e, FleetUnavailable):
+                        # Every replica is ejected or breaker-open:
+                        # capacity is being rebuilt, so this is a
+                        # structured retriable verdict, not a hang or an
+                        # anonymous 500.
+                        self._reply(
+                            503, {"error": f"fleet unavailable: {e}"},
+                            endpoint=endpoint,
+                            retry_after_s=FleetUnavailable.retry_after_s,
+                        )
+                        return
                     if isinstance(e, CanaryRefused):
                         # The pushed payload failed the canary gate; the
                         # prior version keeps serving.  The server is

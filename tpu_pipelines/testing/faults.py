@@ -70,11 +70,17 @@ TRANSIENT_EXECUTOR_ERROR = "transient_executor_error"  # classified-retry bait
 KILL_SHARD_WORKER = "kill_shard_worker"    # SIGKILL-equivalent in a fork child
 STORE_CONTENTION = "store_contention"      # transient StoreUnavailableError
 RELOAD_DURING_HAMMER = "reload_during_hammer"  # hot-swap mid-request-storm
+# Fleet-supervision kinds (ISSUE 17): the per-replica failure modes the
+# ReplicaSupervisor/failover layer must absorb (plan key ``REPLICA_KEY``).
+KILL_REPLICA = "kill_replica"      # latched death until rebuild (generation)
+WEDGE_PREDICT = "wedge_predict"    # predict parks, queue age grows
+DEVICE_ERROR = "device_error"      # transient device fault, `times` times
 
 # Sentinel plan keys for faults that are not tied to a pipeline node.
 STORE_KEY = "__store__"
 SHARD_KEY = "__shards__"
 SERVING_KEY = "__serving__"
+REPLICA_KEY = "__replica__"
 
 # kind -> the runner phase whose hook triggers it.
 _KIND_TO_POINT = {
@@ -87,6 +93,9 @@ _KIND_TO_POINT = {
     KILL_SHARD_WORKER: "in_shard",
     STORE_CONTENTION: "store_op",
     RELOAD_DURING_HAMMER: "serving_request",
+    KILL_REPLICA: "replica_predict",
+    WEDGE_PREDICT: "replica_predict",
+    DEVICE_ERROR: "replica_predict",
 }
 
 
@@ -122,9 +131,19 @@ class NodeFault:
     times: int = 1
     # KILL_SHARD_WORKER: which shard index of the fanned-out pool dies.
     shard: int = 0
-    # RELOAD_DURING_HAMMER: fire once the Nth request has arrived (so the
-    # hammer is demonstrably in flight when the swap happens).
+    # RELOAD_DURING_HAMMER / KILL_REPLICA: fire once the Nth request has
+    # arrived (so the hammer is demonstrably in flight when the swap or
+    # kill happens).
     after: int = 1
+    # Replica-fault targeting (KILL_REPLICA / WEDGE_PREDICT /
+    # DEVICE_ERROR): which replica name the fault applies to; "" = the
+    # first replica the fault observes (then latched to it).
+    replica: str = ""
+    # WEDGE_PREDICT release valve: tests set() it to un-wedge early;
+    # otherwise the wedge parks for max_hang_s.
+    release: threading.Event = dataclasses.field(
+        default_factory=threading.Event, compare=False
+    )
     # KILL_SHARD_WORKER cross-process once-token: fork children inherit a
     # COPY of the plan's fired-set, so in-memory once-semantics cannot
     # span the pool — the first child to atomically create this file is
@@ -153,6 +172,11 @@ class FaultPlan:
         self.faults = dict(faults)
         self._fired: Dict[str, int] = {}
         self._requests = 0  # serving_request arrivals (RELOAD_DURING_HAMMER)
+        self._replica_calls = 0   # replica_predict arrivals (KILL_REPLICA)
+        # KILL_REPLICA latch: replica name -> the generation that died.
+        # Every call from that (replica, generation) fails; the rebuild
+        # bumps the generation, so the rebuilt incarnation runs clean.
+        self._killed: Dict[str, int] = {}
         self._pid = None    # set at activate(): detects fork children
         self._lock = threading.Lock()
         self.log: List[Tuple[str, str]] = []
@@ -358,3 +382,70 @@ def serving_request(server, endpoint: str) -> None:
     threading.Thread(
         target=server.reload, name="tpp-fault-reload", daemon=True
     ).start()
+
+
+def replica_predict(replica_name: str, generation: int = 0) -> None:
+    """Per call on a fleet replica's hot paths (batched predict, the
+    supervisor heartbeat, the generative engine's worker loop), keyed by
+    ``REPLICA_KEY``.
+
+    KILL_REPLICA: after the ``after``-th call fleet-wide, the targeted
+    replica's CURRENT generation is latched dead — every subsequent call
+    from that (replica, generation) raises, exactly like a device that
+    fell off the bus.  A rebuild bumps the generation, so the rebuilt
+    incarnation runs clean: the recovery proof needs the death to be
+    *persistent until healed*, not a one-shot blip.
+
+    WEDGE_PREDICT: ``times`` calls park on the fault's ``release`` event
+    (bounded by ``max_hang_s``) — the wedged-device shape the
+    supervisor's queue-age probe must catch.
+
+    DEVICE_ERROR: ``times`` calls raise a transient device-runtime error
+    (the transfer-failure shape ``classify_error`` marks retriable), so
+    request failover engages without any replica being declared dead.
+    """
+    plan = _ACTIVE
+    if plan is None:
+        return
+    fault = plan.faults.get(REPLICA_KEY)
+    if fault is None or _KIND_TO_POINT.get(fault.kind) != "replica_predict":
+        return
+    if fault.kind == KILL_REPLICA:
+        with plan._lock:
+            latched = plan._killed.get(replica_name)
+            if latched is not None:
+                if latched == generation:
+                    pass  # still the dead incarnation: fall through, raise
+                else:
+                    return  # rebuilt: the new generation runs clean
+            else:
+                if fault.replica and fault.replica != replica_name:
+                    return
+                plan._replica_calls += 1
+                if plan._replica_calls < max(1, fault.after):
+                    return
+                if plan._fired.get(REPLICA_KEY, 0) >= 1:
+                    return  # only one replica dies per plan
+                plan._fired[REPLICA_KEY] = 1
+                plan._killed[replica_name] = generation
+                plan.log.append(
+                    (REPLICA_KEY, f"kill_replica:{replica_name}")
+                )
+        raise InjectedFault(f"{fault.message} (replica {replica_name} dead)")
+    if fault.replica and fault.replica != replica_name:
+        return
+    claimed = plan._take(REPLICA_KEY, "replica_predict")
+    if claimed is None:
+        return
+    if fault.kind == WEDGE_PREDICT:
+        plan.record(REPLICA_KEY, f"wedge_predict:{replica_name}")
+        released = fault.release.wait(fault.max_hang_s)
+        plan.record(
+            REPLICA_KEY, "wedge_released" if released else "wedge_ceiling"
+        )
+        raise InjectedFault(f"{fault.message} (predict wedged)")
+    plan.record(REPLICA_KEY, f"device_error:{replica_name}")
+    raise RuntimeError(
+        f"{fault.message}: failed to transfer buffer to device "
+        f"(injected device error on replica {replica_name})"
+    )
