@@ -724,6 +724,211 @@ def _taxi_window_mesh_measure(smoke: bool) -> dict:
     }
 
 
+def bench_bert_parallelism(smoke: bool) -> dict:
+    """The bert window sweep's parallelism axis (ISSUE 18): the SAME
+    windowed fine-tune step under dp | fsdp | fsdp+accum | ring-attention
+    long-context, recording MFU and peak device memory per config.
+
+    ``fsdp`` must hold throughput against pure DP for a chip-sized
+    control model (the acceptance bar is within 10%; ``fsdp_mfu_vs_dp``
+    records the measured ratio), while its per-device parameter bytes
+    read params/N — the memory headroom that buys models bigger than a
+    chip.  ``ring_long`` runs the long-context config on a (data x seq)
+    mesh with sequence-sharded infeed.  Same honest-box caveats as the
+    taxi mesh leg: on a one-device box the sweep runs in a child process
+    on 8 virtual CPU devices (``simulated_cpu_mesh: true`` — collective
+    and memory semantics are real, chip scaling is not); real-chip MFU
+    anchors land with BENCH_R6.
+    """
+    import jax
+
+    if len(jax.devices()) <= 1:
+        import subprocess
+        import sys
+
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8"
+            ).strip(),
+            "BENCH_SMOKE": "1" if smoke else "0",
+        }
+        proc = subprocess.run(
+            [
+                sys.executable, "-c",
+                "import os, json, bench; print(json.dumps("
+                "bench._bert_parallelism_measure("
+                "bool(int(os.environ['BENCH_SMOKE'])))))",
+            ],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"simulated-mesh child failed: {proc.stderr[-500:]}"
+            )
+        result = json.loads(proc.stdout.strip().splitlines()[-1])
+        result["simulated_cpu_mesh"] = True
+        return result
+    result = _bert_parallelism_measure(smoke)
+    result["simulated_cpu_mesh"] = False
+    return result
+
+
+def _bert_parallelism_measure(smoke: bool) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh
+
+    from tpu_pipelines.models.bert import DEFAULT_HPARAMS, build_bert_model
+    from tpu_pipelines.parallel.mesh import MeshConfig, make_mesh
+    from tpu_pipelines.parallel.ring_attention import (
+        long_context_batch_partition,
+    )
+    from tpu_pipelines.trainer import TrainLoopConfig, train_loop
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    seq = 128
+    long_seq = 256 if smoke else 2048
+    batch = 16 if smoke else BERT_BENCH_BATCH
+    if batch % n_dev:
+        batch = ((batch + n_dev - 1) // n_dev) * n_dev
+    steps = 4 if smoke else 48
+    window = 2 if smoke else 8
+    hp = {
+        **DEFAULT_HPARAMS,
+        "max_len": seq,
+        "attn_impl": "auto",
+        "num_classes": 2,
+    }
+    if smoke:
+        hp.update({"d_model": 64, "n_layers": 2, "n_heads": 4, "d_ff": 128,
+                   "vocab_size": 512})
+    peak = chip_info()["peak_bf16_flops"]
+    data_mesh = make_mesh(MeshConfig(), devices=devices)
+    seq_axis = 4 if n_dev % 4 == 0 else n_dev
+    ring_mesh = Mesh(
+        np.array(devices).reshape(n_dev // seq_axis, 1, seq_axis, 1, 1),
+        ("data", "model", "seq", "expert", "pipe"),
+    )
+    # Smoke's short sequences sit under the default ring floor; pin the
+    # gate to the leg's long-context length (child process, no leakage).
+    os.environ.setdefault("TPP_RING_MIN_SEQ", str(long_seq))
+
+    def run_cfg(*, seq_len, mesh, model_mesh=None, dp=None, accum=1,
+                long_context=False):
+        hp_c = {**hp, "max_len": seq_len}
+        model = build_bert_model(hp_c, mesh=model_mesh)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(
+            4, hp_c["vocab_size"], size=(batch, seq_len), dtype=np.int64
+        )
+        data = {
+            "input_ids": ids.astype(np.int32),
+            "attention_mask": np.ones((batch, seq_len), np.int32),
+            "label": (ids[:, 0] % 2).astype(np.int32),
+        }
+        bp = long_context_batch_partition(data, mesh) if long_context else {}
+
+        def features(b):
+            return {k: v for k, v in b.items() if k != "label"}
+
+        def loss_fn(params, b, step_rng):
+            logits = model.apply(
+                {"params": params}, features(b),
+                deterministic=False, rngs={"dropout": step_rng},
+            )
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, jnp.asarray(b["label"], jnp.int32)
+            ).mean()
+            return loss, {}
+
+        def batches():
+            while True:
+                yield data
+
+        params, result = train_loop(
+            loss_fn=loss_fn,
+            init_params_fn=lambda r, b: model.init(r, features(b))["params"],
+            optimizer=optax.adamw(2e-5),
+            train_iter=batches(),
+            config=TrainLoopConfig(
+                train_steps=steps, batch_size=batch, log_every=0,
+                window_steps=window, dp_collective=dp,
+                grad_accum_steps=accum, batch_partition=bp,
+            ),
+            mesh=mesh,
+        )
+        eps = result.examples_per_sec_per_chip
+        counts = _count_params(params)
+        flops_per_step = (
+            6 * counts["matmul"] * batch * seq_len
+            + 12 * int(hp_c["n_layers"]) * batch * seq_len * seq_len
+            * int(hp_c["d_model"])
+        )
+        # Per-chip MFU at per-chip throughput: flops/step spread over the
+        # mesh against one chip's peak.
+        mfu = flops_per_step * (eps / batch) / peak if batch else 0.0
+        leaves = jax.tree_util.tree_leaves(params)
+        stats = (getattr(jax.local_devices()[0], "memory_stats",
+                         lambda: None)() or {})
+        return {
+            "examples_per_sec_per_chip": eps,
+            "mfu": round(mfu, 6),
+            "param_bytes_total": sum(v.nbytes for v in leaves),
+            # The fsdp memory story, measured: resident parameter bytes on
+            # ONE device (params/N sharded, == total when replicated).
+            "param_bytes_per_device": sum(
+                v.addressable_shards[0].data.nbytes for v in leaves
+            ),
+            # Populated on backends that expose an allocator (TPU/GPU);
+            # None on the CPU smoke box — param_bytes_per_device carries
+            # the structural evidence there.
+            "device_memory_peak_bytes": stats.get("peak_bytes_in_use"),
+            "seq_len": seq_len,
+            "grad_accum_steps": accum,
+            "dp_collective": dp or "implicit",
+        }
+
+    sweep = {
+        "dp": run_cfg(seq_len=seq, mesh=data_mesh, dp="psum_bucketed"),
+        "fsdp": run_cfg(seq_len=seq, mesh=data_mesh, dp="fsdp"),
+        "fsdp_accum": run_cfg(
+            seq_len=seq, mesh=data_mesh, dp="fsdp", accum=2
+        ),
+        "ring_long": run_cfg(
+            seq_len=long_seq, mesh=ring_mesh, model_mesh=ring_mesh,
+            long_context=True,
+        ),
+    }
+    dp_mfu = sweep["dp"]["mfu"]
+    return {
+        "examples_per_sec_per_chip": sweep["dp"]["examples_per_sec_per_chip"],
+        "parallelism": sweep,
+        "fsdp_mfu_vs_dp": (
+            round(sweep["fsdp"]["mfu"] / dp_mfu, 4) if dp_mfu else None
+        ),
+        "fsdp_param_shard_ratio": (
+            round(
+                sweep["fsdp"]["param_bytes_per_device"]
+                / sweep["fsdp"]["param_bytes_total"], 4,
+            )
+            if sweep["fsdp"]["param_bytes_total"] else None
+        ),
+        "mesh_devices": n_dev,
+        "window_steps": window,
+        "batch_size": batch,
+        "steps_per_run": steps,
+        "host_cpus": os.cpu_count() or 1,
+        "virtual_devices_share_cores": (os.cpu_count() or 1) < n_dev,
+        "method": "train_loop_bert_window_parallelism_sweep",
+    }
+
+
 def _device_resident_eps(
     *, loss, init_params, batch_data, batch, optimizer, n1, n2, repeats
 ) -> dict:
@@ -4549,6 +4754,12 @@ def _compact(report: dict) -> dict:
     if isinstance(twm, dict) and "mesh_window_speedup" in twm:
         compact["mesh_window_speedup"] = twm["mesh_window_speedup"]
         compact["scaling_efficiency"] = twm.get("scaling_efficiency")
+    bpar = report.get("bert_parallelism")
+    if isinstance(bpar, dict) and "fsdp_mfu_vs_dp" in bpar:
+        compact["fsdp_mfu_vs_dp"] = bpar["fsdp_mfu_vs_dp"]
+        compact["fsdp_param_shard_ratio"] = bpar.get(
+            "fsdp_param_shard_ratio"
+        )
     # Kernel-autotune headline (ISSUE 9): tuned-over-default flash speedup
     # at the workhorse shape and the measured flash/dense crossover.
     fp = report.get("flash_probe")
@@ -4735,6 +4946,10 @@ def main() -> None:
         retries=1, post=taxi_window_mesh_post)
     # +80 s vs r5: the windowed BERT datapoint is one extra compile + run.
     leg("bert", bench_bert, est_cost_s=200)
+    # The bert window sweep's parallelism axis (ISSUE 18): dp | fsdp |
+    # fsdp+accum | ring-attention long-context, MFU + memory per config.
+    leg("bert_parallelism", bench_bert_parallelism, est_cost_s=180,
+        retries=1)
     e2e: dict = {}
     report["pipeline_e2e"] = e2e
 

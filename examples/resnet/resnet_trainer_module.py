@@ -15,7 +15,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from tpu_pipelines.data.input_pipeline import BatchIterator, InputConfig
+from tpu_pipelines.data.input_pipeline import (
+    BatchIterator, InputConfig, per_host_input_config,
+)
 from tpu_pipelines.models.resnet import DEFAULT_HPARAMS, build_resnet_model
 from tpu_pipelines.parallel.mesh import MeshConfig
 from tpu_pipelines.trainer import (

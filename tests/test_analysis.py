@@ -971,6 +971,98 @@ def test_tpp212_unsupervised_fleet(tmp_path):
             assert "supervisor_interval_s" in f212[0].fix
 
 
+def test_tpp213_pinned_dp_mode_with_partition(tmp_path):
+    """TPP213: param_partition/partition_rules next to a statically pinned
+    non-fsdp dp_collective fires WARN; fsdp, auto, None, a dynamic mode,
+    partition-free modules, and a suppression comment all stay silent."""
+    from tpu_pipelines.utils.module_loader import load_fn
+
+    mod = tmp_path / "sharded.py"
+    mod.write_text(textwrap.dedent('''
+        def pinned_psum(fn_args):
+            from tpu_pipelines.trainer import TrainLoopConfig
+
+            return TrainLoopConfig(
+                train_steps=4, dp_collective="psum_bucketed",
+                param_partition=fn_args.specs,
+            )
+
+
+        def pinned_ordered_rules_elsewhere(fn_args):
+            from tpu_pipelines.trainer import TrainLoopConfig
+
+            rules = fn_args.model.partition_rules
+            return TrainLoopConfig(
+                train_steps=4, dp_collective="ordered",
+            ), rules
+
+
+        def fsdp_is_fine(fn_args):
+            from tpu_pipelines.trainer import TrainLoopConfig
+
+            return TrainLoopConfig(
+                train_steps=4, dp_collective="fsdp",
+                param_partition=fn_args.specs,
+            )
+
+
+        def auto_is_fine(fn_args):
+            from tpu_pipelines.trainer import TrainLoopConfig
+
+            return TrainLoopConfig(
+                train_steps=4, dp_collective="auto",
+                param_partition=fn_args.specs,
+            )
+
+
+        def implicit_none_is_fine(fn_args):
+            from tpu_pipelines.trainer import TrainLoopConfig
+
+            return TrainLoopConfig(
+                train_steps=4, param_partition=fn_args.specs,
+            )
+
+
+        def dynamic_mode_is_fine(fn_args):
+            from tpu_pipelines.trainer import TrainLoopConfig
+
+            return TrainLoopConfig(
+                train_steps=4, dp_collective=fn_args.mode,
+                param_partition=fn_args.specs,
+            )
+
+
+        def no_partition_is_fine(fn_args):
+            from tpu_pipelines.trainer import TrainLoopConfig
+
+            return TrainLoopConfig(
+                train_steps=4, dp_collective="psum_bucketed",
+            )
+
+
+        def suppressed(fn_args):
+            from tpu_pipelines.trainer import TrainLoopConfig
+
+            return TrainLoopConfig(
+                train_steps=4,
+                dp_collective="ordered",  # tpp: disable=TPP213
+                param_partition=fn_args.specs,
+            )
+    '''))
+    for fn, n in (("pinned_psum", 1),
+                  ("pinned_ordered_rules_elsewhere", 1),
+                  ("fsdp_is_fine", 0), ("auto_is_fine", 0),
+                  ("implicit_none_is_fine", 0),
+                  ("dynamic_mode_is_fine", 0),
+                  ("no_partition_is_fine", 0), ("suppressed", 0)):
+        findings = check_callable(load_fn(str(mod), fn), "Trainer")
+        f213 = [f for f in findings if f.rule == "TPP213"]
+        assert len(f213) == n, (fn, findings)
+        if n:
+            assert f213[0].severity == "warn"
+            assert "fsdp" in f213[0].fix
+
+
 def test_tpp210_mesh_without_per_host_input(tmp_path):
     """TPP210: a configured mesh next to an unsharded InputConfig fires
     WARN; explicit shard kwargs, the per_host_input_config helper, an
@@ -1547,6 +1639,18 @@ def MeshGen(ctx):
 
 def create_pipeline():
     gen = MeshGen()
+    return _pipe([gen, Sink(examples=gen.outputs["examples"])])
+''',
+    "TPP213": '''
+@component(outputs={{"examples": "Examples"}}, name="ShardGen")
+def ShardGen(ctx):
+    cfg = {{"train_steps": 4, "dp_collective": "psum_bucketed",
+            "param_partition": ctx.specs}}
+    return cfg
+
+
+def create_pipeline():
+    gen = ShardGen()
     return _pipe([gen, Sink(examples=gen.outputs["examples"])])
 ''',
 }

@@ -73,8 +73,8 @@ def test_bench_smoke_emits_compact_stdout_and_full_report():
     assert report["metric"] == compact["metric"]
     assert report["value"] == compact["value"]
     for key in ("bert", "taxi", "taxi_device", "taxi_window",
-                "taxi_window_mesh", "mnist", "resnet", "pipeline_e2e",
-                "flash_probe", "t5_decode"):
+                "taxi_window_mesh", "bert_parallelism", "mnist", "resnet",
+                "pipeline_e2e", "flash_probe", "t5_decode"):
         assert report.get(key) is not None or key in report["errors"], (
             key, report.get("errors")
         )
@@ -417,6 +417,33 @@ def test_bench_smoke_emits_compact_stdout_and_full_report():
     bw = report["bert"]["window_sweep"]
     assert set(bw) == {"1", str(report["bert"]["window_steps_log_every"])}
     assert all(v > 0 for v in bw.values()), bw
+    # The window sweep's parallelism axis (ISSUE 18): dp | fsdp |
+    # fsdp+accum | ring-attn long-context, each with MFU and the per-device
+    # memory evidence; fsdp params must actually live sharded (1/N bytes).
+    bpar = report["bert_parallelism"]
+    assert isinstance(bpar["simulated_cpu_mesh"], bool)
+    assert bpar["mesh_devices"] == 8
+    par = bpar["parallelism"]
+    assert set(par) == {"dp", "fsdp", "fsdp_accum", "ring_long"}
+    for name, row in par.items():
+        assert row["examples_per_sec_per_chip"] > 0, (name, row)
+        assert row["mfu"] > 0, (name, row)
+        assert row["param_bytes_total"] > 0
+        assert row["param_bytes_per_device"] > 0
+        assert "device_memory_peak_bytes" in row
+    assert par["dp"]["dp_collective"] == "psum_bucketed"
+    assert par["fsdp"]["dp_collective"] == "fsdp"
+    assert par["fsdp_accum"]["grad_accum_steps"] == 2
+    assert par["ring_long"]["dp_collective"] == "implicit"
+    assert par["ring_long"]["seq_len"] > par["dp"]["seq_len"]
+    # ZeRO-3 evidence: fsdp keeps ~1/8 of the params per device; dp
+    # replicates them all.
+    assert bpar["fsdp_param_shard_ratio"] <= 0.25
+    assert (par["dp"]["param_bytes_per_device"]
+            == par["dp"]["param_bytes_total"])
+    assert bpar["fsdp_mfu_vs_dp"] is not None
+    assert compact["fsdp_mfu_vs_dp"] == bpar["fsdp_mfu_vs_dp"]
+    assert compact["fsdp_param_shard_ratio"] == bpar["fsdp_param_shard_ratio"]
     # Kernel-autotune sweep leg (ISSUE 9): flash_probe sweeps seq lengths
     # recording tuned-vs-default-vs-dense, the tuned config can never lose
     # to the default (it is IN the candidate grid), dense is skipped via

@@ -191,7 +191,9 @@ def test_collective_overlap_hlo_bucketed_inside_scan_body():
 
     def step(carry, batch):
         params, opt_state = carry
-        loss, _metrics, grads = fb(params, batch, jax.random.key(0))
+        loss, _metrics, grads, _ = fb(
+            params, None, batch, jax.random.key(0)
+        )
         updates, opt_state = opt.update(grads, opt_state, params)
         return (optax.apply_updates(params, updates), opt_state), loss
 
@@ -437,10 +439,24 @@ def test_dp_collective_validation_and_env(monkeypatch):
 
     with pytest.raises(ValueError, match="expected one of"):
         run_cfg(dp_collective="ring")
-    with pytest.raises(ValueError, match="grad_accum"):
-        run_cfg(dp_collective="ordered", grad_accum_steps=2)
     with pytest.raises(ValueError, match="dp_grad_blocks"):
         run_cfg(dp_collective="ordered", dp_grad_blocks=5)
+    # Capability-accurate routing (ISSUE 18): features that used to be a
+    # blanket refusal now either compose or name the mode that serves them.
+    _, result = run_cfg(dp_collective="ordered", grad_accum_steps=2)
+    assert result.steps_completed == 4  # grad_accum composes with all modes
+    from jax.sharding import PartitionSpec as P
+
+    with pytest.raises(ValueError, match="fsdp"):
+        run_cfg(
+            dp_collective="ordered",
+            param_partition={"w1": P("data"), "w2": P()},
+        )
+    with pytest.raises(ValueError, match="implicit"):
+        run_cfg(
+            dp_collective="psum_bucketed",
+            batch_partition={"x": P("data", "seq")},
+        )
 
     # Env rung: TPP_DP_COLLECTIVE applies when config leaves it unset...
     monkeypatch.setenv("TPP_DP_COLLECTIVE", "ordered")

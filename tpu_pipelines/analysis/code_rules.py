@@ -644,6 +644,78 @@ def _check_unsupervised_fleet(
     return out
 
 
+# Source-level markers that the module shards parameters: the train loop's
+# explicit spec tree or a model's (regex, PartitionSpec) rule list.  Their
+# presence arms TPP213.
+_PARTITION_MARKERS = ("param_partition", "partition_rules")
+# dp_collective values that can honour a param partition: "fsdp" gathers /
+# reduce-scatters the shards inside the scan window; "auto" resolves from
+# TPP_DP_COLLECTIVE at run time so the pin is not static.
+_FSDP_CAPABLE_MODES = {"fsdp", "auto"}
+
+
+def _mentions(tree: ast.AST, names) -> bool:
+    wanted = set(names)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and node.id in wanted:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in wanted:
+            return True
+        if isinstance(node, ast.keyword) and node.arg in wanted:
+            return True
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and node.value in wanted
+        ):
+            return True
+    return False
+
+
+def _check_pinned_dp_with_partition(
+    src: _Source, node_id: str, fn_label: str
+) -> List[Finding]:
+    """TPP213: params are sharded but dp_collective is statically pinned
+    to an explicit non-fsdp mode.
+
+    A module that configures ``param_partition`` (or model
+    ``partition_rules``) wants ZeRO-3-style sharded parameters — but
+    ``dp_collective="psum_bucketed"`` / ``"ordered"`` keep a replicated
+    copy of every param on every device and the train loop refuses the
+    combination at startup.  Fires when any call / dict literal pins
+    ``dp_collective`` to a string constant outside {"fsdp", "auto"} while
+    either partition marker appears anywhere in the module.  ``None`` /
+    ``"auto"`` (implicit GSPMD honours the specs) and ``"fsdp"`` stay
+    silent, as do dynamic mode values."""
+    if not _mentions(src.tree, _PARTITION_MARKERS):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(src.tree):
+        pairs = dict(_const_str_pairs(node))
+        dp = pairs.get("dp_collective")
+        if not (
+            isinstance(dp, ast.Constant)
+            and isinstance(dp.value, str)
+            and dp.value not in _FSDP_CAPABLE_MODES
+        ):
+            continue
+        f = _finding(
+            src, dp, "TPP213", WARN, node_id,
+            f"{fn_label}: dp_collective={dp.value!r} pinned next to "
+            "param_partition/partition_rules — the explicit psum/ordered "
+            "modes keep params replicated on every device, so the "
+            "partition is never applied and the train loop rejects the "
+            "pair at startup",
+            'set dp_collective="fsdp" (shards params over the data axis, '
+            "per-layer all-gather in the scan window, reduce-scatter "
+            "grads) or leave it None/\"auto\" so implicit GSPMD honours "
+            "the specs",
+        )
+        if f:
+            out.append(f)
+    return out
+
+
 def _check_closure_staleness(
     src: _Source, node_id: str, fn_label: str, fn: Callable
 ) -> List[Finding]:
@@ -697,6 +769,7 @@ def check_callable(
     out.extend(_check_whole_request_decode(src, node_id, label))
     out.extend(_check_unsupervised_fleet(src, node_id, label))
     out.extend(_check_mesh_unsharded_input(src, node_id, label))
+    out.extend(_check_pinned_dp_with_partition(src, node_id, label))
     return out
 
 

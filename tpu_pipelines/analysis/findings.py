@@ -175,6 +175,14 @@ RULES: Dict[str, Dict[str, str]] = {
                  "replica, so the router keeps offering it traffic and "
                  "the redundancy buys nothing",
     },
+    "TPP213": {
+        "severity": WARN,
+        "title": "param_partition/partition_rules configured but "
+                 "dp_collective is statically pinned to a non-fsdp "
+                 "explicit mode — psum/ordered keep params replicated, "
+                 "the partition is never applied, and the train loop "
+                 "rejects the pair at startup",
+    },
 }
 
 GRAPH_RULE_PREFIX = "TPP1"

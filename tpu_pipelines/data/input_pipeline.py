@@ -399,6 +399,36 @@ def windowed_infeed(
         yield pending.popleft()
 
 
+def stage_global(batch: Batch, shardings: Dict[str, Any]) -> Dict[str, Any]:
+    """Place one host batch on device under per-key shardings — the infeed
+    primitive behind the train loop's ``put_batch``/``stage_window``.
+
+    Single-process (the CPU test mesh, one TPU host): a plain async
+    ``device_put`` per key.  Multi-host (``jax.process_count() > 1``, e.g. a
+    v4-32 pod slice): each host holds only ITS rows of the global batch, so
+    ``device_put`` against a global sharding would mis-scale — use
+    ``jax.make_array_from_process_local_data``, which assembles the global
+    array from per-process shards without gathering through host 0.  Either
+    way the result is one jax.Array per key laid out exactly as the jitted
+    step's ``in_shardings`` expect (no implicit reshard on dispatch) — this
+    includes ``P("data", "seq")`` long-context layouts from
+    :func:`~tpu_pipelines.parallel.ring_attention.long_context_batch_partition`.
+    """
+    import jax
+
+    if jax.process_count() > 1:
+        return {
+            k: jax.make_array_from_process_local_data(
+                shardings[k], np.asarray(v)
+            )
+            for k, v in batch.items()
+        }
+    return {
+        k: jax.device_put(np.asarray(v), shardings[k])
+        for k, v in batch.items()
+    }
+
+
 def sharded_batches(
     iterator: BatchIterator, mesh: Any
 ) -> Iterator[Any]:

@@ -54,6 +54,11 @@ DENSE_ATTN_TEMP_FACTOR = 3.0
 # device memory — headroom for params, optimizer state and activations.
 # Override per-process with TPP_DENSE_ATTN_HBM_FRACTION.
 DENSE_ATTN_HBM_FRACTION = 0.4
+# Long-context gate for "auto" on a mesh whose 'seq' axis is populated:
+# self-attention at/above this sequence length rides ring attention
+# (sequence-parallel ppermute ring, parallel/ring_attention.py) inside
+# the windowed train loop.  Override per-process with TPP_RING_MIN_SEQ.
+RING_MIN_SEQ = 2048
 
 
 def _device_memory_bytes() -> int:
@@ -135,6 +140,15 @@ def choose_attn_impl(
     per-device crossover, with memory feasibility as the OOM guard only.
 
     Decision order:
+      0. the mesh's ``seq`` axis is populated and the (self-attention)
+         shape is long-context — ``seq_q == seq_kv`` at/above
+         ``TPP_RING_MIN_SEQ`` (default 2048), or even the per-shard dense
+         tile doesn't fit — => "ring": the sequence is sharded over the
+         axis, so single-device kernels never see the full L; ring
+         attention streams the kv blocks around the mesh with overlapped
+         ``ppermute`` (the long-context window path, ISSUE 18).  Short
+         sequences on a seq mesh stay on the measured rule below — the
+         ring's per-hop latency only pays for itself once L is large;
       1. dense's O(L^2) temporaries don't fit => "flash" (the guard —
          feasibility, exactly what ``dense_attn_fits`` was built for);
       2. a measured crossover exists for this device_kind (recorded by
@@ -143,6 +157,16 @@ def choose_attn_impl(
       3. no measurement => "dense" (every probe so far measured dense
          faster wherever it fits; flash must EARN the hot path).
     """
+    if (
+        mesh is not None
+        and mesh.shape.get("seq", 1) > 1
+        and seq_q == seq_kv
+    ):
+        floor = int(os.environ.get("TPP_RING_MIN_SEQ", RING_MIN_SEQ))
+        if seq_q >= floor or not dense_attn_fits(
+            batch, heads, seq_q, seq_kv, itemsize, mesh=mesh
+        ):
+            return "ring"
     if not dense_attn_fits(batch, heads, seq_q, seq_kv, itemsize, mesh=mesh):
         return "flash"
     from tpu_pipelines.ops import autotune

@@ -53,6 +53,74 @@ def make_param_partition(params: Any, rules: Rules) -> Any:
     )
 
 
+def fsdp_param_partition(params: Any, mesh, *, axis: str = "data") -> Any:
+    """Derive the default ZeRO-3 partition for ``dp_collective="fsdp"``:
+    each leaf sharded over the mesh ``axis`` along its first dimension
+    divisible by the axis size; leaves with no divisible dim replicate.
+
+    ``params`` may be real arrays or ``jax.eval_shape`` output.  An
+    explicit ``param_partition`` (from model rules) overrides this — the
+    train loop only calls it when no rules are configured."""
+    n = int(mesh.shape[axis])
+
+    def spec_for(leaf):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if n > 1:
+            for dim, d in enumerate(shape):
+                if d >= n and d % n == 0:
+                    return P(*([None] * dim), axis)
+        return P()
+
+    return jax.tree_util.tree_map(spec_for, params)
+
+
+def foreign_axis_paths(
+    params: Any, partition: Any, *, axis: str = "data"
+) -> List[str]:
+    """Param paths whose spec names a mesh axis other than ``axis``.
+
+    ``fsdp`` shards params over the data axis only (the gather/scatter
+    collectives run inside a shard_map over ``data``); a spec naming
+    ``model``/``seq``/... belongs to the implicit-GSPMD path instead, and
+    the train loop turns these paths into an actionable error."""
+    out: List[str] = []
+    flat_p, _ = jax.tree_util.tree_flatten_with_path(params)
+    flat_s = jax.tree_util.tree_leaves(
+        partition, is_leaf=lambda x: isinstance(x, P)
+    )
+    for (path, _), spec in zip(flat_p, flat_s):
+        for entry in spec:
+            if entry is None:
+                continue
+            names = (entry,) if isinstance(entry, str) else tuple(entry)
+            if any(a != axis for a in names):
+                out.append(f"{path_str(path)}: {spec}")
+                break
+    return out
+
+
+def gather_leaf(x, spec, *, axis: str = "data"):
+    """All-gather one param leaf back to full size along the dim ``spec``
+    shards over ``axis`` (``tiled=True`` — shards concatenate in place);
+    identity for replicated leaves.  Must run inside a ``shard_map`` that
+    binds ``axis``.
+
+    This is the fsdp fast-path primitive: each leaf gets its OWN
+    ``all_gather`` op, so the compiled scan body carries one collective
+    per parameter — distinct ops the scheduler can start while earlier
+    layers still compute, exactly like the PR 15 bucketed psums.  Under
+    ``jax.value_and_grad`` the AD transpose of a tiled all-gather is
+    ``psum_scatter``: the backward pass emits the reduce-scatter gradient
+    exchange with no further code."""
+    for dim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        names = (entry,) if isinstance(entry, str) else tuple(entry)
+        if axis in names:
+            return jax.lax.all_gather(x, axis, axis=dim, tiled=True)
+    return x
+
+
 def validate_partition(params: Any, partition: Any, mesh) -> List[str]:
     """Return human-readable problems (axis sizes not dividing dims)."""
     problems = []

@@ -252,3 +252,28 @@ def ulysses_attention(
         out_specs=qkv_spec,
         check_vma=False,
     )(q, k, v)
+
+def long_context_batch_partition(sample_batch, mesh: Mesh, *, axis: str = "seq",
+                                 batch_axis: str = "data"):
+    """``TrainLoopConfig.batch_partition`` for a long-context run: shard
+    every token-shaped input feature ``[batch, seq, ...]`` over
+    ``(batch_axis, axis)`` so each device receives its own sequence slice
+    at the infeed boundary and ring/ulysses attention never materialises a
+    full-length activation.
+
+    A feature counts as token-shaped when it has a second dimension
+    divisible by the ``seq`` axis size; scalars-per-example (labels,
+    weights) keep the plain data-parallel layout and are omitted from the
+    returned dict (the train loop's default covers them).  Returns ``{}``
+    on a mesh whose ``seq`` axis is unpopulated — safe to pass through
+    unconditionally.
+    """
+    n = int(mesh.shape[axis])
+    if n <= 1:
+        return {}
+    out = {}
+    for key, v in sample_batch.items():
+        shape = tuple(getattr(v, "shape", ()) or ())
+        if len(shape) >= 2 and shape[1] >= n and shape[1] % n == 0:
+            out[key] = P(batch_axis, axis)
+    return out

@@ -35,6 +35,11 @@ from tpu_pipelines.parallel.mesh import (
     make_mesh,
     replicate,
 )
+from tpu_pipelines.parallel.partition import (
+    foreign_axis_paths,
+    fsdp_param_partition,
+    validate_partition,
+)
 from tpu_pipelines.trainer.fn_args import TrainResult
 from tpu_pipelines.trainer.goodput import GoodputTracker
 
@@ -121,8 +126,18 @@ class TrainLoopConfig:
     # the param trajectory is bitwise-invariant to the data-axis size, so
     # an elastic resume onto a survivor mesh continues the exact same
     # trajectory; costs all-gather bandwidth (block grads move whole).
-    # Both explicit modes require pure DP: no param_partition /
-    # batch_partition / grad_accum / model_state.
+    # "fsdp": ZeRO-3 — params (and Adam moments) live SHARDED over the
+    # data axis per ``param_partition`` (or a derived default: first dim
+    # divisible by the axis), each leaf is all-gathered just-in-time
+    # inside the scan body (a distinct collective per leaf, overlappable
+    # like the bucketed psums; the backward re-gathers under a remat
+    # policy instead of saving full params), and the gradient exchange is
+    # the reduce-scatter AD transpose of those gathers — per-device
+    # resident bytes ≈ params/N + one layer's gather.  Capability table:
+    # param_partition requires "fsdp" (data-axis specs) or None/"auto"
+    # (arbitrary GSPMD axes); batch_partition (ring-attention sequence
+    # sharding) requires None/"auto"; grad_accum_steps and model_state
+    # compose with every mode.
     dp_collective: Optional[str] = None
     # Chunked-psum bucket count for "psum_bucketed" (>=1; grad leaves are
     # round-robined into buckets, one psum each).
@@ -228,7 +243,7 @@ def _opt_state_sharding(opt_state, params, p_shard, mesh: Mesh):
 
 
 ENV_DP_COLLECTIVE = "TPP_DP_COLLECTIVE"
-_DP_MODES = ("auto", "psum_bucketed", "ordered")
+_DP_MODES = ("auto", "psum_bucketed", "ordered", "fsdp")
 
 
 def _effective_dp_collective(config: TrainLoopConfig) -> str:
@@ -246,6 +261,9 @@ def _effective_dp_collective(config: TrainLoopConfig) -> str:
     return mode
 
 
+_FSDP_GATHER_NAME = "fsdp_allgather"
+
+
 def _make_dp_forward_backward(
     loss_fn: LossFn,
     mesh: Mesh,
@@ -253,9 +271,12 @@ def _make_dp_forward_backward(
     *,
     buckets: int,
     grad_blocks: int,
+    accum: int = 1,
+    has_model_state: bool = False,
+    fsdp_specs: Optional[Any] = None,
 ):
-    """Mesh-explicit DP forward/backward: (params, batch, rng) ->
-    (loss, metrics, grads), all replicated.
+    """Mesh-explicit DP forward/backward: (params, model_state, batch, rng)
+    -> (loss, metrics, grads, new_model_state), loss/metrics replicated.
 
     The gradient exchange is expressed INSIDE the function (and therefore
     inside the windowed scan body) instead of being left to GSPMD:
@@ -272,70 +293,230 @@ def _make_dp_forward_backward(
         size computes the same per-block grads and reduces them with the
         same op, the result is bitwise-invariant to the data-axis size —
         the contract elastic resume onto a survivor mesh relies on.
+      * ``fsdp`` — ZeRO-3: params arrive SHARDED per ``fsdp_specs`` (data
+        axis only).  Each leaf is all-gathered just-in-time (tiled, one
+        distinct op per leaf — the overlappable analogue of the psum
+        buckets) under a ``jax.checkpoint`` policy that refuses to save
+        the gathered values, so the backward re-gathers instead of
+        holding full params as residuals; differentiating w.r.t. the
+        SHARDS makes the AD transpose of each tiled all-gather a
+        ``psum_scatter`` — the reduce-scatter gradient exchange falls out
+        of autodiff, and grads leave sharded exactly like the params the
+        optimizer then updates shard-wise.
+
+    ``accum > 1`` composes with every mode as an inner ``lax.scan`` over
+    interleaved micro-batches of the LOCAL batch.  For ``psum_bucketed``
+    the scan accumulates per-device grads and the bucketed psums run once
+    per OUTER step (exchange volume independent of accum).  For
+    ``ordered`` the block-ordered exchange IS the summation-order
+    contract, so it runs per micro-batch and the replicated micro results
+    accumulate in fixed scan order — mesh-size bitwise invariance holds
+    through accumulation.  For ``fsdp`` the reduce-scatter is the AD
+    transpose inside each micro step (deferring it would need a
+    full-size local accumulator, defeating the sharded memory model);
+    the accumulator itself stays sharded at params/N bytes.
+
+    ``model_state`` (BatchNorm-style collections) threads micro-batch to
+    micro-batch; float leaves of the step's final state are psum-averaged
+    over the data axis (the sync-BN convention) for ``psum_bucketed`` /
+    ``fsdp``, while ``ordered`` averages the per-block states in block
+    order, preserving its mesh-size-invariance contract.
 
     Loss/metrics follow the same reduction as the grads, so the reported
     series inherits the mode's determinism contract.
     """
+    from jax.ad_checkpoint import checkpoint_name
+
     from tpu_pipelines.parallel.compat import shard_map
+    from tpu_pipelines.parallel.partition import gather_leaf
 
     data_axis = mesh.shape["data"]
 
-    def fb(params, batch, rng):
-        def local_psum(params, lb, rng):
-            (loss, metrics), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params, lb, rng)
-            leaves, treedef = jax.tree_util.tree_flatten(grads)
-            k = max(1, min(buckets, len(leaves)))
-            reduced: list = [None] * len(leaves)
-            for i in range(k):
-                chunk = tuple(leaves[i::k])
-                out = jax.lax.psum(chunk, "data")
-                for j, v in enumerate(out):
-                    reduced[i + j * k] = v
-            inv = 1.0 / data_axis
-            grads = jax.tree_util.tree_unflatten(
-                treedef, [v * inv for v in reduced]
-            )
-            loss = jax.lax.psum(loss, "data") * inv
-            metrics = jax.tree_util.tree_map(
-                lambda v: jax.lax.psum(v, "data") * inv, metrics
-            )
-            return loss, metrics, grads
+    def call_loss(params, ms, mb, rng):
+        """Either loss contract -> (loss, (metrics, new_model_state))."""
+        if has_model_state:
+            return loss_fn(params, ms, mb, rng)
+        loss, metrics = loss_fn(params, mb, rng)
+        return loss, (metrics, ms)
 
-        def local_ordered(params, lb, rng):
-            blocks = grad_blocks // data_axis
+    def plain_micro(params, ms, mb, rng):
+        (loss, (metrics, new_ms)), grads = jax.value_and_grad(
+            lambda p: call_loss(p, ms, mb, rng), has_aux=True
+        )(params)
+        return loss, metrics, grads, new_ms
 
-            def block_fb(mb):
-                (loss, metrics), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True
-                )(params, mb, rng)
-                return loss, metrics, grads
-
-            mb = jax.tree_util.tree_map(
-                lambda x: x.reshape(
-                    blocks, x.shape[0] // blocks, *x.shape[1:]
+    def fsdp_micro(p_shards, ms, mb, rng):
+        def from_shards(shards):
+            full = jax.tree_util.tree_map(
+                lambda x, s: checkpoint_name(
+                    gather_leaf(x, s), _FSDP_GATHER_NAME
                 ),
-                lb,
+                shards, fsdp_specs,
             )
-            l_b, m_b, g_b = jax.vmap(block_fb)(mb)
-            gather = lambda t: jax.lax.all_gather(t, "data", tiled=True)
-            inv = 1.0 / grad_blocks
-            ordered_sum = lambda v: jnp.sum(gather(v), axis=0) * inv
-            return (
-                ordered_sum(l_b),
-                jax.tree_util.tree_map(ordered_sum, m_b),
-                jax.tree_util.tree_map(ordered_sum, g_b),
-            )
+            return call_loss(full, ms, mb, rng)
 
-        local = local_psum if mode == "psum_bucketed" else local_ordered
+        f = jax.checkpoint(
+            from_shards,
+            policy=jax.checkpoint_policies.save_anything_except_these_names(
+                _FSDP_GATHER_NAME
+            ),
+        )
+        (loss, (metrics, new_ms)), g_shards = jax.value_and_grad(
+            f, has_aux=True
+        )(p_shards)
+        # g_shards left psum_scatter as the SUM over devices of the local
+        # grads' shard slice; the caller scales to the global mean.
+        return loss, metrics, g_shards, new_ms
+
+    def ordered_micro(params, ms, mb, rng):
+        blocks = grad_blocks // data_axis
+
+        def block_fb(bmb):
+            (loss, (metrics, new_ms)), grads = jax.value_and_grad(
+                lambda p: call_loss(p, ms, bmb, rng), has_aux=True
+            )(params)
+            return loss, metrics, grads, new_ms
+
+        bmb = jax.tree_util.tree_map(
+            lambda x: x.reshape(
+                blocks, x.shape[0] // blocks, *x.shape[1:]
+            ),
+            mb,
+        )
+        l_b, m_b, g_b, s_b = jax.vmap(block_fb)(bmb)
+        gather = lambda t: jax.lax.all_gather(t, "data", tiled=True)
+        inv = 1.0 / grad_blocks
+        ordered_sum = lambda v: jnp.sum(gather(v), axis=0) * inv
+        # Float collections average in block order (the mode's contract);
+        # integer leaves (counters) advance identically in every block and
+        # must keep their dtype — take block 0's value.
+        new_ms = (
+            jax.tree_util.tree_map(
+                lambda v: (
+                    ordered_sum(v)
+                    if jnp.issubdtype(v.dtype, jnp.inexact) else v[0]
+                ),
+                s_b,
+            )
+            if has_model_state else ms
+        )
+        return (
+            ordered_sum(l_b),
+            jax.tree_util.tree_map(ordered_sum, m_b),
+            jax.tree_util.tree_map(ordered_sum, g_b),
+            new_ms,
+        )
+
+    micro_fb = {
+        "psum_bucketed": plain_micro,
+        "ordered": ordered_micro,
+        "fsdp": fsdp_micro,
+    }[mode]
+
+    def fb(params, mstate, batch, rng):
+        # Loss/metrics shapes for the accumulator carry, traced OUTSIDE the
+        # shard_map (mean reductions make them batch-size independent).
+        out_sd = (
+            jax.eval_shape(call_loss, params, mstate, batch, rng)
+            if accum > 1 else None
+        )
+
+        def local(params, ms, lb, rng):
+            if accum == 1:
+                loss, metrics, grads, new_ms = micro_fb(params, ms, lb, rng)
+            else:
+                # Micro-batch i takes every accum-th LOCAL row (interleaved
+                # split, same as the implicit path) so each micro stays
+                # evenly spread over the data axis.
+                def split(x):
+                    return jnp.moveaxis(
+                        x.reshape(
+                            x.shape[0] // accum, accum, *x.shape[1:]
+                        ), 1, 0,
+                    )
+
+                micro = jax.tree_util.tree_map(split, lb)
+                loss_sd, (metrics_sd, _) = out_sd
+                zeros = lambda sd: jax.tree_util.tree_map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), sd
+                )
+
+                def mb_step(carry, idx_mb):
+                    g_acc, l_acc, m_acc, ms_c = carry
+                    i, mb = idx_mb
+                    l, m, g, ms_c = micro_fb(
+                        params, ms_c, mb, jax.random.fold_in(rng, i)
+                    )
+                    g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                    m_acc = jax.tree_util.tree_map(jnp.add, m_acc, m)
+                    return (g_acc, l_acc + l, m_acc, ms_c), None
+
+                # Grad accumulator: zeros shaped like the LOCAL param view —
+                # full params for psum/ordered, the shard for fsdp, so the
+                # donated carry never exceeds the mode's resident budget.
+                (g_sum, l_sum, m_sum, new_ms), _ = jax.lax.scan(
+                    mb_step,
+                    (
+                        jax.tree_util.tree_map(jnp.zeros_like, params),
+                        zeros(loss_sd), zeros(metrics_sd), ms,
+                    ),
+                    (jnp.arange(accum), micro),
+                )
+                inv_a = 1.0 / accum
+                grads = jax.tree_util.tree_map(lambda v: v * inv_a, g_sum)
+                loss = l_sum * inv_a
+                metrics = jax.tree_util.tree_map(
+                    lambda v: v * inv_a, m_sum
+                )
+
+            # The per-outer-step exchange.  "ordered" already exchanged
+            # inside each micro step (the block order IS the contract) and
+            # returned replicated means; "fsdp" grads left the AD transpose
+            # as reduce-scattered sums — only scaling remains.
+            inv = 1.0 / data_axis
+            if mode == "psum_bucketed":
+                leaves, treedef = jax.tree_util.tree_flatten(grads)
+                k = max(1, min(buckets, len(leaves)))
+                reduced: list = [None] * len(leaves)
+                for i in range(k):
+                    chunk = tuple(leaves[i::k])
+                    out = jax.lax.psum(chunk, "data")
+                    for j, v in enumerate(out):
+                        reduced[i + j * k] = v
+                grads = jax.tree_util.tree_unflatten(
+                    treedef, [v * inv for v in reduced]
+                )
+                loss = jax.lax.psum(loss, "data") * inv
+                metrics = jax.tree_util.tree_map(
+                    lambda v: jax.lax.psum(v, "data") * inv, metrics
+                )
+            elif mode == "fsdp":
+                grads = jax.tree_util.tree_map(lambda v: v * inv, grads)
+                loss = jax.lax.psum(loss, "data") * inv
+                metrics = jax.tree_util.tree_map(
+                    lambda v: jax.lax.psum(v, "data") * inv, metrics
+                )
+            if has_model_state and mode != "ordered":
+                # Sync-BN convention: float collections average over the
+                # data axis (replicated out); integer leaves (counters)
+                # advance identically on every device and pass through.
+                new_ms = jax.tree_util.tree_map(
+                    lambda v: (
+                        jax.lax.psum(v, "data") * inv
+                        if jnp.issubdtype(v.dtype, jnp.inexact) else v
+                    ),
+                    new_ms,
+                )
+            return loss, metrics, grads, new_ms
+
+        pspec = fsdp_specs if mode == "fsdp" else P()
         return shard_map(
             local,
             mesh=mesh,
-            in_specs=(P(), P("data"), P()),
-            out_specs=(P(), P(), P()),
+            in_specs=(pspec, P(), P("data"), P()),
+            out_specs=(P(), P(), pspec, P()),
             check_vma=False,
-        )(params, batch, rng)
+        )(params, mstate, batch, rng)
 
     return fb
 
@@ -406,7 +587,94 @@ def train_loop(
         params, model_state = init_params_fn(init_rng, first_batch)
     else:
         params = init_params_fn(init_rng, first_batch)
-    p_shard = _param_sharding(mesh, config, params)
+    bp = config.batch_partition or {}
+    accum = max(1, int(config.grad_accum_steps))
+    if accum > 1 and config.batch_size % accum:
+        raise ValueError(
+            f"batch_size {config.batch_size} not divisible by "
+            f"grad_accum_steps {accum}"
+        )
+
+    # Explicit DP collective modes (multi-chip window): replace the
+    # implicit GSPMD gradient exchange with a shard_map-expressed one.
+    # Capability table — each refusal below routes to the mode that
+    # supports the ask instead of just blocking:
+    #   psum_bucketed / ordered  params replicated (pure DP exchange);
+    #   fsdp                     params sharded over 'data' (per-leaf JIT
+    #                            all-gather + reduce-scatter grads);
+    #   None/'auto' (implicit)   arbitrary param_partition axes and
+    #                            batch_partition (ring-attention sequence
+    #                            sharding) live here.
+    # grad_accum_steps and model_state compose with EVERY mode.
+    dp_mode = _effective_dp_collective(config)
+    data_axis = mesh.shape["data"]
+    fsdp_partition = None
+    if dp_mode:
+        if bp:
+            raise ValueError(
+                f"dp_collective={dp_mode!r}: batch_partition (sequence-"
+                "sharded inputs for ring attention) rides the implicit-"
+                "GSPMD window — use dp_collective=None/'auto' for "
+                "long-context configs; explicit collective modes shard "
+                "the batch over 'data' only"
+            )
+        if dp_mode == "fsdp":
+            fsdp_partition = (
+                config.param_partition
+                if config.param_partition is not None
+                else fsdp_param_partition(params, mesh)
+            )
+            foreign = foreign_axis_paths(params, fsdp_partition)
+            if foreign:
+                raise ValueError(
+                    "dp_collective='fsdp' shards params over the mesh "
+                    "'data' axis only; these param_partition specs name "
+                    "other axes — model-parallel specs ride the implicit "
+                    "mode (dp_collective=None/'auto'):\n  "
+                    + "\n  ".join(foreign)
+                )
+        elif config.param_partition is not None:
+            raise ValueError(
+                f"dp_collective={dp_mode!r} keeps params replicated "
+                "(pure data parallelism); param_partition requires "
+                "dp_collective='fsdp' (params sharded over 'data', "
+                "per-layer all-gather inside the scan body) or the "
+                "implicit mode (None/'auto') for model-parallel specs"
+            )
+        if config.batch_size % data_axis:
+            raise ValueError(
+                f"dp_collective={dp_mode!r}: batch_size "
+                f"{config.batch_size} must be divisible by the mesh "
+                f"data axis ({data_axis})"
+            )
+        if accum > 1 and (config.batch_size // data_axis) % accum:
+            raise ValueError(
+                f"grad_accum_steps {accum} must divide the per-device "
+                f"batch ({config.batch_size} over data axis {data_axis} "
+                f"= {config.batch_size // data_axis} rows)"
+            )
+
+    # Surface bad partitions BEFORE compilation (satellite of ISSUE 18):
+    # a spec whose mesh-axis size doesn't divide the param dim otherwise
+    # only fails deep inside jit with a GSPMD error naming no parameter.
+    partition_in_play = (
+        fsdp_partition if dp_mode == "fsdp" else config.param_partition
+    )
+    if partition_in_play is not None:
+        problems = validate_partition(params, partition_in_play, mesh)
+        if problems:
+            raise ValueError(
+                "param_partition does not fit this mesh — fix these "
+                "rules before compilation:\n  " + "\n  ".join(problems)
+            )
+
+    if fsdp_partition is not None:
+        p_shard = jax.tree_util.tree_map(
+            lambda spec: NamedSharding(mesh, spec), fsdp_partition,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+    else:
+        p_shard = _param_sharding(mesh, config, params)
     params = jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, s), params, p_shard
     )
@@ -426,7 +694,6 @@ def train_loop(
     state = jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, s), state, state_shard
     )
-    bp = config.batch_partition or {}
     unknown = sorted(set(bp) - set(first_batch))
     if unknown:
         raise ValueError(
@@ -441,43 +708,29 @@ def train_loop(
         for k, v in first_batch.items()
     }
 
-    accum = max(1, int(config.grad_accum_steps))
-    if accum > 1 and config.batch_size % accum:
-        raise ValueError(
-            f"batch_size {config.batch_size} not divisible by "
-            f"grad_accum_steps {accum}"
-        )
-
-    # Explicit DP collective mode (multi-chip window): replace the implicit
-    # GSPMD gradient exchange with a shard_map-expressed one — bucketed
-    # psum (overlap-friendly) or fixed-block ordered reduction (bitwise
-    # mesh-size-invariant).  Runs even on a data=1 mesh so a single-chip
-    # "ordered" run shares the multi-chip run's exact reduction structure.
-    dp_mode = _effective_dp_collective(config)
+    # Runs even on a data=1 mesh so a single-chip "ordered" run shares the
+    # multi-chip run's exact reduction structure.
     dp_fb = None
     if dp_mode:
-        data_axis = mesh.shape["data"]
-        if config.param_partition is not None or bp:
-            raise ValueError(
-                f"dp_collective={dp_mode!r} is pure data parallelism: "
-                "param_partition/batch_partition are not supported"
-            )
-        if accum > 1 or has_model_state:
-            raise ValueError(
-                f"dp_collective={dp_mode!r} does not compose with "
-                "grad_accum_steps>1 or has_model_state"
-            )
         grad_blocks = int(config.dp_grad_blocks or data_axis)
-        if grad_blocks % data_axis or config.batch_size % grad_blocks:
+        if dp_mode == "ordered" and (
+            grad_blocks % data_axis
+            or (config.batch_size // accum) % grad_blocks
+        ):
             raise ValueError(
                 f"dp_grad_blocks {grad_blocks} must be a multiple of the "
-                f"mesh data axis ({data_axis}) and divide batch_size "
-                f"({config.batch_size})"
+                f"mesh data axis ({data_axis}) and divide the "
+                f"per-microbatch global batch "
+                f"({config.batch_size} / grad_accum_steps {accum} = "
+                f"{config.batch_size // accum})"
             )
         dp_fb = _make_dp_forward_backward(
             loss_fn, mesh, dp_mode,
             buckets=max(1, int(config.collective_buckets)),
             grad_blocks=grad_blocks,
+            accum=accum,
+            has_model_state=has_model_state,
+            fsdp_specs=fsdp_partition,
         )
 
     def forward_backward(params, mstate, mb, rng):
@@ -495,8 +748,12 @@ def train_loop(
     def step_fn(state: TrainState, batch) -> Tuple[TrainState, Dict[str, jax.Array]]:
         step_rng = jax.random.fold_in(state.rng, state.step)
         if dp_fb is not None:
-            loss, metrics, grads = dp_fb(state.params, batch, step_rng)
-            new_mstate = state.model_state
+            # Accumulation and model_state live INSIDE the collective fb
+            # (the inner scan accumulates under the same shard_map as the
+            # exchange), so every dp mode composes with both.
+            loss, metrics, grads, new_mstate = dp_fb(
+                state.params, state.model_state, batch, step_rng
+            )
         elif accum == 1:
             loss, metrics, grads, new_mstate = forward_backward(
                 state.params, state.model_state, batch, step_rng
@@ -662,10 +919,10 @@ def train_loop(
     tracker.training_prep_end()
 
     # ---- the loop
+    from tpu_pipelines.data.input_pipeline import stage_global
+
     def put_batch(b):
-        return jax.tree_util.tree_map(
-            lambda x, s: jax.device_put(np.asarray(x), s), b, batch_shard
-        )
+        return stage_global(b, batch_shard)
 
     tb_writer = None
     if config.tensorboard_dir and jax.process_index() == 0:
@@ -789,10 +1046,7 @@ def train_loop(
         )
 
         def stage_window(stacked):
-            return {
-                k: jax.device_put(v, win_shard[k])
-                for k, v in stacked.items()
-            }
+            return stage_global(stacked, win_shard)
 
         def window_lengths(start: int):
             # Windows shrink to land exactly on eval/checkpoint/train_steps
