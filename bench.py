@@ -558,6 +558,13 @@ def bench_taxi_window(smoke: bool) -> dict:
         )
     base = sweep[str(windows[0])]
     best = max(windows, key=lambda w: sweep[str(w)] or 0.0)
+    # The telemetry-plane acceptance drill rides the same model/batches
+    # at the log_every window: 3 windows (first absorbs compile, the
+    # rest are attributed + steady-state).
+    telemetry = _train_window_telemetry_drill(
+        loss_fn, lambda r, b: model.init(r, b)["params"], batches,
+        batch, steps=3 * log_window, window_steps=log_window,
+    )
     return {
         "examples_per_sec_per_chip": sweep[str(best)],
         "window_sweep": sweep,
@@ -567,7 +574,161 @@ def bench_taxi_window(smoke: bool) -> dict:
         "window_speedup": round(sweep[str(best)] / base, 4) if base else None,
         "batch_size": batch,
         "steps_per_run": steps,
+        "train_telemetry": telemetry,
         "method": "train_loop_pipeline_path_window_sweep",
+    }
+
+
+def _train_window_telemetry_drill(
+    loss_fn, init_params_fn, batches_fn, batch: int, steps: int,
+    window_steps: int, mesh=None, dp_kwargs=None,
+) -> dict:
+    """ISSUE 19 acceptance drill: ONE windowed run with the whole
+    training-telemetry plane on — federation spool + durable snapshot
+    ring + a live federated ``/metrics`` endpoint — judged from the
+    scrape, the RunTrace, and the ring, not from in-process state.
+
+    Green contract: the scraped four-phase attribution sums to the
+    trace-recorded window wall-clock within 5% (two independent sinks —
+    the registry counters vs the ``window_breakdown`` instants),
+    compiles-after-warm == 0 at steady state (every window compiles the
+    same scan), the scrape is the MERGED federated endpoint, and the run
+    leaves a replayable snapshot ring whose headline feeds
+    ``trace diff`` without tripping its own regression flags.
+    """
+    import shutil
+    import tempfile
+    import urllib.request
+
+    import optax
+
+    from tpu_pipelines.observability import (
+        TraceRecorder,
+        activate,
+        read_events,
+    )
+    from tpu_pipelines.observability import federation as fed
+    from tpu_pipelines.observability.export import diff_metrics
+    from tpu_pipelines.observability.metrics import (
+        default_registry,
+        start_http_server,
+    )
+    from tpu_pipelines.observability.metrics_history import MetricsHistory
+    from tpu_pipelines.trainer import TrainLoopConfig, train_loop
+
+    root = tempfile.mkdtemp(prefix="tpp-telemetry-")
+    run_id = "telemetry-drill"
+    saved = {
+        k: os.environ.get(k)
+        for k in (fed.ENV_FEDERATION_DIR, "TPP_METRICS_HISTORY")
+    }
+    os.environ[fed.ENV_FEDERATION_DIR] = os.path.join(root, "spool")
+    os.environ["TPP_METRICS_HISTORY"] = "1"
+
+    phases = ("infeed_wait", "device_compute", "device_collective", "host")
+    reg = default_registry()
+    c_phase = reg.counter("train_window_time_seconds", labels=("phase",))
+    base = {ph: c_phase.labels(ph).get() for ph in phases}
+    base_compiles = reg.counter("train_compiles_after_warm_total").get()
+
+    server = start_http_server(fed.FederatedRegistry(reg), port=0)
+    rec = TraceRecorder(os.path.join(root, ".runs", run_id), run_id)
+    try:
+        t0 = time.perf_counter()
+        with activate(rec):
+            _, result = train_loop(
+                loss_fn=loss_fn,
+                init_params_fn=init_params_fn,
+                optimizer=optax.adam(1e-3),
+                train_iter=batches_fn(),
+                config=TrainLoopConfig(
+                    train_steps=steps, batch_size=batch, log_every=0,
+                    window_steps=window_steps,
+                    pipeline_root=root, run_id=run_id,
+                    **(dp_kwargs or {}),
+                ),
+                **({"mesh": mesh} if mesh is not None else {}),
+            )
+        wall_s = time.perf_counter() - t0
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=30
+        ) as r:
+            scrape = r.read().decode()
+    finally:
+        rec.close()
+        server.close()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    # Phase attribution, from the federated scrape (delta vs the
+    # process-cumulative counters the earlier sweep already advanced).
+    scraped = {
+        ph: _parse_prom_counter(
+            scrape, "train_window_time_seconds", f'phase="{ph}"'
+        ) - base[ph]
+        for ph in phases
+    }
+    attributed = sum(scraped.values())
+    compiles = int(
+        _parse_prom_counter(scrape, "train_compiles_after_warm_total")
+        - base_compiles
+    )
+    federated = "federation_sources" in scrape
+
+    # Independent wall-clock sink: the RunTrace's per-window instants.
+    events = read_events(rec.events_path)
+    windows_total_s = sum(
+        e["args"]["window_s"] for e in events
+        if e["name"] == "window_breakdown"
+    )
+
+    # Durable ring: replayable headline the trace-diff path consumes.
+    hist = MetricsHistory.for_pipeline_root(root)
+    snapshots = len(hist.entries(run_id))
+    head = hist.headline(run_id)
+    self_flags = diff_metrics(
+        {"train_telemetry": head}, {"train_telemetry": head}
+    )["regression_flags"]
+
+    phase_sum_ok = (
+        attributed > 0
+        and windows_total_s > 0
+        and abs(attributed - windows_total_s) <= 0.05 * windows_total_s
+        and attributed <= wall_s
+    )
+    green = (
+        phase_sum_ok
+        and compiles == 0
+        and federated
+        and snapshots >= 2
+        and "window_phase_seconds" in head
+        and self_flags == []
+    )
+    shutil.rmtree(root, ignore_errors=True)
+    return {
+        "green": green,
+        "phase_seconds": {ph: round(v, 4) for ph, v in scraped.items()},
+        "attributed_s": round(attributed, 4),
+        "trace_windows_s": round(windows_total_s, 4),
+        "wall_s": round(wall_s, 4),
+        "phase_sum_within_5pct": phase_sum_ok,
+        "infeed_wait_pct": (
+            round(100.0 * scraped["infeed_wait"] / attributed, 2)
+            if attributed else None
+        ),
+        "compiles_after_warm": compiles,
+        "mfu": result.mfu,
+        "federated_scrape": federated,
+        "federation_sources": int(
+            _parse_prom_gauge_value(scrape, "federation_sources") or 0
+        ),
+        "history_snapshots": snapshots,
+        "history_headline_keys": sorted(head),
+        "window_steps": window_steps,
+        "steps": steps,
     }
 
 
@@ -698,6 +859,17 @@ def _taxi_window_mesh_measure(smoke: bool) -> dict:
     # at equal work, not small-batch single-chip luck.
     single = run(devices[:1], best)
     host_cpus = os.cpu_count() or 1
+    # ISSUE 19 acceptance: the MULTI-CHIP windowed run (simulated mesh
+    # OK) serving one federated scrape with sum-exact phase attribution,
+    # zero steady-state compiles, and a replayable snapshot ring.
+    telemetry = _train_window_telemetry_drill(
+        loss_fn, lambda r, b: model.init(r, b)["params"], batches,
+        batch, steps=3 * log_window, window_steps=log_window,
+        mesh=make_mesh(MeshConfig(), devices=devices),
+        dp_kwargs={
+            "dp_collective": "psum_bucketed", "collective_buckets": 2,
+        },
+    )
     return {
         "examples_per_sec_per_chip": sweep[str(best)],
         "window_sweep": sweep,
@@ -715,6 +887,7 @@ def _taxi_window_mesh_measure(smoke: bool) -> dict:
         "collective_buckets": 2,
         "batch_size": batch,
         "steps_per_run": steps,
+        "train_telemetry": telemetry,
         "host_cpus": host_cpus,
         # The 1-core-parity caveat, recorded not implied: n virtual
         # devices on fewer host cores time-slice the same silicon, so
@@ -4610,8 +4783,9 @@ def _compact(report: dict) -> dict:
     Rounds 1-4 all ended with ``parsed: null`` in the driver artifact: the
     full cumulative report grew past 3.7 KB, the tail buffer kept only the
     last 2,000 bytes, and the captured line started mid-JSON.  The fix is a
-    contract split: stdout carries ONLY this compact line (<= ~600 bytes);
-    the full report lives in BENCH_PARTIAL.json and the committed
+    contract split: stdout carries ONLY this compact line (~1.5 KB with
+    every leg's headline keys, budget-checked in test_bench_smoke); the
+    full report lives in BENCH_PARTIAL.json and the committed
     BENCH_R{N}_LOCAL.json artifact.
     """
     e2e = report.get("pipeline_e2e") or {}
@@ -4754,6 +4928,15 @@ def _compact(report: dict) -> dict:
     if isinstance(twm, dict) and "mesh_window_speedup" in twm:
         compact["mesh_window_speedup"] = twm["mesh_window_speedup"]
         compact["scaling_efficiency"] = twm.get("scaling_efficiency")
+    # Training-telemetry headline (ISSUE 19): where the window went
+    # (infeed-wait share of the attributed window wall-clock) and the
+    # steady-state recompile count, which must read 0.
+    tt = (tw if isinstance(tw, dict) else {}).get("train_telemetry")
+    if not isinstance(tt, dict):
+        tt = (twm if isinstance(twm, dict) else {}).get("train_telemetry")
+    if isinstance(tt, dict):
+        compact["train_infeed_wait_pct"] = tt.get("infeed_wait_pct")
+        compact["train_compiles_after_warm"] = tt.get("compiles_after_warm")
     bpar = report.get("bert_parallelism")
     if isinstance(bpar, dict) and "fsdp_mfu_vs_dp" in bpar:
         compact["fsdp_mfu_vs_dp"] = bpar["fsdp_mfu_vs_dp"]
@@ -4922,7 +5105,7 @@ def main() -> None:
 
     # Host-loop-tax evidence (ISSUE 8): windowed train_loop sweep, right
     # after its ceiling so the gap ratio can land in the same flush.
-    leg("taxi_window", bench_taxi_window, est_cost_s=90, retries=1,
+    leg("taxi_window", bench_taxi_window, est_cost_s=110, retries=1,
         post=taxi_window_post)
 
     def taxi_window_mesh_post(result: dict) -> dict:
@@ -4942,7 +5125,7 @@ def main() -> None:
     # Multi-chip window evidence (ISSUE 15): the same window sweep on the
     # full mesh with the bucketed in-scan collective, vs one device (in a
     # child on the 8-virtual-device topology when this box exposes one).
-    leg("taxi_window_mesh", bench_taxi_window_mesh, est_cost_s=150,
+    leg("taxi_window_mesh", bench_taxi_window_mesh, est_cost_s=180,
         retries=1, post=taxi_window_mesh_post)
     # +80 s vs r5: the windowed BERT datapoint is one extra compile + run.
     leg("bert", bench_bert, est_cost_s=200)

@@ -1231,6 +1231,95 @@ def test_tpp211_repo_serving_metrics_are_documented():
     assert check_serving_metric_docs() == []
 
 
+def test_tpp214_undocumented_metric_names(tmp_path):
+    """TPP214: a *_total/*_seconds/*_bytes string constant anywhere in
+    the package with no row in EITHER doc fires WARN with file:line
+    attribution; documented names (in either doc), non-metric strings,
+    and `# tpp: disable=TPP214` lines all stay silent."""
+    from tpu_pipelines.analysis import check_metric_docs
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "trainer.py").write_text(textwrap.dedent('''
+        IN_OBS_DOC = "train_window_time_seconds"
+        IN_SERVING_DOC = "serving_decode_steps_total"
+        UNDOCUMENTED = "train_mystery_total"
+        SUPPRESSED = "train_hidden_bytes"  # tpp: disable=TPP214
+        NOT_A_METRIC = "total"
+        ALSO_NOT = "finished in 3 seconds"
+    '''))
+    sub = pkg / "data"
+    sub.mkdir()
+    (sub / "plane.py").write_text(
+        'ALSO_MISSING = "shards_orphaned_seconds"\n'
+    )
+    obs_doc = tmp_path / "OBSERVABILITY.md"
+    obs_doc.write_text("| `train_window_time_seconds` | counter |\n")
+    serving_doc = tmp_path / "SERVING.md"
+    serving_doc.write_text("| `serving_decode_steps_total` | counter |\n")
+    docs = [str(obs_doc), str(serving_doc)]
+
+    findings = check_metric_docs(package_dir=str(pkg), doc_paths=docs)
+    assert sorted(
+        (os.path.basename(f.file), f.rule, f.severity) for f in findings
+    ) == [
+        ("plane.py", "TPP214", "warn"),
+        ("trainer.py", "TPP214", "warn"),
+    ]
+    by_file = {os.path.basename(f.file): f for f in findings}
+    assert "train_mystery_total" in by_file["trainer.py"].message
+    assert by_file["trainer.py"].line > 0
+    assert "OBSERVABILITY.md" in by_file["trainer.py"].fix
+    assert "shards_orphaned_seconds" in by_file["plane.py"].message
+
+    # Documenting the stragglers (in either doc) clears the check.
+    obs_doc.write_text(
+        "train_window_time_seconds train_mystery_total\n"
+    )
+    serving_doc.write_text(
+        "serving_decode_steps_total shards_orphaned_seconds\n"
+    )
+    assert check_metric_docs(package_dir=str(pkg), doc_paths=docs) == []
+
+    # Both catalogs missing = nothing documented: every emission flags.
+    obs_doc.unlink()
+    serving_doc.unlink()
+    assert len(
+        check_metric_docs(package_dir=str(pkg), doc_paths=docs)
+    ) == 4
+
+
+def test_tpp214_dedupes_within_file_and_gates_like_any_warn(tmp_path):
+    """One finding per metric name per file, riding the standard gate."""
+    from tpu_pipelines.analysis import check_metric_docs
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "metrics.py").write_text(textwrap.dedent('''
+        A = "repeat_latency_seconds"
+        B = "repeat_latency_seconds"
+        def emit(reg):
+            return reg.histogram("repeat_latency_seconds")
+    '''))
+    doc = tmp_path / "OBSERVABILITY.md"
+    doc.write_text("nothing documented here\n")
+    findings = check_metric_docs(
+        package_dir=str(pkg), doc_paths=[str(doc)]
+    )
+    assert len(findings) == 1
+    assert gated(findings, "warn") == findings
+    assert gated(findings, "error") == []
+
+
+def test_tpp214_repo_metrics_are_documented():
+    """Dogfood: every metric-shaped name the whole package emits is in
+    one of the two catalogs (or carries a reviewed per-line
+    suppression) — the exact check the lint CLI rides along."""
+    from tpu_pipelines.analysis import check_metric_docs
+
+    assert check_metric_docs() == []
+
+
 # ------------------------------------------------------------------- gates
 
 
@@ -1360,6 +1449,39 @@ def test_cli_lint_clean_on_taxi_example(tmp_path, monkeypatch, capsys):
     ])
     assert rc == 0
     assert "clean" in capsys.readouterr().out
+
+
+def test_cli_lint_gates_on_tpp214(tmp_path, monkeypatch, capsys):
+    """A TPP214 finding rides the lint gate exactly like a graph WARN:
+    exit 3 at --fail-on warn, reported with its file:line.  (The real
+    repo lints TPP214-clean — the dogfood test above — so the finding
+    is injected at the analysis seam the CLI imports.)"""
+    import tpu_pipelines.analysis as analysis_pkg
+    from tpu_pipelines.__main__ import main
+    from tpu_pipelines.analysis import Finding
+
+    monkeypatch.setenv("TPP_PIPELINE_HOME", str(tmp_path / "home"))
+    monkeypatch.setattr(
+        analysis_pkg, "check_metric_docs",
+        lambda: [Finding(
+            rule="TPP214", severity="warn", node_id="<repo>",
+            message="metric-shaped name 'ghost_total' is undocumented",
+            file="tpu_pipelines/ghost.py", line=7,
+            fix="add 'ghost_total' to the catalog",
+        )],
+    )
+    rc = main([
+        "lint", "--pipeline-module",
+        os.path.join(EXAMPLES, "taxi", "pipeline.py"),
+        "--fail-on", "warn", "--json",
+    ])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 3
+    assert out["gated"] == 1
+    assert "TPP214" in out["rules"]
+    by_rule = {f["rule"]: f for f in out["findings"]}
+    assert by_rule["TPP214"]["file"] == "tpu_pipelines/ghost.py"
+    assert by_rule["TPP214"]["line"] == 7
 
 
 def test_cli_run_lint_flag(tmp_path, capsys):

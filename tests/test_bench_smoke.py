@@ -25,9 +25,11 @@ pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# The driver tail keeps 2,000 bytes; leave headroom so the LAST line is
-# intact even with one earlier line captured alongside it.
-MAX_STDOUT_LINE_BYTES = 1500
+# The driver tail keeps 2,000 bytes and JSON-parses the LAST line, which
+# is intact as long as it fits the tail whole; cap below that with real
+# headroom.  (1500 until ISSUE 19 — the telemetry headline keys pushed
+# the full-report line to ~1540 B, still 400+ B clear of the tail.)
+MAX_STDOUT_LINE_BYTES = 1600
 
 
 def _run_bench(extra_env, timeout):
@@ -413,6 +415,36 @@ def test_bench_smoke_emits_compact_stdout_and_full_report():
     assert isinstance(twm["virtual_devices_share_cores"], bool)
     assert compact["mesh_window_speedup"] == twm["mesh_window_speedup"]
     assert compact["scaling_efficiency"] == twm["scaling_efficiency"]
+    # Training-telemetry acceptance drill (ISSUE 19), on BOTH windowed
+    # legs: the scraped four-phase attribution sums to the trace-recorded
+    # window wall-clock within 5%, compiles-after-warm reads 0 at steady
+    # state, the scrape is the MERGED federated endpoint, and the run
+    # left a replayable (>= 2 snapshot) metrics-history ring whose
+    # headline feeds trace diff.  The mesh leg's drill is the multi-chip
+    # acceptance run: same contract with the bucketed in-scan collective.
+    for leg in (tw, twm):
+        tt = leg["train_telemetry"]
+        assert tt["green"] is True, tt
+        assert tt["phase_sum_within_5pct"] is True, tt
+        assert tt["compiles_after_warm"] == 0
+        assert tt["attributed_s"] > 0
+        assert tt["attributed_s"] <= tt["wall_s"]
+        assert set(tt["phase_seconds"]) == {
+            "infeed_wait", "device_compute", "device_collective", "host",
+        }
+        assert tt["federated_scrape"] is True
+        assert tt["federation_sources"] >= 1
+        assert tt["history_snapshots"] >= 2
+        assert "window_phase_seconds" in tt["history_headline_keys"]
+        assert "infeed_wait_share" in tt["history_headline_keys"]
+    # The mesh drill ran THROUGH the collective: device_collective time
+    # was actually attributed, not a structural zero.
+    assert twm["train_telemetry"]["phase_seconds"]["device_collective"] > 0
+    # And the compact line carries the telemetry headline keys.
+    assert compact["train_infeed_wait_pct"] == tw["train_telemetry"][
+        "infeed_wait_pct"
+    ]
+    assert compact["train_compiles_after_warm"] == 0
     # The BERT leg carries its windowed datapoint at the bench log window.
     bw = report["bert"]["window_sweep"]
     assert set(bw) == {"1", str(report["bert"]["window_steps_log_every"])}

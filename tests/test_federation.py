@@ -1,0 +1,444 @@
+"""Federation identity + durable metrics history (ISSUE 19 satellite 3).
+
+The federation contract is an IDENTITY: the one merged scrape must equal
+the sum of what every source observed — no loss (a fork child's counts
+reach the endpoint) and no double count (a child's inherited parent
+counts, or the server's own spooled snapshot, are never added twice).
+These tests drive the identity through the REAL seams: a process-pool
+``map_shards`` fan-out and a 2-replica ModelServer fleet under a REST
+hammer.  The flip side is the zero-footprint invariant: with no env
+knobs, ``/metrics`` is byte-identical to the plain registry exposition
+and nothing is written under ``.runs/_metrics/`` or any spool.
+"""
+
+import json
+import os
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpu_pipelines.data.shard_plan import map_shards
+from tpu_pipelines.observability import federation as fed
+from tpu_pipelines.observability.metrics import (
+    MetricsRegistry,
+    default_registry,
+)
+from tpu_pipelines.observability.metrics_history import (
+    MetricsHistory,
+    metrics_history_root,
+)
+
+pytestmark = [pytest.mark.observability, pytest.mark.profiling]
+
+
+def _series_total(snapshot, name):
+    """Sum of every series of ``name`` in a registry snapshot."""
+    payload = snapshot[name]
+    return sum(float(v) for v in payload["series"].values())
+
+
+def _prom_series(text, name):
+    """[(labels_dict, value)] rows of one metric in a text exposition."""
+    out = []
+    for m in re.finditer(
+        rf"^{re.escape(name)}(?:\{{([^}}]*)\}})? (\S+)$", text, re.M
+    ):
+        labels = dict(
+            re.findall(r'(\w+)="([^"]*)"', m.group(1) or "")
+        )
+        out.append((labels, float(m.group(2))))
+    return out
+
+
+# ------------------------------------------------------ codec + merge law
+
+
+def test_snapshot_codec_roundtrips_through_json():
+    reg = MetricsRegistry()
+    reg.counter("fedtest_units_total", "d", labels=("kind",)).labels(
+        "a"
+    ).inc(2)
+    reg.gauge("fedtest_level", "d").set(5.5)
+    reg.histogram("fedtest_lat_seconds", "d").observe(0.01)
+    snap = reg.snapshot()
+    wire = json.loads(json.dumps(fed.encode_snapshot(snap)))
+    assert fed.decode_snapshot(wire) == snap
+
+
+def test_delta_snapshot_subtracts_inherited_counts():
+    reg = MetricsRegistry()
+    c = reg.counter("fedtest_units_total", "d")
+    g = reg.gauge("fedtest_level", "d")
+    h = reg.histogram("fedtest_lat_seconds", "d")
+    c.inc(5)
+    g.set(1.0)
+    h.observe(0.2)
+    baseline = reg.snapshot()  # "fork-time" inherited state
+
+    c.inc(3)  # the only post-fork work
+    delta = fed.delta_snapshot(reg.snapshot(), baseline)
+    assert _series_total(delta, "fedtest_units_total") == 3.0
+    # Unchanged gauge and histogram publish nothing.
+    assert "fedtest_level" not in delta
+    assert "fedtest_lat_seconds" not in delta
+
+    g.set(2.0)
+    delta = fed.delta_snapshot(reg.snapshot(), baseline)
+    assert delta["fedtest_level"]["series"][()] == 2.0
+
+
+def test_merged_scrape_is_sum_and_skips_own_spool_file(tmp_path):
+    """Merge law (counters ADD) + the writer-stamp self-skip: a process
+    that both publishes its registry and serves the merged endpoint
+    must not count itself twice."""
+    spool = str(tmp_path / "spool")
+    local = MetricsRegistry()
+    local.counter("fedtest_units_total", "d").inc(5)
+    other = MetricsRegistry()
+    other.counter("fedtest_units_total", "d").inc(3)
+
+    # The local registry's OWN spool file (what a trainer publishing for
+    # remote scrapes leaves behind) plus a genuine peer.
+    fed.publish_registry(local, spool_dir=spool, source="me")
+    fed.publish_registry(
+        other, spool_dir=spool, source="peer", labels={"host": "host-b"}
+    )
+
+    agg = fed.FederatedRegistry(local, spool_dir=spool)
+    snap = agg.snapshot()
+    assert _series_total(snap, "fedtest_units_total") == 8.0  # not 13
+    # Per-source attribution survives in the extended labels.
+    rows = _prom_series(agg.to_prometheus(), "fedtest_units_total")
+    assert {r[0]["host"] for r in rows} >= {"host-b"}
+    assert snap["federation_sources"]["series"][()] == 2.0
+
+    # A departed source ages out when a freshness limit is set.
+    peer_path = os.path.join(spool, "peer.json")
+    with open(peer_path) as f:
+        payload = json.load(f)
+    payload["unix_time"] -= 3600.0
+    with open(peer_path, "w") as f:
+        json.dump(payload, f)
+    aged = fed.FederatedRegistry(local, spool_dir=spool, max_age_s=60.0)
+    assert _series_total(aged.snapshot(), "fedtest_units_total") == 5.0
+
+
+# ---------------------------------------------- fork-pool scrape identity
+
+
+def _fed_pool_work(k):
+    """Module-level (picklable) shard fn: k units of counted work."""
+    default_registry().counter(
+        "fedtest_pool_units_total",
+        "work units done by federation identity test shards",
+    ).inc(k)
+    return k
+
+
+def test_fork_pool_children_federate_into_one_scrape(tmp_path, monkeypatch):
+    """Identity through the REAL process pool: the merged scrape's work
+    total equals the work dispatched, even though every unit was counted
+    in a forked child's registry the parent never sees.  The delta-vs-
+    fork-baseline publish is what keeps inherited parent counts from
+    doubling."""
+    spool = str(tmp_path / "spool")
+    monkeypatch.setenv("TPP_FEDERATION_DIR", spool)
+    monkeypatch.setenv("TPP_DATA_POOL", "process")
+    monkeypatch.setenv("TPP_DATA_POOL_WORKERS", "2")
+
+    reg = default_registry()
+    counter = reg.counter(
+        "fedtest_pool_units_total",
+        "work units done by federation identity test shards",
+    )
+    base = counter.get()  # parent-side residue from earlier tests
+
+    tasks = [1, 2, 3, 4, 5, 6]
+    assert map_shards(_fed_pool_work, tasks) == tasks
+
+    merged = fed.FederatedRegistry(reg).snapshot()
+    assert _series_total(merged, "fedtest_pool_units_total") == (
+        pytest.approx(base + sum(tasks))
+    )
+    # The children really did publish delta files into the spool.
+    workers = [
+        f for f in os.listdir(spool) if f.startswith("worker-")
+    ]
+    assert workers, "no fork-worker snapshot reached the spool"
+
+
+# ------------------------------------------- 2-replica fleet, one scrape
+
+
+class _FakeLoaded:
+    def __init__(self, scale):
+        self.scale = scale
+        self.generate = None
+        self.transform = None
+
+    def predict(self, batch):
+        return np.asarray(batch["x"], np.float64) * self.scale
+
+    predict_transformed = predict
+
+
+def _fake_loader(version_dir):
+    with open(os.path.join(version_dir, "scale.txt")) as f:
+        return _FakeLoaded(float(f.read()))
+
+
+@pytest.fixture
+def fake_loader(monkeypatch):
+    monkeypatch.setattr(
+        "tpu_pipelines.serving.fleet.versions._default_loader",
+        _fake_loader,
+    )
+    monkeypatch.setattr(
+        "tpu_pipelines.serving.server.load_exported_model", _fake_loader
+    )
+    return _fake_loader
+
+
+def _fake_payload(base, version, scale):
+    vdir = base / str(version)
+    vdir.mkdir(parents=True)
+    (vdir / "scale.txt").write_text(str(scale))
+    return str(vdir)
+
+
+def _get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def test_two_replica_fleet_serves_one_federated_scrape(
+    tmp_path, fake_loader, monkeypatch
+):
+    """A 2-replica fleet under a multi-thread hammer, federation ON: its
+    ``/metrics`` is the fleet-wide endpoint — the server's own registry
+    (exactly once, despite also spooling itself on every scrape) merged
+    with a trainer's published snapshot, all federation-labeled."""
+    from tpu_pipelines.serving import ModelServer
+
+    spool = str(tmp_path / "spool")
+    monkeypatch.setenv("TPP_FEDERATION_DIR", spool)
+    monkeypatch.setenv("TPP_TENANT", "acme")
+
+    # A per-host trainer published its snapshot for this scrape to merge.
+    trainer = MetricsRegistry()
+    trainer.counter("train_steps_total", "d").inc(7)
+    fed.publish_registry(
+        trainer,
+        source="trainer-host-a",
+        labels={"host": "host-a", "replica": "", "tenant": "acme"},
+    )
+
+    base = tmp_path / "m"
+    _fake_payload(base, 1, 1.0)
+    server = ModelServer(
+        "toy", str(base), replicas=2, max_batch_size=8,
+        batch_timeout_s=0.002,
+    )
+    assert server._fleet is not None and server._federated is not None
+    port = server.start()
+    predict_url = f"http://127.0.0.1:{port}/v1/models/toy:predict"
+    body = json.dumps({"inputs": {"x": [[1.0, 2.0]]}}).encode()
+    N, threads_n = 24, 3
+    errors = []
+
+    def fire(n):
+        for _ in range(n):
+            try:
+                req = urllib.request.Request(predict_url, data=body)
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    if r.status != 200:
+                        errors.append(r.status)
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+    try:
+        threads = [
+            threading.Thread(target=fire, args=(N // threads_n,))
+            for _ in range(threads_n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == []
+        scrape1 = _get(f"http://127.0.0.1:{port}/metrics")
+        # Scrape again: scrape 1 published the server's registry into
+        # the spool — without the writer-stamp skip this scrape would
+        # now double-count every serving series.
+        scrape2 = _get(f"http://127.0.0.1:{port}/metrics")
+    finally:
+        server.stop()
+
+    for scrape in (scrape1, scrape2):
+        # Identity: every hammer request counted exactly once.
+        predict = [
+            v for labels, v in _prom_series(scrape, "serving_requests_total")
+            if labels.get("endpoint") == "predict"
+        ]
+        assert sum(predict) == N
+        # Both replicas took traffic and their declared ``replica``
+        # label survived federation.
+        per_replica = {
+            labels["replica"]: v
+            for labels, v in _prom_series(
+                scrape, "serving_replica_requests_total"
+            )
+        }
+        assert set(per_replica) == {"0", "1"}
+        assert sum(per_replica.values()) == N
+        # The trainer's series merged in, attributed to its host, and
+        # the tenant seam is stamped on the serving side's series.
+        steps = _prom_series(scrape, "train_steps_total")
+        assert [(lbl["host"], v) for lbl, v in steps] == [("host-a", 7.0)]
+        assert any(
+            lbl.get("tenant") == "acme"
+            for lbl, _ in _prom_series(scrape, "serving_requests_total")
+        )
+        # Merge bookkeeping: local + trainer (never the self-spool).
+        assert _prom_series(scrape, "federation_sources")[0][1] == 2.0
+        assert any(
+            lbl.get("source") == "trainer-host-a"
+            for lbl, _ in _prom_series(
+                scrape, "federation_source_age_seconds"
+            )
+        )
+
+
+# ------------------------------------------------ zero footprint when off
+
+
+def test_disabled_mode_byte_identical_scrape_and_zero_files(
+    tmp_path, fake_loader, monkeypatch
+):
+    """No env knobs ⇒ the publish/history hooks are no-ops, a real fork
+    fan-out leaves zero files, and a server's ``/metrics`` body is
+    byte-identical to the plain registry exposition."""
+    from tpu_pipelines.serving import ModelServer
+
+    for var in (
+        "TPP_FEDERATION_DIR", "TPP_FED_REPLICA", "TPP_TENANT",
+        "TPP_METRICS_HISTORY",
+    ):
+        monkeypatch.delenv(var, raising=False)
+
+    assert fed.federation_dir() is None
+    assert fed.publish_registry(MetricsRegistry()) is None
+    fed.note_fork_baseline()
+    assert fed.publish_fork_delta() is None
+    pipeline_root = str(tmp_path / "pipe")
+    assert MetricsHistory.from_env(pipeline_root) is None
+
+    # A real process-pool fan-out writes nothing anywhere.
+    monkeypatch.setenv("TPP_DATA_POOL", "process")
+    monkeypatch.setenv("TPP_DATA_POOL_WORKERS", "2")
+    assert map_shards(_fed_pool_work, [1, 2, 3, 4]) == [1, 2, 3, 4]
+    assert not os.path.exists(metrics_history_root(pipeline_root))
+
+    base = tmp_path / "m"
+    _fake_payload(base, 1, 1.0)
+    server = ModelServer(
+        "toy", str(base), replicas=2, max_batch_size=8,
+        batch_timeout_s=0.002,
+    )
+    assert server._federated is None
+    port = server.start()
+    try:
+        scrape = expected = None
+        for _ in range(3):  # tolerate a background gauge update race
+            expected = server.metrics.to_prometheus()
+            if server.request_tracer is not None:
+                expected += server.request_tracer.exemplar_exposition()
+            scrape = _get(f"http://127.0.0.1:{port}/metrics")
+            if scrape == expected:
+                break
+        assert scrape == expected
+    finally:
+        server.stop()
+    # The only artifacts under tmp_path are the model payload itself.
+    assert sorted(os.listdir(tmp_path)) == ["m"]
+
+
+# ------------------------------------------------- durable history ring
+
+
+def test_metrics_history_ring_retention_and_queries(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPP_METRICS_HISTORY", "1")
+    monkeypatch.setenv("TPP_METRICS_HISTORY_KEEP", "3")
+    root = str(tmp_path)
+    hist = MetricsHistory.from_env(root)
+    assert hist is not None and hist.keep == 3
+
+    reg = MetricsRegistry()
+    steps = reg.counter("train_steps_total", "d")
+    for i in range(5):
+        steps.inc(10)
+        hist.append(reg, "run-a", step=(i + 1) * 10)
+
+    run_dir = os.path.join(metrics_history_root(root), "run-a")
+    assert len(os.listdir(run_dir)) == 3  # retention enforced
+    rows = hist.series("run-a", "train_steps_total")
+    assert [r["value"] for r in rows] == [30.0, 40.0, 50.0]
+    assert [r["step"] for r in rows] == [30, 40, 50]
+
+    reg_b = MetricsRegistry()
+    reg_b.counter("train_steps_total", "d").inc(80)
+    hist.append(reg_b, "run-b", step=80)
+    assert hist.runs() == ["run-a", "run-b"]
+    delta = hist.run_delta("run-a", "run-b", ["train_steps_total"])
+    assert delta["train_steps_total"] == {"a": 50.0, "b": 80.0, "delta": 30.0}
+
+    # Rehydration: the ring replays into a scrapeable registry.
+    replay = hist.merged_registry("run-b")
+    assert "train_steps_total 80" in replay.to_prometheus()
+
+
+def test_metrics_history_headline_feeds_trace_diff(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPP_METRICS_HISTORY", "1")
+    hist = MetricsHistory.from_env(str(tmp_path))
+    reg = MetricsRegistry()
+    win = reg.counter(
+        "train_window_time_seconds", "d", labels=("phase",)
+    )
+    win.labels("infeed_wait").inc(1.0)
+    win.labels("device_compute").inc(3.0)
+    reg.counter("train_compiles_after_warm_total", "d").inc(0)
+    reg.gauge("train_mfu", "d").set(0.42)
+    reg.gauge(
+        "device_memory_peak_bytes", "d", labels=("device",)
+    ).labels("0").set(1234.0)
+    hist.append(reg, "run-c", step=100)
+
+    head = hist.headline("run-c")
+    assert head["window_phase_seconds"] == {
+        "infeed_wait": 1.0, "device_compute": 3.0,
+    }
+    assert head["infeed_wait_share"] == pytest.approx(0.25)
+    assert head["compiles_after_warm"] == 0.0
+    assert head["mfu"] == 0.42
+    assert head["device_memory_peak_bytes"] == 1234.0
+
+    # The headline is diff_metrics' input: an infeed regression between
+    # two runs trips the train_telemetry regression flag.
+    from tpu_pipelines.observability.export import diff_metrics
+
+    reg2 = MetricsRegistry()
+    win2 = reg2.counter(
+        "train_window_time_seconds", "d", labels=("phase",)
+    )
+    win2.labels("infeed_wait").inc(3.0)
+    win2.labels("device_compute").inc(3.0)
+    hist.append(reg2, "run-d", step=100)
+    diff = diff_metrics(
+        {"train_telemetry": hist.headline("run-c")},
+        {"train_telemetry": hist.headline("run-d")},
+    )
+    assert "train_telemetry.infeed_wait_share" in diff["regression_flags"]

@@ -321,6 +321,44 @@ def test_train_loop_publishes_gauges_and_nan_watchdog():
 # -------------------------------------------------- goodput mirror retry
 
 
+def test_goodput_tracker_drives_real_library_end_to_end():
+    """Regression for the ``cloud_logger=`` kwarg drift: the recorder and
+    calculator are constructed against the REAL installed
+    ml_goodput_measurement (keyword is ``logger=``), events are recorded,
+    and ``summary()`` must come back non-empty.  Before the fix the
+    constructor TypeError was swallowed by the best-effort except, silently
+    downgrading every run to the host-input-wait proxy."""
+    goodput_lib = pytest.importorskip("ml_goodput_measurement")
+    del goodput_lib
+
+    from tpu_pipelines.trainer.goodput import GoodputTracker
+
+    t = GoodputTracker("goodput-regression-probe")
+    # The whole point: construction against the real library succeeded.
+    assert t.enabled
+
+    t.job_start()
+    t.tpu_init_start()
+    time.sleep(0.02)
+    t.tpu_init_end()
+    t.training_prep_start()
+    time.sleep(0.01)
+    t.training_prep_end()
+    t.step_start(0)
+    time.sleep(0.02)
+    t.step_start(1)
+    time.sleep(0.02)
+    t.job_end()
+
+    s = t.summary()
+    assert s, "summary() fell back to {} against the real library"
+    assert 0.0 < s["goodput"] <= 1.0
+    assert s["last_step"] == 1
+    # The badput algebra ran: init + prep windows were attributed.
+    assert "tpu_initialization" in s["badput"]
+    assert "training_prep" in s["badput"]
+
+
 def test_goodput_mirror_counts_failures_and_retries_once(tmp_path):
     import builtins
 
@@ -365,7 +403,7 @@ def test_goodput_mirror_counts_failures_and_retries_once(tmp_path):
         logger.write_cloud_logging_entry(dict(entry))   # dead: no write
         assert len(path.read_text().splitlines()) == 2
         # Every entry stayed in memory regardless of mirror state.
-        entries, _ = logger.read_cloud_logging_entries()
+        entries = logger.read_cloud_logging_entries()
         assert len(entries) == 7
     finally:
         if hasattr(goodput_mod, "open"):
@@ -783,6 +821,53 @@ def test_trace_diff_cli_on_two_recorded_runs(tmp_path, capsys):
     assert main(["trace", "diff", fast.run_id, "nope",
                  "--pipeline-root", root]) == 1
     assert "no trace event log" in capsys.readouterr().err
+
+
+def test_trace_diff_formats_zero_baseline_regression(tmp_path):
+    """compiles_after_warm 0 -> N has no defined fraction (rel to a zero
+    baseline); format_diff must render the absolute move, not crash on
+    ``None.__format__`` — found live on the first real 0 -> 10 diff."""
+    from tpu_pipelines.observability.export import diff_metrics, format_diff
+
+    base = {
+        "per_node": {}, "critical_path_measured_s": 1.0,
+        "train_telemetry": {
+            "window_phase_seconds": {"infeed_wait": 0.1, "host": 0.9},
+            "compiles_after_warm": 0,
+        },
+    }
+    cand = {
+        "per_node": {}, "critical_path_measured_s": 1.0,
+        "train_telemetry": {
+            "window_phase_seconds": {"infeed_wait": 0.1, "host": 0.9},
+            "compiles_after_warm": 10,
+        },
+    }
+    diff = diff_metrics(base, cand)
+    assert "train_telemetry.compiles_after_warm" in diff["regression_flags"]
+    text = format_diff(diff)
+    assert "compiles_after_warm 0 -> 10" in text
+    assert "(0.0 -> 10.0)" in text
+
+
+def test_trace_latest_skips_cross_run_metrics_dir(tmp_path, capsys):
+    """`.runs/_metrics` (the durable snapshot ring) is newer than every
+    run dir the moment a ring snapshot lands — `trace latest` must never
+    resolve it as a run (found live: the very first post-ring scrape)."""
+    from tpu_pipelines.__main__ import main
+    from tpu_pipelines.orchestration import LocalDagRunner
+
+    result = LocalDagRunner().run(_sleep_pipeline(tmp_path, 0.01))
+    root = str(tmp_path / "root")
+    ring = os.path.join(root, ".runs", "_metrics", result.run_id)
+    os.makedirs(ring)
+    with open(os.path.join(ring, "snap-00000000.json"), "w") as f:
+        f.write("{}")
+
+    assert main(["trace", "latest", "--pipeline-root", root]) == 0
+    out = capsys.readouterr().out
+    assert result.run_id in out
+    assert "_metrics" not in out
 
 
 def test_trace_and_inspect_runs_json_flags(tmp_path, capsys):

@@ -170,7 +170,10 @@ def _resolve_run_id(pipeline_root: str, run_id: str):
     candidates = sorted(
         (d for d in (os.listdir(runs_dir) if os.path.isdir(runs_dir)
                      else [])
-         if os.path.isdir(os.path.join(runs_dir, d))),
+         # "_"-prefixed dirs are cross-run stores (.runs/_metrics), not
+         # runs — they'd otherwise win "latest" by mtime on every scrape.
+         if not d.startswith("_")
+         and os.path.isdir(os.path.join(runs_dir, d))),
         key=lambda d: os.path.getmtime(os.path.join(runs_dir, d)),
     )
     if not candidates:
@@ -205,6 +208,28 @@ def _load_run_metrics(pipeline_root: str, run_id: str):
     return (run_id, events, compute_metrics(events)), None
 
 
+def _attach_history_telemetry(
+    pipeline_root: str, run_id: str, metrics: dict
+) -> None:
+    """Backfill ``metrics['train_telemetry']`` from the durable snapshot
+    ring (<root>/.runs/_metrics/) when the trace itself recorded none —
+    the ring outlives the trainer process, so ``trace``/``trace diff``
+    can compare telemetry for runs whose event log predates the summary
+    instant or was trimmed.  No ring, no change."""
+    if metrics.get("train_telemetry"):
+        return
+    from tpu_pipelines.observability import MetricsHistory
+
+    try:
+        headline = MetricsHistory.for_pipeline_root(
+            pipeline_root
+        ).headline(run_id)
+    except OSError:
+        return
+    if headline:
+        metrics["train_telemetry"] = headline
+
+
 def cmd_trace(args) -> int:
     import json as _json
 
@@ -230,6 +255,7 @@ def cmd_trace(args) -> int:
         print(err, file=sys.stderr)
         return 1
     run_id, events, metrics = loaded
+    _attach_history_telemetry(args.pipeline_root, run_id, metrics)
     if args.json:
         print(_json.dumps(
             {"run_id": run_id, "events": len(events), **metrics},
@@ -275,6 +301,8 @@ def cmd_trace_diff(args) -> int:
             return 1
         loaded.append(got)
     (id_a, _, metrics_a), (id_b, _, metrics_b) = loaded
+    _attach_history_telemetry(args.pipeline_root, id_a, metrics_a)
+    _attach_history_telemetry(args.pipeline_root, id_b, metrics_b)
     diff = diff_metrics(metrics_a, metrics_b, threshold=args.threshold)
     if args.json:
         print(_json.dumps(
@@ -560,6 +588,7 @@ def cmd_lint(args) -> int:
     from tpu_pipelines.analysis import (
         EXIT_GATED,
         analyze_pipeline,
+        check_metric_docs,
         check_serving_metric_docs,
         format_findings,
         gated,
@@ -575,12 +604,14 @@ def cmd_lint(args) -> int:
             spmd_sync=getattr(args, "spmd_sync", False),
             continuous=getattr(args, "continuous", False),
         )
-        # TPP211 is repo-scoped (serving/ emissions vs the docs/SERVING.md
-        # catalog), not pipeline-scoped — it rides along with every lint so
-        # the same gate catches a decode metric shipped without its catalog
-        # row.
+        # TPP211/TPP214 are repo-scoped (metric emissions vs the doc
+        # catalogs), not pipeline-scoped — they ride along with every lint
+        # so the same gate catches a metric family shipped without its
+        # catalog row.
         findings = sort_findings(
-            list(findings) + check_serving_metric_docs()
+            list(findings)
+            + check_serving_metric_docs()
+            + check_metric_docs()
         )
     except Exception as e:
         # The module failing to load/compile is a tool error (1), not a

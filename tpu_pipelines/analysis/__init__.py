@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Sequence
 from tpu_pipelines.analysis.code_rules import (
     check_callable,
     check_component_code,
+    check_metric_docs,
     check_serving_metric_docs,
 )
 from tpu_pipelines.analysis.findings import (
@@ -175,6 +176,7 @@ __all__ = [
     "analyze_pipeline",
     "check_callable",
     "check_component_code",
+    "check_metric_docs",
     "check_serving_metric_docs",
     "count_by_severity",
     "format_findings",

@@ -896,3 +896,102 @@ def check_serving_metric_docs(
                         "docs/SERVING.md (name, type, labels, meaning)",
                 ))
     return out
+
+
+# ------------------------------------------------- repo-level rules (TPP214)
+
+# Any Prometheus-suffixed metric-name constant anywhere in the package:
+# the TPP211 contract (emitted series must have a catalog row) applied
+# repo-wide.  The unit suffixes are the signal — ``*_total`` counters,
+# ``*_seconds``/``*_bytes`` gauges and histograms are metric names by
+# this repo's own naming convention; bare words like ``"total"`` don't
+# match (a prefix is required).
+_METRIC_NAME_RE = re.compile(r"[a-z][a-z0-9_]*_(total|seconds|bytes)\Z")
+
+
+def check_metric_docs(
+    package_dir: Optional[str] = None,
+    doc_paths: Optional[List[str]] = None,
+) -> List[Finding]:
+    """TPP214: every metric-name string constant under ``tpu_pipelines/``
+    (``*_total`` / ``*_seconds`` / ``*_bytes``) must appear in one of the
+    metric catalogs (``docs/OBSERVABILITY.md`` or ``docs/SERVING.md``).
+
+    The repo-wide generalization of TPP211: the serving decode catalog
+    turned out to be the only metric surface the lint protected, while
+    trainer, runner, data-plane, continuous, and federation families
+    shipped unchecked.  Same mechanics — AST string constants matched
+    against doc text, per-line ``# tpp: disable=TPP214`` suppression,
+    one finding per name per file — but scanning the whole package
+    against BOTH docs, so a telemetry family added anywhere without its
+    operator-contract row fails the same ``lint`` gate.
+
+    Defaults resolve against the installed package root and its sibling
+    ``docs/``; tests point both at tmp fixtures.  Missing doc files read
+    as empty catalogs (everything flags), not as errors.
+    """
+    import os
+
+    if package_dir is None:
+        import tpu_pipelines as _pkg
+
+        package_dir = os.path.dirname(os.path.abspath(_pkg.__file__))
+    if doc_paths is None:
+        repo_root = os.path.dirname(os.path.abspath(package_dir))
+        doc_paths = [
+            os.path.join(repo_root, "docs", "OBSERVABILITY.md"),
+            os.path.join(repo_root, "docs", "SERVING.md"),
+        ]
+    doc_text = ""
+    for doc_path in doc_paths:
+        try:
+            with open(doc_path, "r", encoding="utf-8") as fh:
+                doc_text += fh.read()
+        except OSError:
+            pass
+
+    out: List[Finding] = []
+    for dirpath, _dirnames, filenames in sorted(os.walk(package_dir)):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    source = fh.read()
+                tree = ast.parse(source)
+            except (OSError, SyntaxError):
+                continue
+            lines = source.splitlines()
+            seen_here: Set[str] = set()
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)):
+                    continue
+                name = node.value
+                if not _METRIC_NAME_RE.match(name):
+                    continue
+                if name in doc_text or name in seen_here:
+                    continue
+                line_no = getattr(node, "lineno", 0)
+                text = lines[line_no - 1] if 0 < line_no <= len(lines) else ""
+                if suppressed_in_source(text, "TPP214"):
+                    continue
+                seen_here.add(name)
+                out.append(Finding(
+                    rule="TPP214", severity=WARN,
+                    node_id="<repo>",
+                    message=(
+                        f"metric-shaped name {name!r} is emitted here but "
+                        "listed in neither docs/OBSERVABILITY.md nor "
+                        "docs/SERVING.md — the metric catalogs are the "
+                        "operator contract; an undocumented series is "
+                        "invisible to dashboards and alerts"
+                    ),
+                    file=path, line=line_no,
+                    fix=f"add {name!r} to the catalog in docs/"
+                        "OBSERVABILITY.md (or docs/SERVING.md for serving "
+                        "families), or suppress a non-metric string with "
+                        "# tpp: disable=TPP214",
+                ))
+    return out

@@ -183,6 +183,14 @@ RULES: Dict[str, Dict[str, str]] = {
                  "the partition is never applied, and the train loop "
                  "rejects the pair at startup",
     },
+    "TPP214": {
+        "severity": WARN,
+        "title": "metric-shaped name (*_total/*_seconds/*_bytes) emitted "
+                 "under tpu_pipelines/ but listed in neither docs/"
+                 "OBSERVABILITY.md nor docs/SERVING.md — the metric "
+                 "catalogs are the operator contract; an undocumented "
+                 "series is invisible to dashboards and alerts",
+    },
 }
 
 GRAPH_RULE_PREFIX = "TPP1"

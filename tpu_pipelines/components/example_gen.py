@@ -187,7 +187,8 @@ def _convert_options(column_types):
         # Files above this many bytes stream through pyarrow's incremental
         # CSV reader into per-split writers (O(block) memory) instead of
         # being read whole.  0 = always stream.
-        "streaming_threshold_bytes": Parameter(type=int, default=256 << 20),
+        "streaming_threshold_bytes":  # tpp: disable=TPP214 (parameter)
+            Parameter(type=int, default=256 << 20),
         # Optional {column: arrow-type-alias} (e.g. {"fare": "float64"}).
         # The streaming reader infers types from its FIRST block only, so
         # pin any column whose type could shift deeper into a large file
@@ -221,7 +222,7 @@ def CsvExampleGen(ctx):
             ctx.exec_properties.get("version"),
         )
     splits = ctx.exec_properties["splits"] or dict(DEFAULT_SPLITS)
-    threshold = ctx.exec_properties["streaming_threshold_bytes"]
+    threshold = ctx.exec_properties["streaming_threshold_bytes"]  # tpp: disable=TPP214 (parameter)
     plan = ShardPlan.resolve(ctx.exec_properties.get("num_shards"))
     convert = _convert_options(ctx.exec_properties["column_types"])
     if os.path.isdir(path):

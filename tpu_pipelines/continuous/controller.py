@@ -112,6 +112,10 @@ class ContinuousController:
         self.last_deploy: Optional[Dict[str, Any]] = None
         self.last_iteration: Dict[str, Any] = {}
         self._iterations = 0
+        # Previous window run's MetricsHistory headline: the baseline the
+        # next retrain's telemetry is compared against (ring-durable
+        # telemetry is what makes the comparison survive restarts).
+        self._last_window_telemetry: Optional[Dict[str, Any]] = None
         self._init_metrics(cfg.registry)
 
     # ------------------------------------------------------------- metrics
@@ -234,14 +238,15 @@ class ContinuousController:
 
         deployed: Optional[Dict[str, Any]] = None
         window_size = 0
+        telemetry: Optional[Dict[str, Any]] = None
+        telemetry_flags: List[str] = []
         if (
             self._window_dirty
             and not stop.is_set()
             and self.watcher.seen_spans()
         ):
-            result = self._run_pipeline(
-                self.cfg.make_window_pipeline(), kind="window"
-            )
+            window_pipeline = self.cfg.make_window_pipeline()
+            result = self._run_pipeline(window_pipeline, kind="window")
             if result is not None and result.succeeded:
                 self._window_dirty = False
                 statuses.extend(
@@ -249,6 +254,9 @@ class ContinuousController:
                 )
                 deployed = self._detect_deploy(result)
                 window_size = self._window_span_count(result)
+                telemetry, telemetry_flags = self._window_telemetry(
+                    window_pipeline.pipeline_root, result.run_id
+                )
             else:
                 # Survive a controller restart too: the marker re-arms
                 # _window_dirty in __init__ (resume/caching make the
@@ -285,6 +293,10 @@ class ContinuousController:
             "deployed": deployed,
             "wall_s": round(time.monotonic() - t0, 3),
         }
+        if telemetry is not None:
+            summary["train_telemetry"] = telemetry
+            if telemetry_flags:
+                summary["train_telemetry_regressions"] = telemetry_flags
         if deployed is not None:
             self._c_deploys.inc()
             deployed["deploy_latency_s"] = summary["wall_s"]
@@ -357,6 +369,45 @@ class ContinuousController:
                 kind, pipeline.name, result.run_id, failed,
             )
         return result
+
+    def _window_telemetry(
+        self, pipeline_root: str, run_id: str
+    ) -> "tuple[Optional[Dict[str, Any]], List[str]]":
+        """Read the just-finished window run's training-telemetry
+        headline from the durable snapshot ring and diff it against the
+        previous window's (both survive controller restarts and trainer
+        exits — the ring, not a live scrape, is the source).  Returns
+        (headline or None, regression flag list); empty when the ring
+        recorded nothing (TPP_METRICS_HISTORY unset)."""
+        from tpu_pipelines.observability.export import diff_metrics
+        from tpu_pipelines.observability.metrics_history import (
+            MetricsHistory,
+        )
+
+        if not pipeline_root:
+            return None, []
+        try:
+            headline = MetricsHistory.for_pipeline_root(
+                pipeline_root
+            ).headline(run_id)
+        except OSError:
+            return None, []
+        if not headline:
+            return None, []
+        flags: List[str] = []
+        prev = self._last_window_telemetry
+        if prev:
+            flags = diff_metrics(
+                {"train_telemetry": prev},
+                {"train_telemetry": headline},
+            )["regression_flags"]
+            if flags:
+                log.warning(
+                    "window retrain %s telemetry regressed vs previous "
+                    "window: %s", run_id, flags,
+                )
+        self._last_window_telemetry = headline
+        return headline, flags
 
     def _load_pending(self) -> Dict[str, Any]:
         if not self._pending_path:

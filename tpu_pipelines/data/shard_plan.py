@@ -41,6 +41,7 @@ from typing import (
 )
 
 from tpu_pipelines.observability import trace as _obs
+from tpu_pipelines.observability import federation as _fed
 from tpu_pipelines.robustness import (
     NO_RETRY,
     RetryPolicy,
@@ -157,23 +158,41 @@ class _TracedShardFn:
     idempotent, so fallbacks never double-wrap.
     """
 
-    __slots__ = ("fn", "label", "pool")
+    __slots__ = ("fn", "label", "pool", "parent_pid")
 
     def __init__(self, fn: Callable, label: str, pool: str):
         self.fn = fn
         self.label = label
         self.pool = pool
+        # Captured in the PARENT: a pid mismatch inside __call__ means
+        # we are a fork-pool child and should federate our own metric
+        # deltas back to the parent's scrape (no-op when federation is
+        # off — the child's registry updates are otherwise lost).
+        self.parent_pid = os.getpid()
 
     def __call__(self, indexed):
         i, task = indexed
         # Fault hook (testing/faults.py KILL_SHARD_WORKER): one module-
         # global read when no plan is active.
         _faults.in_shard(i)
-        with _obs.span(
-            "shard", cat="data",
-            args={"label": self.label, "shard": i, "pool": self.pool},
-        ):
-            return self.fn(task)
+        in_child = os.getpid() != self.parent_pid
+        if in_child:
+            _fed.note_fork_baseline()
+        try:
+            with _obs.span(
+                "shard", cat="data",
+                args={"label": self.label, "shard": i, "pool": self.pool},
+            ):
+                return self.fn(task)
+        finally:
+            if in_child:
+                try:
+                    _fed.publish_fork_delta()
+                except OSError:
+                    log.warning(
+                        "federation publish failed for shard worker %d",
+                        os.getpid(), exc_info=True,
+                    )
 
 
 @dataclasses.dataclass

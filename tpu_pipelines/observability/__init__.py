@@ -50,6 +50,19 @@ from tpu_pipelines.observability.metrics import (  # noqa: F401
     latency_buckets,
     start_http_server,
 )
+from tpu_pipelines.observability.federation import (  # noqa: F401
+    FederatedRegistry,
+    federation_dir,
+    federation_labels,
+    publish_registry,
+    publish_snapshot,
+)
+from tpu_pipelines.observability.metrics_history import (  # noqa: F401
+    MetricsHistory,
+    history_enabled,
+    metrics_history_root,
+    snapshot_value,
+)
 from tpu_pipelines.observability.health import (  # noqa: F401
     HealthMonitor,
     stall_timeout_from_env,

@@ -332,6 +332,7 @@ def compute_metrics(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     store_ops: Dict[str, Dict[str, Any]] = {}
     shard_pools: Dict[str, List[float]] = {}
     goodput: Optional[Dict[str, Any]] = None
+    train_telemetry: Optional[Dict[str, Any]] = None
     run_span = {"start": None, "end": None, "succeeded": None}
     deadline_expiries: List[str] = []
     adopted: List[str] = []
@@ -381,6 +382,8 @@ def compute_metrics(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             ).append(dur)
         elif cat == "trainer" and name == "goodput_summary":
             goodput = args or None
+        elif cat == "trainer" and name == "train_telemetry_summary":
+            train_telemetry = args or None
         elif cat == "run" and name == "run_start":
             if run_span["start"] is None:
                 run_span["start"] = e.get("ts")
@@ -436,6 +439,7 @@ def compute_metrics(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "deadline_expiries": deadline_expiries,
         "adopted_nodes": sorted(set(adopted)),
         "goodput": goodput,
+        "train_telemetry": train_telemetry,
         "run_wall_s": measured_wall,
         "run_succeeded": run_span["succeeded"],
     }
@@ -540,6 +544,58 @@ def diff_metrics(
         v = d.get(key)
         return float(v) if v is not None else None
 
+    # Training-telemetry regressions (from the train_telemetry_summary
+    # instant or a MetricsHistory headline — both carry the same keys).
+    tt_a = run_a.get("train_telemetry") or {}
+    tt_b = run_b.get("train_telemetry") or {}
+    train_telemetry_diff: Dict[str, Any] = {}
+    if tt_a or tt_b:
+        def _share(tt: Dict[str, Any]) -> Optional[float]:
+            if tt.get("infeed_wait_share") is not None:
+                return float(tt["infeed_wait_share"])
+            phases = tt.get("window_phase_seconds") or {}
+            total = sum(phases.values())
+            if not total:
+                return None
+            return float(phases.get("infeed_wait", 0.0)) / total
+
+        share_a, share_b = _share(tt_a), _share(tt_b)
+        comp_a = float(tt_a.get("compiles_after_warm") or 0.0)
+        comp_b = float(tt_b.get("compiles_after_warm") or 0.0)
+        train_telemetry_diff = {
+            "infeed_wait_share_a": (
+                round(share_a, 4) if share_a is not None else None
+            ),
+            "infeed_wait_share_b": (
+                round(share_b, 4) if share_b is not None else None
+            ),
+            "compiles_after_warm_a": int(comp_a),
+            "compiles_after_warm_b": int(comp_b),
+            "mfu_a": _get(tt_a, "mfu"),
+            "mfu_b": _get(tt_b, "mfu"),
+        }
+        # Input-bound drift: the candidate spends a materially larger
+        # share of the window waiting on the host pipeline.  The 0.05
+        # absolute floor plays the min_abs_s role for a ratio.
+        if (
+            share_a is not None and share_b is not None
+            and share_b - share_a > max(0.05, share_a * threshold)
+        ):
+            regressions.append({
+                "metric": "train_telemetry.infeed_wait_share",
+                "a": round(share_a, 4),
+                "b": round(share_b, 4),
+                "frac": rel(share_a, share_b),
+            })
+        # Any growth in mid-run recompiles is a stall regression.
+        if comp_b > comp_a:
+            regressions.append({
+                "metric": "train_telemetry.compiles_after_warm",
+                "a": comp_a,
+                "b": comp_b,
+                "frac": rel(comp_a, comp_b),
+            })
+
     cache_a = _get(run_a, "cache_hit_ratio")
     cache_b = _get(run_b, "cache_hit_ratio")
     return {
@@ -557,6 +613,7 @@ def diff_metrics(
         ),
         "cache_hit_ratio_a": cache_a,
         "cache_hit_ratio_b": cache_b,
+        "train_telemetry": train_telemetry_diff,
         "regression_flags": [r["metric"] for r in regressions],
         "regressions": regressions,
         "regressed": bool(regressions),
@@ -600,10 +657,30 @@ def format_diff(diff: Dict[str, Any]) -> str:
             f"{e['wall_delta_s']:>+9.3f} "
             f"{(f'{frac:+.1%}' if frac is not None else '-'):>8}  {flag}"
         )
+    tt = diff.get("train_telemetry") or {}
+    if tt:
+        def _fmt(v, pct=False):
+            if v is None:
+                return "-"
+            return f"{v:.1%}" if pct else f"{v}"
+
+        lines.append(
+            "train telemetry: infeed_wait "
+            f"{_fmt(tt.get('infeed_wait_share_a'), pct=True)} -> "
+            f"{_fmt(tt.get('infeed_wait_share_b'), pct=True)} · "
+            "compiles_after_warm "
+            f"{tt.get('compiles_after_warm_a', 0)} -> "
+            f"{tt.get('compiles_after_warm_b', 0)} · mfu "
+            f"{_fmt(tt.get('mfu_a'))} -> {_fmt(tt.get('mfu_b'))}"
+        )
     if diff["regressions"]:
+        # frac is None when the baseline was 0 (e.g. compiles_after_warm
+        # 0 -> N) — show the absolute move instead of crashing on it.
         lines.append(
             "regressions: " + ", ".join(
                 f"{r['metric']} ({r['frac']:+.1%})"
+                if r.get("frac") is not None
+                else f"{r['metric']} ({r['a']} -> {r['b']})"
                 for r in diff["regressions"]
             )
         )
@@ -660,4 +737,22 @@ def format_summary(metrics: Dict[str, Any]) -> str:
     gp = metrics.get("goodput")
     if gp:
         lines.append(f"goodput: {gp}")
+    tt = metrics.get("train_telemetry")
+    if tt:
+        phases = tt.get("window_phase_seconds") or {}
+        total = sum(phases.values())
+        if total > 0:
+            lines.append(
+                "train phases: " + "  ".join(
+                    f"{k}={v}s ({v / total:.0%})"
+                    for k, v in sorted(phases.items())
+                )
+            )
+        tail = []
+        if tt.get("mfu") is not None:
+            tail.append(f"mfu={tt['mfu']}")
+        tail.append(
+            f"compiles_after_warm={tt.get('compiles_after_warm', 0)}"
+        )
+        lines.append("train telemetry: " + "  ".join(tail))
     return "\n".join(lines)

@@ -115,6 +115,22 @@ class TPUJobRunnerConfig:
     # a cluster Prometheus with kubernetes_sd discovers the pods with no
     # per-pipeline scrape config.  0 = no server, no annotations.
     metrics_port: int = 0
+    # Metric-federation spool (observability/federation.py).  When set,
+    # every pod gets TPP_FEDERATION_DIR so trainers / fork-pool workers /
+    # fleet replicas publish snapshot deltas there, and each pod's
+    # /metrics port serves the MERGED host/replica/tenant-labeled scrape.
+    # Must live on the shared volume (same precondition as
+    # pipeline_root).  "" = federation off, zero footprint.
+    federation_spool: str = ""
+    # Tenant label stamped on every federated series (TPP_TENANT) — the
+    # per-team quota-accounting seam (ROADMAP item 1).  "" = unlabeled.
+    tenant: str = ""
+    # Durable metrics history (observability/metrics_history.py).  True
+    # sets TPP_METRICS_HISTORY=1 in every pod: trainers append scrape
+    # snapshots to <pipeline_root>/.runs/_metrics/<run_id>/ for
+    # `trace diff` and the continuous controller to read after the pods
+    # are gone.
+    metrics_history: bool = False
     # Static-analysis gate on the compiled IR (docs/ANALYSIS.md) before any
     # manifest is emitted: "error" (default) refuses on ERROR findings,
     # "warn" on any finding, "off" disables.  Graph rules (TPP1xx) only —
@@ -284,10 +300,22 @@ class TPUJobRunner:
         }
 
     def _metrics_env(self) -> List[Dict[str, str]]:
-        port = self.config.metrics_port
-        if port <= 0:
-            return []
-        return [{"name": "TPP_METRICS_PORT", "value": str(port)}]
+        cfg = self.config
+        env: List[Dict[str, str]] = []
+        if cfg.metrics_port > 0:
+            env.append(
+                {"name": "TPP_METRICS_PORT", "value": str(cfg.metrics_port)}
+            )
+        if cfg.federation_spool:
+            env.append({
+                "name": "TPP_FEDERATION_DIR",
+                "value": cfg.federation_spool,
+            })
+        if cfg.tenant:
+            env.append({"name": "TPP_TENANT", "value": cfg.tenant})
+        if cfg.metrics_history:
+            env.append({"name": "TPP_METRICS_HISTORY", "value": "1"})
+        return env
 
     def _load_trace_metrics(self) -> Dict[str, Any]:
         """Prior-run RunTrace metrics, {} when not configured.
@@ -448,10 +476,11 @@ class TPUJobRunner:
                 tpl.setdefault("metadata", {}).setdefault(
                     "annotations", {}
                 ).update(self._metrics_annotations())
-                if "container" in tpl:
-                    tpl["container"].setdefault("env", []).extend(
-                        self._metrics_env()
-                    )
+            metrics_env = self._metrics_env()
+            if metrics_env and "container" in tpl:
+                # Federation/history knobs flow even without a scrape
+                # port — the spool and the snapshot ring are file-based.
+                tpl["container"].setdefault("env", []).extend(metrics_env)
             templates.append(tpl)
         spec: Dict[str, Any] = {
             "entrypoint": "pipeline-dag",
@@ -515,6 +544,14 @@ class TPUJobRunner:
                 "value": self._tuner_shard_dir(ir, node_id),
             })
         env.extend(self._metrics_env())
+        if cfg.federation_spool:
+            # Each training host publishes under its own replica label;
+            # the pod name (unique per completion index) is the natural
+            # host-stable identity.
+            env.append({
+                "name": "TPP_FED_REPLICA",
+                "valueFrom": {"fieldRef": {"fieldPath": "metadata.name"}},
+            })
         container = {
             "name": "worker",
             "image": cfg.image,

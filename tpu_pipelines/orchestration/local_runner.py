@@ -230,10 +230,23 @@ class _RunTelemetry:
             from tpu_pipelines.observability.metrics import (
                 start_http_server,
             )
+            from tpu_pipelines.observability.federation import (
+                FederatedRegistry,
+                federation_dir,
+            )
 
+            # With TPP_FEDERATION_DIR set, the runner's port becomes the
+            # ONE federated scrape: its own registry merged with every
+            # spooled snapshot (fork-pool workers, per-host trainers,
+            # fleet replicas), host/replica/tenant-labeled.  Without it,
+            # the plain process registry is served — byte-identical to
+            # the pre-federation behavior.
+            serve_reg = (
+                FederatedRegistry(reg) if federation_dir() else reg
+            )
             try:
                 self._server = start_http_server(
-                    reg, port=int(port), health_fn=self._health
+                    serve_reg, port=int(port), health_fn=self._health
                 )
                 log.info(
                     "metrics server on :%d (/metrics, /healthz)",

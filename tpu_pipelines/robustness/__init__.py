@@ -54,7 +54,7 @@ __all__ = [
     "TRANSIENT",
     "TRANSIENT_ERRNOS",
     "TransientError",
-    "atomic_write_bytes",
+    "atomic_write_bytes",  # tpp: disable=TPP214 (function name)
     "atomic_write_json",
     "classify_error",
     "is_transient",

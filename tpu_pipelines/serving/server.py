@@ -41,6 +41,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from tpu_pipelines.observability import federation as _fed
 from tpu_pipelines.observability import request_trace
 from tpu_pipelines.observability.metrics import (
     CONTENT_TYPE_LATEST,
@@ -283,6 +284,19 @@ class ModelServer:
             service=model_name,
             registry=self.metrics,
         )
+        # Metric federation (observability/federation.py), opt-in via
+        # TPP_FEDERATION_DIR: each scrape first publishes THIS server's
+        # registry into the spool (so sibling replicas' endpoints merge
+        # it, at most one scrape interval stale), then serves the merged
+        # host/replica/tenant-labeled exposition — any replica's
+        # /metrics is the fleet-wide endpoint.  The writer stamp keeps
+        # merged() from re-counting our own spool file.  Unset: plain
+        # local exposition, no files — byte-identical to pre-federation.
+        self._federated = None
+        self._fed_source = ""
+        if _fed.federation_dir() is not None:
+            self._fed_source = f"serving-{model_name}-{os.getpid()}"
+            self._federated = _fed.FederatedRegistry(self.metrics)
         if slo_monitor_interval_s < 0:
             slo_monitor_interval_s = _env_number(ENV_SLO_MONITOR, 0.0)
         self._slo_interval_s = max(0.0, slo_monitor_interval_s)
@@ -658,7 +672,16 @@ class ModelServer:
                     # id per scrape interval (comments are invisible to
                     # scrape parsers; with tracing off nothing is
                     # appended and the exposition is byte-identical).
-                    text = server.metrics.to_prometheus()
+                    if server._federated is not None:
+                        try:
+                            _fed.publish_registry(
+                                server.metrics, source=server._fed_source
+                            )
+                        except OSError:
+                            pass  # spool unwritable: still serve local
+                        text = server._federated.to_prometheus()
+                    else:
+                        text = server.metrics.to_prometheus()
                     if server.request_tracer is not None:
                         text += server.request_tracer.exemplar_exposition()
                     body = text.encode("utf-8")

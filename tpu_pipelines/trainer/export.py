@@ -88,7 +88,7 @@ def export_model(
             # resident parameter bytes — what the fleet's
             # serving_version_memory_bytes gauge reports per version.
             "dtype": serving_dtype or qz.infer_dtype(params),
-            "params_bytes": qz.params_nbytes(params),
+            "params_bytes": qz.params_nbytes(params),  # tpp: disable=TPP214 (payload key)
             **(extra_spec or {}),
         }
         with open(os.path.join(serving_model_dir, SPEC_FILE), "w") as f:

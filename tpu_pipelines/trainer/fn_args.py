@@ -157,3 +157,21 @@ class TrainResult:
     # "psum_bucketed" = chunked in-scan psums, "ordered" = fixed-block
     # mesh-size-invariant reduction (TrainLoopConfig.dp_collective).
     dp_collective: str = ""
+    # Model-FLOPs utilization: cost-analysis FLOPs/step x post-warmup
+    # steps / attributed device-compute seconds / (peak chip FLOPs x
+    # chips).  Needs collect_cost_analysis=True and a known peak
+    # (TrainLoopConfig.peak_flops_per_chip / TPP_PEAK_FLOPS / device-kind
+    # table); None otherwise.  Also published live as the train_mfu gauge.
+    mfu: Optional[float] = None
+    # XLA backend compiles observed AFTER the first window retired —
+    # the training twin of serving_aot_compiles_after_warm_total.  Every
+    # one is a mid-run recompile stall; steady state is 0.
+    compiles_after_warm: int = 0
+    # Post-warmup windowed-loop wall-clock attributed per phase
+    # (infeed_wait | device_compute | device_collective | host; the
+    # phases of each window sum to its wall-clock).  Empty on the
+    # per-step (window_steps<=1) path, which cannot separate device from
+    # host time without a per-step sync.
+    window_phase_seconds: Dict[str, float] = dataclasses.field(
+        default_factory=dict
+    )

@@ -102,10 +102,11 @@ class LocalEntryLogger:
             # its own backoff + single retry.
             self._mirror_retry_at = None
 
-    def read_cloud_logging_entries(
-        self, start_time=None, end_time=None, last_entry_info=None
-    ):
-        return list(self._entries), last_entry_info
+    def read_cloud_logging_entries(self):
+        # The calculator iterates this return value directly as the list of
+        # payload dicts (no pagination tuple) — returning anything else makes
+        # ``_get_total_job_time`` iterate the wrapper and blow up on None.
+        return list(self._entries)
 
 
 class GoodputTracker:
@@ -119,9 +120,14 @@ class GoodputTracker:
             from ml_goodput_measurement.src import goodput as goodput_mod
 
             self._logger = LocalEntryLogger(job_name, jsonl_path)
+            # Keyword is ``logger=`` (ml_goodput_measurement >= 0.0.2);
+            # the old ``cloud_logger=`` raised TypeError here, which the
+            # best-effort except silently downgraded EVERY run to the
+            # proxy path — the regression test drives this constructor
+            # for real.
             self._recorder = goodput_mod.GoodputRecorder(
                 job_name, "local", logging_enabled=True,
-                cloud_logger=self._logger,
+                logger=self._logger,
             )
             self._goodput_mod = goodput_mod
         except Exception as e:  # noqa: BLE001 — accounting is best-effort
@@ -178,7 +184,7 @@ class GoodputTracker:
             return {}
         try:
             calc = self._goodput_mod.GoodputCalculator(
-                self.job_name, "local", cloud_logger=self._logger
+                self.job_name, "local", logger=self._logger
             )
             goodput_pct, badput, last_step = calc.get_job_goodput(
                 include_badput_breakdown=True

@@ -14,6 +14,7 @@ per-parameter sharding rules for model parallelism.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import itertools
 import logging
@@ -44,6 +45,137 @@ from tpu_pipelines.trainer.fn_args import TrainResult
 from tpu_pipelines.trainer.goodput import GoodputTracker
 
 log = logging.getLogger("tpu_pipelines.trainer")
+
+
+# ---- XLA compile-event tracking (the training twin of the serving
+# fleet's aot-compiles-after-warm audit).  jax.monitoring fires
+# '/jax/core/compile/backend_compile_duration' for every backend
+# compile; listeners cannot be unregistered, so ONE process-wide
+# listener is installed lazily and dispatches to the hook of whichever
+# train loop is currently running — the indirection is what scopes
+# attribution to the live loop and makes repeated train_loop calls in
+# one process (tests, tuner trials) not leak listeners.
+_COMPILE_EVENT_SUFFIX = "backend_compile_duration"
+_COMPILE_HOOK: Optional[Callable[[float], None]] = None
+_COMPILE_LISTENER_INSTALLED = False
+
+
+def _on_xla_compile_event(event: str, duration_s: float, **_kw: Any) -> None:
+    hook = _COMPILE_HOOK
+    if hook is not None and event.endswith(_COMPILE_EVENT_SUFFIX):
+        hook(float(duration_s))
+
+
+# Marked administrative regions: compiles inside one (same thread) are
+# real XLA work but never a step stall — the hook books them under the
+# "admin" label instead of the after-warm counter.  threading.local so a
+# region opened on the loop thread cannot mask a concurrent thread.
+_COMPILE_ADMIN = threading.local()
+
+
+def _compile_admin_depth() -> int:
+    return getattr(_COMPILE_ADMIN, "depth", 0)
+
+
+@contextlib.contextmanager
+def _compile_admin_region():
+    _COMPILE_ADMIN.depth = _compile_admin_depth() + 1
+    try:
+        yield
+    finally:
+        _COMPILE_ADMIN.depth -= 1
+
+
+def _set_compile_hook(hook: Optional[Callable[[float], None]]) -> None:
+    global _COMPILE_HOOK, _COMPILE_LISTENER_INSTALLED
+    _COMPILE_HOOK = hook
+    if hook is not None and not _COMPILE_LISTENER_INSTALLED:
+        try:
+            from jax import monitoring
+
+            monitoring.register_event_duration_secs_listener(
+                _on_xla_compile_event
+            )
+            _COMPILE_LISTENER_INSTALLED = True
+        except Exception as e:  # noqa: BLE001 — telemetry must not fail a run
+            log.debug("compile-event listener unavailable: %s", e)
+
+
+# Peak per-chip bf16 FLOPs for the live train_mfu gauge.  Precedence:
+# TrainLoopConfig.peak_flops_per_chip > TPP_PEAK_FLOPS env > device-kind
+# table (same table bench.py matches) > 0.0 (MFU not computed — an
+# assumed denominator would publish a made-up utilization).
+ENV_PEAK_FLOPS = "TPP_PEAK_FLOPS"
+_PEAK_BF16_FLOPS = [
+    ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v6 lite", 918e12), ("v6e", 918e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+]
+
+
+def _peak_flops_per_chip(config: "TrainLoopConfig") -> float:
+    if config.peak_flops_per_chip:
+        return float(config.peak_flops_per_chip)
+    env = os.environ.get(ENV_PEAK_FLOPS, "").strip()
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            log.warning("ignoring non-numeric %s=%r", ENV_PEAK_FLOPS, env)
+    try:
+        kind = jax.local_devices()[0].device_kind.lower()
+    except Exception:  # noqa: BLE001
+        return 0.0
+    for key, peak in _PEAK_BF16_FLOPS:
+        if key in kind:
+            return peak
+    return 0.0
+
+
+def _tree_bytes(tree: Any) -> int:
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        try:
+            total += int(np.prod(shape)) * int(np.dtype(dtype).itemsize)
+        except (TypeError, ValueError):
+            pass
+    return total
+
+
+def _collective_fraction(params: Any, first_batch: Any, mesh: Mesh,
+                         dp_mode: str) -> float:
+    """Estimated share of a window's device span spent in the gradient
+    exchange, splitting the measured device phase into device_compute /
+    device_collective.  Bandwidth proxy over the same byte counts the
+    PR 18 memory_analysis checks reason about: per step the exchange
+    moves ~factor x (N-1)/N x param_bytes over the interconnect
+    (factor 2 for an all-reduce — reduce-scatter + all-gather — and 3
+    for fsdp's JIT gathers + reduce-scatter), against an HBM-traffic
+    proxy of 3 x param_bytes (read params + read grads + write params)
+    plus the per-device batch.  An estimate, not a measurement — but the
+    published phases still sum exactly to wall-clock because only the
+    measured device span is being split."""
+    try:
+        n = int(mesh.shape["data"])
+    except (KeyError, TypeError):
+        n = 1
+    if n <= 1:
+        return 0.0
+    param_bytes = _tree_bytes(params)
+    if param_bytes <= 0:
+        return 0.0
+    batch_bytes = _tree_bytes(first_batch) / n
+    factor = 3.0 if dp_mode == "fsdp" else 2.0
+    coll = factor * (n - 1) / n * param_bytes
+    hbm = 3.0 * param_bytes + batch_bytes
+    return coll / max(coll + hbm, 1.0)
 
 
 class TrainState(struct.PyTreeNode):
@@ -188,6 +320,19 @@ class TrainLoopConfig:
     # Called as cb(kind, detail) when a watchdog fires ("stall", "nan",
     # "loss_spike") — wire pagers, or sys.exit for fail-fast jobs.
     health_alert_cb: Optional[Callable[[str, str], None]] = None
+    # ---- telemetry plane (observability/federation + metrics_history) --
+    # Pipeline root the durable metrics-history ring lives under
+    # (<pipeline_root>/.runs/_metrics/<run_id>/).  "" = derive both from
+    # the active RunTrace recorder when one is installed.  Snapshots are
+    # written only when TPP_METRICS_HISTORY is set — zero files
+    # otherwise.  Federation publishing needs no config: it keys off
+    # TPP_FEDERATION_DIR alone.
+    pipeline_root: str = ""
+    run_id: str = ""
+    # Peak per-chip FLOPs for the live train_mfu gauge; None = env
+    # TPP_PEAK_FLOPS, else the device-kind table, else no MFU (a made-up
+    # denominator would publish a made-up utilization).
+    peak_flops_per_chip: Optional[float] = None
 
 
 LossFn = Callable[[Any, Dict[str, jax.Array], jax.Array], Tuple[jax.Array, Dict[str, jax.Array]]]
@@ -973,6 +1118,97 @@ def train_loop(
         "bytes_in_use on device 0 (0 where the backend reports none).",
     )
     g_steps = reg.gauge("train_steps_total", "Steps completed so far.")
+    # ---- step-time attribution + compile/HBM tracking (telemetry plane)
+    c_phase = reg.counter(
+        "train_window_time_seconds",
+        "Post-warmup windowed-loop wall-clock attributed per phase "
+        "(infeed_wait | device_compute | device_collective | host); the "
+        "phases of each window sum to its wall-clock.",
+        labels=("phase",),
+    )
+    c_compiles_warm = reg.counter(
+        "train_compiles_after_warm_total",
+        "XLA backend compiles of the TRAINING STEP path observed after "
+        "the first window retired — each one is a mid-run recompile "
+        "stall; steady state is 0.  Administrative compiles (checkpoint "
+        "snapshot copy, the eval program's own first build, background "
+        "threads) land under train_compile_seconds_total{when=\"admin\"} "
+        "instead.",
+    )
+    c_compile_s = reg.counter(
+        "train_compile_seconds_total",
+        "Cumulative XLA backend compile wall-clock, split by when it "
+        "happened (warmup = before the first window retired, steady = "
+        "after, admin = checkpoint-copy / eval-first-build / "
+        "background-thread compiles that are not step stalls).",
+        labels=("when",),
+    )
+    g_mfu = reg.gauge(
+        "train_mfu",
+        "Model-FLOPs utilization: cost-analysis FLOPs/step x post-warmup "
+        "steps / device-compute seconds / (peak chip FLOPs x chips); 0 "
+        "until measured (needs collect_cost_analysis and a known peak).",
+    )
+    g_dev_peak = reg.gauge(
+        "device_memory_peak_bytes",
+        "Per-device HBM high-water mark (memory_stats peak_bytes_in_use)"
+        ", live at window cadence.",
+        labels=("device",),
+    )
+    c_compiles_warm.inc(0)  # materialize the zero: absence is not proof
+
+    compile_stats = {
+        "warm": False, "after_warm": 0, "seconds": 0.0,
+        # True while dispatching the FIRST window of a given length: a
+        # cadence-split short window (checkpoint_every not a multiple of
+        # window_steps) compiles a new scan once, which is that
+        # program's warmup — only a re-compile of a length already seen
+        # is a genuine steady-state stall.
+        "first_of_len": False,
+    }
+    loop_thread = threading.get_ident()
+
+    def _on_compile(duration_s: float) -> None:
+        # Only the dispatch thread's un-suppressed compiles can be step
+        # stalls: the async checkpointer's orbax thread and the marked
+        # admin regions (snapshot copy, eval first build) compile real
+        # XLA programs too, but none of them block a training step — a
+        # healthy checkpointing run must still read after_warm == 0.
+        if (threading.get_ident() != loop_thread
+                or _compile_admin_depth() > 0):
+            compile_stats["seconds"] += duration_s
+            c_compile_s.labels("admin").inc(duration_s)
+            return
+        steady = compile_stats["warm"] and not compile_stats["first_of_len"]
+        compile_stats["seconds"] += duration_s
+        c_compile_s.labels("steady" if steady else "warmup").inc(duration_s)
+        if steady:
+            compile_stats["after_warm"] += 1
+            c_compiles_warm.inc()
+
+    # ---- federation + durable history publication (both opt-in by env;
+    # no knob set => no file, no socket, byte-identical scrape).
+    from tpu_pipelines.observability import federation as _fed
+    from tpu_pipelines.observability import trace as _obs
+    from tpu_pipelines.observability.metrics_history import MetricsHistory
+
+    fed_source = (
+        f"trainer-p{jax.process_index()}-{os.getpid()}"
+        if _fed.federation_dir() is not None else None
+    )
+    _active_rec = _obs.active_recorder()
+    _pipeline_root = config.pipeline_root
+    _hist_run_id = config.run_id
+    if _active_rec is not None:
+        _hist_run_id = _hist_run_id or getattr(_active_rec, "run_id", "")
+        rec_dir = getattr(_active_rec, "run_dir", "")
+        if not _pipeline_root and rec_dir:
+            # run_dir is <pipeline_root>/.runs/<run_id>
+            _pipeline_root = os.path.dirname(os.path.dirname(rec_dir))
+    history = (
+        MetricsHistory.from_env(_pipeline_root) if _pipeline_root else None
+    )
+    hist_run_id = _hist_run_id or "train"
     # tokens/example: the widest trailing extent among integer features
     # (token ids); mask-like siblings share the shape, max() dedups them.
     tokens_per_example = max(
@@ -1003,7 +1239,26 @@ def train_loop(
             g_device_mem.set(float((stats or {}).get("bytes_in_use", 0)))
         except Exception:  # noqa: BLE001 — not every backend reports
             pass
+        try:
+            # Per-device HBM watermark, promoted from a bench-only number
+            # to a live labeled gauge (not every backend reports it).
+            for d in jax.local_devices():
+                peak = (d.memory_stats() or {}).get("peak_bytes_in_use")
+                if peak is not None:
+                    g_dev_peak.labels(str(d.id)).set(float(peak))
+        except Exception:  # noqa: BLE001
+            pass
         monitor.heartbeat(at_step, loss=loss)
+        if fed_source is not None:
+            try:
+                _fed.publish_registry(reg, source=fed_source)
+            except OSError as e:
+                log.warning("federation publish failed: %s", e)
+        if history is not None:
+            try:
+                history.append(reg, hist_run_id, step=at_step)
+            except OSError as e:
+                log.warning("metrics-history append failed: %s", e)
 
     metrics_hist: list = []
     metrics = None   # stays None when resume starts at/past train_steps
@@ -1017,224 +1272,292 @@ def train_loop(
     step = start_step
     eff_window = _effective_window_steps(config)
     window_anchor = (step, time.perf_counter())  # telemetry window start
+    # Step-time attribution state (windowed path): measured per-window
+    # partition (the infeed wait and device span are clocked; host is
+    # the remainder, so the family sums exactly to wall-clock) with the
+    # estimated collective fraction splitting the device span.
+    phase_totals = {
+        "infeed_wait": 0.0, "device_compute": 0.0,
+        "device_collective": 0.0, "host": 0.0,
+    }
+    coll_frac = _collective_fraction(
+        state.params, first_batch, mesh, dp_mode
+    )
+
+    eval_warmed = {"done": False}
 
     def emit_eval(at_step: int) -> None:
-        ev = _run_eval(eval_step, state, eval_iter_fn, config, put_batch,
-                       has_model_state)
+        # The eval program's FIRST build is its own warmup, not a step
+        # stall — admin-book it; a re-compile on a later eval is real.
+        region = (
+            _compile_admin_region() if not eval_warmed["done"]
+            else contextlib.nullcontext()
+        )
+        eval_warmed["done"] = True
+        with region:
+            ev = _run_eval(eval_step, state, eval_iter_fn, config,
+                           put_batch, has_model_state)
         if metrics_cb:
             metrics_cb(at_step, {f"eval_{k}": v for k, v in ev.items()})
         tb_write("eval", at_step, {f"eval_{k}": v for k, v in ev.items()})
         log.info("step %d eval: %s", at_step, ev)
 
-    if eff_window > 1:
-        # ---- device-resident multi-step window (the host-loop-tax fix).
-        # The log_every window runs as ONE compiled lax.scan over a batch
-        # stack staged on device by the double-buffered infeed; the only
-        # per-window host traffic is the fetch of the scan's stacked
-        # metrics — a copy-out, never a sync on the (donated) hot state.
-        from tpu_pipelines.data.input_pipeline import windowed_infeed
+    _set_compile_hook(_on_compile)
+    try:
+        if eff_window > 1:
+            # ---- device-resident multi-step window (the host-loop-tax fix).
+            # The log_every window runs as ONE compiled lax.scan over a batch
+            # stack staged on device by the double-buffered infeed; the only
+            # per-window host traffic is the fetch of the scan's stacked
+            # metrics — a copy-out, never a sync on the (donated) hot state.
+            from tpu_pipelines.data.input_pipeline import windowed_infeed
 
-        win_shard = {
-            k: NamedSharding(mesh, P(None, *s.spec))
-            for k, s in batch_shard.items()
-        }
-        train_window = jax.jit(
-            lambda st, bats: jax.lax.scan(step_fn, st, bats),
-            in_shardings=(state_shard, win_shard),
-            out_shardings=(state_shard, None),
-            donate_argnums=(0,) if config.donate_state else (),
-        )
-
-        def stage_window(stacked):
-            return stage_global(stacked, win_shard)
-
-        def window_lengths(start: int):
-            # Windows shrink to land exactly on eval/checkpoint/train_steps
-            # boundaries, so boundary consumers still see the state at the
-            # exact step they expect.  Scan length is shape-static (each
-            # distinct length is one compile); the schedule keeps distinct
-            # lengths to O(1): the window itself plus boundary remainders.
-            s = start
-            while s < config.train_steps:
-                stop = s + eff_window
-                for every in (
-                    config.eval_every if eval_step is not None else 0,
-                    checkpoint_every if mngr is not None else 0,
-                ):
-                    if every:
-                        stop = min(stop, ((s // every) + 1) * every)
-                stop = min(stop, config.train_steps)
-                yield stop - s
-                s = stop
-
-        saver = _AsyncCheckpointSaver(mngr) if mngr is not None else None
-        infeed = windowed_infeed(
-            itertools.chain([first_batch], train_it),
-            window_lengths(step),
-            stage_window,
-        )
-        while step < config.train_steps:
-            t_in = time.perf_counter()
-            tracker.data_loading_start()
-            try:
-                item = next(infeed, None)
-            finally:
-                tracker.data_loading_end()
-            if item is None:
-                log.info("train iterator exhausted at step %d", step)
-                break
-            if t_start is not None:
-                input_wait_s += time.perf_counter() - t_in
-            w, dev_window = item
-            tracker.step_start(step)
-            state, mstack = train_window(state, dev_window)
-            step += w
-            # ONE device-to-host fetch per window: the stacked metrics are
-            # a data dependency of every step in the window, so the
-            # transfer proves the whole window executed before the clock
-            # is read — the same cannot-lie anchoring as the per-step
-            # path, at window granularity.  Per HOST, not per device: the
-            # scan's metric outputs land replicated (the loss mean/psum
-            # makes them so), so device_get reads one locally-addressable
-            # copy — no cross-device gather, and each process in a
-            # multi-host run fetches only from its own devices.
-            host_stack = jax.device_get(mstack)
-            now = time.perf_counter()
-            if t_start is None:
-                t_start = now  # the first window absorbs compile
-            else:
-                examples_after_t0 += w * config.batch_size
-            anchors.append((step, now))
-            # Per-step values reconstructed from the windowed accumulator:
-            # the watchdog sees every step's loss (a mid-window NaN fires
-            # at the boundary) and log_every keeps its exact cadence.
-            for i in range(w):
-                s_i = step - w + 1 + i
-                monitor.heartbeat(s_i, loss=float(host_stack["loss"][i]))
-                if config.log_every and s_i % config.log_every == 0:
-                    host_metrics = {
-                        k: float(v[i]) for k, v in host_stack.items()
-                    }
-                    metrics_hist.append((s_i, host_metrics))
-                    if metrics_cb:
-                        metrics_cb(s_i, host_metrics)
-                    tb_write("train", s_i, host_metrics)
-                    log.info("step %d: %s", s_i, host_metrics)
-            metrics = {k: v[-1] for k, v in host_stack.items()}
-            _publish_window(
-                step, step - window_anchor[0], now - window_anchor[1],
-                float(host_stack["loss"][-1]),
+            win_shard = {
+                k: NamedSharding(mesh, P(None, *s.spec))
+                for k, s in batch_shard.items()
+            }
+            train_window = jax.jit(
+                lambda st, bats: jax.lax.scan(step_fn, st, bats),
+                in_shardings=(state_shard, win_shard),
+                out_shardings=(state_shard, None),
+                donate_argnums=(0,) if config.donate_state else (),
             )
-            window_anchor = (step, now)
-            if checkpoint_dir:
-                # The window just proved itself executed (the metric fetch
-                # above is a data dependency of every step in it): advance
-                # the progress marker so a crash before the NEXT durable
-                # checkpoint shows up as a replayed span on resume.
-                _write_progress(checkpoint_dir, step)
-            if (
-                saver is not None and checkpoint_every
-                and step % checkpoint_every == 0
-            ):
-                saver.save(step, state)
-            if (
-                eval_step is not None
-                and config.eval_every
-                and step % config.eval_every == 0
-            ):
-                emit_eval(step)
-        if saver is not None:
-            # Completion fence at loop exit: the in-flight save must be
-            # durable before the final synchronous save/export below.
-            saver.fence()
-    else:
-        while step < config.train_steps:
-            if config.profile_dir and not profiling and step - start_step == config.profile_from:
-                jax.profiler.start_trace(config.profile_dir)
-                profiling = True
-            tracker.step_start(step)
-            t_in = time.perf_counter()
-            device_batch = put_batch(batch)
-            if t_start is not None:  # only measure the post-compile window
-                input_wait_s += time.perf_counter() - t_in
-            state, metrics = train_step(state, device_batch)
-            step += 1
-            monitor.heartbeat(step)  # liveness only; loss rides log cadence
-            if profiling and step - start_step >= config.profile_to:
-                # Device-to-host read (not block_until_ready — see t_start
-                # note) so the trace captures the step's full execution.
-                np.asarray(metrics["loss"])
-                jax.profiler.stop_trace()
-                profiling = False
-            if t_start is None:
-                # Start timing after step 1 retires (excludes compile time).  A
-                # device-to-host READ, not block_until_ready: on some platforms
-                # (e.g. tunneled experimental backends) block_until_ready returns
-                # before execution finishes, which would start the clock early —
-                # a transfer of the step's output cannot lie.
-                np.asarray(metrics["loss"])
-                t_start = time.perf_counter()
-                anchors.append((step, t_start))
-            else:
-                examples_after_t0 += config.batch_size
-                if (
-                    config.anchor_every
-                    and (step - anchors[0][0]) % config.anchor_every == 0
-                ):
-                    # Device-to-host read of THIS step's output: the step chain
-                    # is a data dependency, so the transfer proves every step up
-                    # to here executed on device before the clock is read.
-                    np.asarray(metrics["loss"])
-                    anchors.append((step, time.perf_counter()))
-            if config.log_every and step % config.log_every == 0:
-                host_metrics = {
-                    k: float(v) for k, v in metrics.items()
-                }
-                metrics_hist.append((step, host_metrics))
-                if metrics_cb:
-                    metrics_cb(step, host_metrics)
-                tb_write("train", step, host_metrics)
-                log.info("step %d: %s", step, host_metrics)
-                # Telemetry window: the host loss just materialized above, so
-                # the NaN/spike checks are free here; gauges cover the span
-                # since the previous log point.
-                now = time.perf_counter()
-                _publish_window(
-                    step, step - window_anchor[0], now - window_anchor[1],
-                    host_metrics.get("loss"),
-                )
-                window_anchor = (step, now)
-            if (
-                mngr is not None and checkpoint_every
-                and step % checkpoint_every == 0
-            ):
-                # Gated on the cadence here, not just inside orbax: building
-                # save args and consulting the manager every step is pure
-                # per-step host overhead on the hot path.
-                mngr.save(step, args=_ocp_save_args(state))
-                _write_progress(checkpoint_dir, step)
-            if (
-                eval_step is not None
-                and config.eval_every
-                and step % config.eval_every == 0
-            ):
-                emit_eval(step)
-            if step >= config.train_steps:
-                break
-            try:
+
+            def stage_window(stacked):
+                return stage_global(stacked, win_shard)
+
+            def window_lengths(start: int):
+                # Windows shrink to land exactly on eval/checkpoint/train_steps
+                # boundaries, so boundary consumers still see the state at the
+                # exact step they expect.  Scan length is shape-static (each
+                # distinct length is one compile); the schedule keeps distinct
+                # lengths to O(1): the window itself plus boundary remainders.
+                s = start
+                while s < config.train_steps:
+                    stop = s + eff_window
+                    for every in (
+                        config.eval_every if eval_step is not None else 0,
+                        checkpoint_every if mngr is not None else 0,
+                    ):
+                        if every:
+                            stop = min(stop, ((s // every) + 1) * every)
+                    stop = min(stop, config.train_steps)
+                    yield stop - s
+                    s = stop
+
+            saver = _AsyncCheckpointSaver(mngr) if mngr is not None else None
+            seen_window_lens: set = set()
+            infeed = windowed_infeed(
+                itertools.chain([first_batch], train_it),
+                window_lengths(step),
+                stage_window,
+            )
+            while step < config.train_steps:
                 t_in = time.perf_counter()
                 tracker.data_loading_start()
                 try:
-                    batch = next(train_it)
+                    item = next(infeed, None)
                 finally:
-                    # On StopIteration too — an open-ended data-loading interval
-                    # would misattribute everything through job_end as badput.
                     tracker.data_loading_end()
+                if item is None:
+                    log.info("train iterator exhausted at step %d", step)
+                    break
+                t_fetched = time.perf_counter()
+                infeed_s = t_fetched - t_in
                 if t_start is not None:
+                    input_wait_s += infeed_s
+                w, dev_window = item
+                tracker.step_start(step)
+                # Scan programs are keyed by window length; the first
+                # dispatch of a NEW length (cadence-split short window)
+                # compiles once as that program's warmup.
+                compile_stats["first_of_len"] = w not in seen_window_lens
+                seen_window_lens.add(w)
+                try:
+                    state, mstack = train_window(state, dev_window)
+                finally:
+                    compile_stats["first_of_len"] = False
+                step += w
+                # ONE device-to-host fetch per window: the stacked metrics are
+                # a data dependency of every step in the window, so the
+                # transfer proves the whole window executed before the clock
+                # is read — the same cannot-lie anchoring as the per-step
+                # path, at window granularity.  Per HOST, not per device: the
+                # scan's metric outputs land replicated (the loss mean/psum
+                # makes them so), so device_get reads one locally-addressable
+                # copy — no cross-device gather, and each process in a
+                # multi-host run fetches only from its own devices.
+                host_stack = jax.device_get(mstack)
+                now = time.perf_counter()
+                if t_start is None:
+                    t_start = now  # the first window absorbs compile
+                    # From here on, every backend compile is a mid-run stall
+                    # (a shrunk boundary window, a shape change) — counted by
+                    # the listener as train_compiles_after_warm_total.
+                    compile_stats["warm"] = True
+                else:
+                    examples_after_t0 += w * config.batch_size
+                    # Measured window partition: infeed wait + device span
+                    # are clocked, host is the remainder (the previous
+                    # window's post-fetch host work: per-step reconstruction,
+                    # publishing, checkpoint markers) — so the four phases
+                    # sum EXACTLY to this window's wall-clock.  The estimated
+                    # collective fraction only splits the device span.
+                    device_s = now - t_fetched
+                    host_s = max(
+                        0.0, (now - window_anchor[1]) - infeed_s - device_s
+                    )
+                    phases = {
+                        "infeed_wait": infeed_s,
+                        "device_compute": device_s * (1.0 - coll_frac),
+                        "device_collective": device_s * coll_frac,
+                        "host": host_s,
+                    }
+                    for ph, secs in phases.items():
+                        phase_totals[ph] += secs
+                        c_phase.labels(ph).inc(secs)
+                    _obs.instant(
+                        "window_breakdown", cat="trainer",
+                        args={
+                            "step": step, "window_steps": w,
+                            "window_s": now - window_anchor[1], **phases,
+                        },
+                    )
+                anchors.append((step, now))
+                # Per-step values reconstructed from the windowed accumulator:
+                # the watchdog sees every step's loss (a mid-window NaN fires
+                # at the boundary) and log_every keeps its exact cadence.
+                for i in range(w):
+                    s_i = step - w + 1 + i
+                    monitor.heartbeat(s_i, loss=float(host_stack["loss"][i]))
+                    if config.log_every and s_i % config.log_every == 0:
+                        host_metrics = {
+                            k: float(v[i]) for k, v in host_stack.items()
+                        }
+                        metrics_hist.append((s_i, host_metrics))
+                        if metrics_cb:
+                            metrics_cb(s_i, host_metrics)
+                        tb_write("train", s_i, host_metrics)
+                        log.info("step %d: %s", s_i, host_metrics)
+                metrics = {k: v[-1] for k, v in host_stack.items()}
+                _publish_window(
+                    step, step - window_anchor[0], now - window_anchor[1],
+                    float(host_stack["loss"][-1]),
+                )
+                window_anchor = (step, now)
+                if checkpoint_dir:
+                    # The window just proved itself executed (the metric fetch
+                    # above is a data dependency of every step in it): advance
+                    # the progress marker so a crash before the NEXT durable
+                    # checkpoint shows up as a replayed span on resume.
+                    _write_progress(checkpoint_dir, step)
+                if (
+                    saver is not None and checkpoint_every
+                    and step % checkpoint_every == 0
+                ):
+                    saver.save(step, state)
+                if (
+                    eval_step is not None
+                    and config.eval_every
+                    and step % config.eval_every == 0
+                ):
+                    emit_eval(step)
+            if saver is not None:
+                # Completion fence at loop exit: the in-flight save must be
+                # durable before the final synchronous save/export below.
+                saver.fence()
+        else:
+            while step < config.train_steps:
+                if config.profile_dir and not profiling and step - start_step == config.profile_from:
+                    jax.profiler.start_trace(config.profile_dir)
+                    profiling = True
+                tracker.step_start(step)
+                t_in = time.perf_counter()
+                device_batch = put_batch(batch)
+                if t_start is not None:  # only measure the post-compile window
                     input_wait_s += time.perf_counter() - t_in
-            except StopIteration:
-                log.info("train iterator exhausted at step %d", step)
-                break
+                state, metrics = train_step(state, device_batch)
+                step += 1
+                monitor.heartbeat(step)  # liveness only; loss rides log cadence
+                if profiling and step - start_step >= config.profile_to:
+                    # Device-to-host read (not block_until_ready — see t_start
+                    # note) so the trace captures the step's full execution.
+                    np.asarray(metrics["loss"])
+                    jax.profiler.stop_trace()
+                    profiling = False
+                if t_start is None:
+                    # Start timing after step 1 retires (excludes compile time).  A
+                    # device-to-host READ, not block_until_ready: on some platforms
+                    # (e.g. tunneled experimental backends) block_until_ready returns
+                    # before execution finishes, which would start the clock early —
+                    # a transfer of the step's output cannot lie.
+                    np.asarray(metrics["loss"])
+                    t_start = time.perf_counter()
+                    compile_stats["warm"] = True  # later compiles are stalls
+                    anchors.append((step, t_start))
+                else:
+                    examples_after_t0 += config.batch_size
+                    if (
+                        config.anchor_every
+                        and (step - anchors[0][0]) % config.anchor_every == 0
+                    ):
+                        # Device-to-host read of THIS step's output: the step chain
+                        # is a data dependency, so the transfer proves every step up
+                        # to here executed on device before the clock is read.
+                        np.asarray(metrics["loss"])
+                        anchors.append((step, time.perf_counter()))
+                if config.log_every and step % config.log_every == 0:
+                    host_metrics = {
+                        k: float(v) for k, v in metrics.items()
+                    }
+                    metrics_hist.append((step, host_metrics))
+                    if metrics_cb:
+                        metrics_cb(step, host_metrics)
+                    tb_write("train", step, host_metrics)
+                    log.info("step %d: %s", step, host_metrics)
+                    # Telemetry window: the host loss just materialized above, so
+                    # the NaN/spike checks are free here; gauges cover the span
+                    # since the previous log point.
+                    now = time.perf_counter()
+                    _publish_window(
+                        step, step - window_anchor[0], now - window_anchor[1],
+                        host_metrics.get("loss"),
+                    )
+                    window_anchor = (step, now)
+                if (
+                    mngr is not None and checkpoint_every
+                    and step % checkpoint_every == 0
+                ):
+                    # Gated on the cadence here, not just inside orbax: building
+                    # save args and consulting the manager every step is pure
+                    # per-step host overhead on the hot path.
+                    mngr.save(step, args=_ocp_save_args(state))
+                    _write_progress(checkpoint_dir, step)
+                if (
+                    eval_step is not None
+                    and config.eval_every
+                    and step % config.eval_every == 0
+                ):
+                    emit_eval(step)
+                if step >= config.train_steps:
+                    break
+                try:
+                    t_in = time.perf_counter()
+                    tracker.data_loading_start()
+                    try:
+                        batch = next(train_it)
+                    finally:
+                        # On StopIteration too — an open-ended data-loading interval
+                        # would misattribute everything through job_end as badput.
+                        tracker.data_loading_end()
+                    if t_start is not None:
+                        input_wait_s += time.perf_counter() - t_in
+                except StopIteration:
+                    log.info("train iterator exhausted at step %d", step)
+                    break
+
+    finally:
+        _set_compile_hook(None)
 
     if profiling:
         jax.profiler.stop_trace()
@@ -1268,8 +1591,12 @@ def train_loop(
         {k: float(v) for k, v in metrics.items()} if metrics is not None else {}
     )
     if eval_step is not None:
-        ev = _run_eval(eval_step, state, eval_iter_fn, config, put_batch,
-                       has_model_state)
+        # Post-loop final eval: any compile here (first build when no
+        # in-loop eval cadence fired) happens after the last step — by
+        # definition not a step stall.
+        with _compile_admin_region():
+            ev = _run_eval(eval_step, state, eval_iter_fn, config,
+                           put_batch, has_model_state)
         final_metrics.update({f"eval_{k}": v for k, v in ev.items()})
 
     if tb_writer is not None:
@@ -1326,6 +1653,35 @@ def train_loop(
         except Exception as e:  # noqa: BLE001 — diagnostics must not fail a run
             log.warning("train-step cost analysis failed: %s", e)
 
+    # MFU over the ATTRIBUTED device-compute seconds when the windowed
+    # loop measured them (post-warmup windows only), else post-compile
+    # wall-clock (the per-step path cannot separate device from host
+    # without a per-step sync — that figure is a lower bound).
+    mfu = None
+    peak = _peak_flops_per_chip(config)
+    steps_measured = (
+        examples_after_t0 / config.batch_size if config.batch_size else 0
+    )
+    if cost_flops and peak and steps_measured > 0:
+        device_s = phase_totals["device_compute"] or elapsed
+        if device_s > 0:
+            mfu = cost_flops * steps_measured / device_s / (
+                peak * n_devices
+            )
+            g_mfu.set(round(mfu, 4))
+            # The gauge changed after the loop's last window publish:
+            # push one more snapshot so the scrape/ring carry it.
+            if fed_source is not None:
+                try:
+                    _fed.publish_registry(reg, source=fed_source)
+                except OSError as e:
+                    log.warning("federation publish failed: %s", e)
+            if history is not None:
+                try:
+                    history.append(reg, hist_run_id, step=step)
+                except OSError as e:
+                    log.warning("metrics-history append failed: %s", e)
+
     tracker.job_end()
     gsum = tracker.summary()
     # The proxy stays the reported floor when the library is absent; when
@@ -1357,6 +1713,22 @@ def train_loop(
             "replayed_steps": replayed_steps,
         },
     )
+    # Stamp the window-phase breakdown into the RunTrace alongside the
+    # per-window instants, so `trace`/`trace diff` compare runs on where
+    # their windows went, not just how long they took.
+    _obs.instant(
+        "train_telemetry_summary", cat="trainer",
+        args={
+            "window_phase_seconds": {
+                k: round(v, 6) for k, v in phase_totals.items()
+            },
+            "compiles_after_warm": compile_stats["after_warm"],
+            "compile_seconds": round(compile_stats["seconds"], 6),
+            "collective_fraction_est": round(coll_frac, 6),
+            "mfu": mfu,
+            "window_steps": eff_window,
+        },
+    )
     result = TrainResult(
         final_metrics=final_metrics,
         examples_per_sec=round(eps, 2),
@@ -1376,6 +1748,11 @@ def train_loop(
         window_steps=eff_window,
         replayed_steps=replayed_steps,
         dp_collective=dp_mode,
+        mfu=round(mfu, 4) if mfu is not None else None,
+        compiles_after_warm=compile_stats["after_warm"],
+        window_phase_seconds={
+            k: round(v, 6) for k, v in phase_totals.items()
+        },
     )
     final = (
         (state.params, state.model_state) if has_model_state
@@ -1477,10 +1854,11 @@ class _AsyncCheckpointSaver:
 
     def save(self, step: int, state: "TrainState") -> None:
         self.fence()
-        snap = jax.tree_util.tree_map(
-            lambda x: jnp.array(x) if isinstance(x, jax.Array) else x,
-            _saveable(state),
-        )
+        with _compile_admin_region():
+            snap = jax.tree_util.tree_map(
+                lambda x: jnp.array(x) if isinstance(x, jax.Array) else x,
+                _saveable(state),
+            )
 
         def run() -> None:
             import orbax.checkpoint as ocp
