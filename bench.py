@@ -4435,6 +4435,349 @@ def bench_continuous(smoke: bool) -> dict:
         shutil.rmtree(td, ignore_errors=True)
 
 
+def bench_monitoring(smoke: bool) -> dict:
+    """The ``monitoring.drift_drill`` leg (ISSUE 20): the live drift &
+    skew plane exercised end to end against a RUNNING controller.
+
+    Evidence recorded:
+      - a monitored fleet (``monitor_sample_rate=1.0``) under control
+        traffic drawn from the training distribution stays quiet —
+        ``drift_false_alarms`` must read 0 across >= 3 scored windows;
+      - covariate-shifted traffic (loc 0 -> 5) breaches the payload-
+        stamped training baseline within ``drift_detect_windows`` <= 3
+        tumbling windows, read from the fleet's own /metrics scrape;
+      - the controller's scrape poll consumes the breach and answers
+        with EXACTLY ONE out-of-cadence window retrain
+        (``continuous_drift_triggered_runs_total == 1``), evidence
+        recorded as a drift_evidence context in the metadata store;
+      - ``drift_sampler_overhead_pct``: matched sequential predict
+        latency, monitored fleet vs an unmonitored fleet on the same
+        payloads — the sampler must stay off the critical path.
+    """
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    import pyarrow as pa
+
+    from tpu_pipelines.components import (
+        CsvExampleGen,
+        Pusher,
+        RollingWindowResolver,
+        StatisticsGen,
+    )
+    from tpu_pipelines.continuous import (
+        ContinuousConfig,
+        ContinuousController,
+        SpanWindow,
+        WindowStatisticsMerger,
+    )
+    from tpu_pipelines.data.statistics import (
+        compute_split_statistics,
+        save_statistics,
+    )
+    from tpu_pipelines.dsl.component import component
+    from tpu_pipelines.dsl.pipeline import Pipeline
+    from tpu_pipelines.observability.drift import parse_drift_scrape
+    from tpu_pipelines.observability.metrics import MetricsRegistry
+    from tpu_pipelines.serving import ModelServer
+    from tpu_pipelines.trainer.export import export_model
+
+    td = tempfile.mkdtemp(prefix="tpp-monitoring-")
+    rng = np.random.default_rng(20)
+    span_rows = 60 if smoke else 400
+    baseline_rows = 2000 if smoke else 8000
+    window_s = 0.8 if smoke else 1.5
+    lat_n = 80 if smoke else 300
+    server = None
+    server_plain = None
+    stop = threading.Event()
+    thread = None
+    try:
+        data = os.path.join(td, "data")
+        pattern = os.path.join(data, "span-{SPAN}", "v-{VERSION}")
+        md = os.path.join(td, "md.sqlite")
+        dest = os.path.join(td, "serving")
+
+        # The training baseline the live plane scores against: real
+        # accumulator statistics over the feature the fleet will see,
+        # stamped onto every exported payload below.
+        stats_uri = os.path.join(td, "baseline-stats")
+        base_stats = compute_split_statistics(
+            "train", pa.table({"x": rng.normal(size=baseline_rows)})
+        )
+        save_statistics(stats_uri, {"train": base_stats})
+
+        def write_span(span, rows):
+            d = os.path.join(data, f"span-{span}", "v-1")
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "data.csv"), "w") as f:
+                f.write("x,y\n")
+                for i in range(rows):
+                    f.write(f"{i + 1000 * span},{(i * 3 + span) % 7}\n")
+
+        module = os.path.join(td, "toy_module.py")
+        with open(module, "w") as f:
+            f.write(
+                "import jax.numpy as jnp\n"
+                "def build_model(hp):\n"
+                "    return None\n"
+                "def apply_fn(model, params, batch):\n"
+                "    return jnp.asarray(batch['x'], jnp.float32) "
+                "* params['w']\n"
+            )
+
+        @component(inputs={"examples": "Examples"},
+                   outputs={"model": "Model"}, name="ToyTrainer")
+        def ToyTrainer(ctx):
+            n = sum(ctx.input("examples").properties.get(
+                "split_counts", {}).values())
+            export_model(
+                serving_model_dir=ctx.output("model").uri,
+                params={"w": np.array([float(n)], np.float32)},
+                module_file=module,
+                training_statistics_uri=stats_uri,
+            )
+            return {"rows_trained": n}
+
+        @component(inputs={"model": "Model",
+                           "statistics": "ExampleStatistics"},
+                   outputs={"blessing": "ModelBlessing"}, is_sink=True,
+                   name="ToyBless")
+        def ToyBless(ctx):
+            with open(os.path.join(
+                    ctx.output("blessing").uri, "BLESSED"), "w") as f:
+                f.write("{}")
+            ctx.output("blessing").properties["blessed"] = True
+            return {"blessed": True}
+
+        export_model(
+            serving_model_dir=os.path.join(dest, "1"),
+            params={"w": np.array([1.0], np.float32)},
+            module_file=module,
+            training_statistics_uri=stats_uri,
+        )
+        server = ModelServer(
+            "taxi", dest, replicas=2, max_versions=2,
+            monitor_sample_rate=1.0, monitor_window_s=window_s,
+        )
+        port = server.start()
+        serving_url = f"http://127.0.0.1:{port}/v1/models/taxi"
+        predict_url = serving_url + ":predict"
+        metrics_url = f"http://127.0.0.1:{port}/metrics"
+
+        def make_span_pipeline(span, version):
+            gen = CsvExampleGen(
+                input_path=pattern, span=span, num_shards=2
+            )
+            stats = StatisticsGen(
+                examples=gen.outputs["examples"], save_accumulators=True
+            )
+            return Pipeline(
+                "drift-ingest", [gen, stats],
+                pipeline_root=os.path.join(td, "ingest-root"),
+                metadata_path=md, node_timeout_s=600,
+            )
+
+        def make_window_pipeline():
+            win = RollingWindowResolver(
+                window_spans=1, source_pipeline="drift-ingest",
+                examples_producer="CsvExampleGen",
+                statistics_producer="StatisticsGen",
+            )
+            spanwin = SpanWindow(examples=win.outputs["examples"])
+            merged = WindowStatisticsMerger(
+                statistics=win.outputs["statistics"]
+            )
+            trainer = ToyTrainer(examples=spanwin.outputs["window"])
+            bless = ToyBless(
+                model=trainer.outputs["model"],
+                statistics=merged.outputs["statistics"],
+            )
+            pusher = Pusher(
+                model=trainer.outputs["model"],
+                blessing=bless.outputs["blessing"],
+                push_destination=dest,
+                serving_push_url=serving_url,
+            ).with_lint_suppressions("TPP109")
+            return Pipeline(
+                "drift-window",
+                [win, spanwin, merged, trainer, bless, pusher],
+                pipeline_root=os.path.join(td, "window-root"),
+                metadata_path=md, node_timeout_s=600,
+            )
+
+        registry = MetricsRegistry()
+        controller = ContinuousController(ContinuousConfig(
+            input_pattern=pattern,
+            make_span_pipeline=make_span_pipeline,
+            make_window_pipeline=make_window_pipeline,
+            poll_interval_s=0.1,
+            serving_url=serving_url,
+            probation_watch_s=0.0,
+            state_dir=os.path.join(td, "state"),
+            registry=registry,
+        ))
+
+        write_span(1, span_rows)
+        thread = threading.Thread(
+            target=controller.run, kwargs={"stop_event": stop},
+        )
+        thread.start()
+
+        def wait_for(predicate, timeout_s=120.0):
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                if predicate():
+                    return True
+                time.sleep(0.05)
+            return False
+
+        deploys = registry.get("continuous_deploys_total")
+        boot_ok = wait_for(lambda: deploys.get() >= 1, timeout_s=180.0)
+
+        def predict(x_rows):
+            body = json.dumps({"instances": [
+                {"x": float(v)} for v in x_rows
+            ]}).encode()
+            req = urllib.request.Request(
+                predict_url, data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(req, timeout=30) as r:
+                r.read()
+            return time.perf_counter() - t0
+
+        def scrape():
+            with urllib.request.urlopen(metrics_url, timeout=5) as r:
+                return parse_drift_scrape(
+                    r.read().decode("utf-8", "replace")
+                )
+
+        # Phase A — control traffic drawn from the training distribution
+        # for >= 3 scored windows: the plane must stay quiet.
+        t_end = time.monotonic() + 3.5 * window_s
+        control_requests = 0
+        while time.monotonic() < t_end:
+            predict(rng.normal(size=32))
+            control_requests += 1
+            time.sleep(0.01)
+        time.sleep(1.5 * window_s)  # let the last control window close
+        rep = scrape()
+        false_alarms = rep.get("alerts_total", 0.0)
+        control_windows = rep.get("windows_total", 0.0)
+        w0 = control_windows
+
+        # Phase B — covariate shift (loc 0 -> 5): the skew comparator
+        # against the payload-stamped baseline must fire within 3
+        # windows of the shift landing.
+        detect_windows = None
+        t_shift_end = time.monotonic() + 8 * window_s
+        while time.monotonic() < t_shift_end:
+            for _ in range(4):
+                predict(rng.normal(loc=5.0, size=32))
+            r2 = scrape()
+            if r2.get("alerts_total", 0.0) > false_alarms:
+                detect_windows = max(
+                    1.0, r2.get("windows_total", 0.0) - w0
+                )
+                break
+            time.sleep(0.05)
+
+        # Loop closure: the controller's scrape poll consumes the alert
+        # delta and runs ONE out-of-cadence retrain.  Stop the loop the
+        # moment the counter lands so residual shifted windows (the tail
+        # of the burst draining through the sampler) cannot double-fire.
+        drift_runs = registry.get("continuous_drift_triggered_runs_total")
+        retrain_ok = wait_for(lambda: drift_runs.get() >= 1)
+        stop.set()
+        thread.join(timeout=120)
+
+        evidence = 0
+        from tpu_pipelines.metadata import open_store
+
+        store = open_store(md)
+        try:
+            evidence = len(store.get_contexts(type_name="drift_evidence"))
+        finally:
+            store.close()
+
+        # Phase C — sampler overhead: matched sequential predict latency
+        # against an unmonitored fleet over the same payload directory.
+        server_plain = ModelServer("taxi", dest, replicas=2,
+                                   max_versions=2)
+        port2 = server_plain.start()
+        plain_url = f"http://127.0.0.1:{port2}/v1/models/taxi:predict"
+
+        def hammer(url, n):
+            body = json.dumps({"instances": [
+                {"x": float(v)} for v in rng.normal(size=32)
+            ]}).encode()
+            lats = []
+            for _ in range(n):
+                req = urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                t0 = time.perf_counter()
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    r.read()
+                lats.append(time.perf_counter() - t0)
+            return lats
+
+        hammer(plain_url, 10)  # warm-up (XLA compile, canary capture)
+        plain = sorted(hammer(plain_url, lat_n))
+        hammer(predict_url, 10)
+        mon = sorted(hammer(predict_url, lat_n))
+        p50_plain = plain[len(plain) // 2]
+        p50_mon = mon[len(mon) // 2]
+        overhead_pct = (
+            (p50_mon / p50_plain - 1.0) * 100.0 if p50_plain > 0 else None
+        )
+
+        runs = drift_runs.get()
+        green = bool(
+            boot_ok
+            and false_alarms == 0
+            and control_windows >= 3
+            and detect_windows is not None and detect_windows <= 3
+            and retrain_ok and runs == 1
+            and evidence >= 1
+        )
+        return {"drift_drill": {
+            "green": green,
+            "bootstrap_deploy_ok": boot_ok,
+            "control_requests": control_requests,
+            "control_windows": control_windows,
+            "false_alarms": false_alarms,
+            "detect_windows": detect_windows,
+            "drift_triggered_runs": runs,
+            "drift_evidence_contexts": evidence,
+            "deploys": deploys.get(),
+            "serving_version": server.version,
+            "sampler_overhead_pct": (
+                round(overhead_pct, 2) if overhead_pct is not None
+                else None
+            ),
+            "p50_monitored_ms": round(p50_mon * 1000, 3),
+            "p50_plain_ms": round(p50_plain * 1000, 3),
+            "window_s": window_s,
+            "sampled_total": rep.get("sampled_total"),
+            "dropped_total": rep.get("dropped_total"),
+        }}
+    finally:
+        stop.set()
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=30)
+        if server is not None:
+            server.stop()
+        if server_plain is not None:
+            server_plain.stop()
+        shutil.rmtree(td, ignore_errors=True)
+
+
 def bench_flash_probe(smoke: bool) -> dict:
     """Flash vs dense attention across a seq-length sweep (ISSUE 9).
 
@@ -4909,6 +5252,16 @@ def _compact(report: dict) -> dict:
     if isinstance(cont, dict) and "green" in cont:
         compact["continuous_green"] = bool(cont.get("green"))
         compact["incremental_work_saved"] = cont.get("work_saved_ratio")
+    # Live drift-plane headline (ISSUE 20): quiet under control traffic,
+    # shift caught within 3 windows, one retrain, sampler off the path.
+    mon = (report.get("monitoring") or {}).get("drift_drill")
+    if isinstance(mon, dict) and "green" in mon:
+        compact["drift_green"] = bool(mon.get("green"))
+        compact["drift_detect_windows"] = mon.get("detect_windows")
+        compact["drift_false_alarms"] = mon.get("false_alarms")
+        compact["drift_sampler_overhead_pct"] = mon.get(
+            "sampler_overhead_pct"
+        )
     td = report.get("trace_diff")
     if isinstance(td, dict):
         # Capped: the compact line must stay under the driver-tail budget
@@ -5203,6 +5556,11 @@ def main() -> None:
     # RUNNING controller — incremental stats identity, work-saved ratio,
     # and span-landing -> fleet-serving deploy latency.
     leg("continuous", bench_continuous, est_cost_s=90, retries=1)
+    # Live drift & skew plane (ISSUE 20): a monitored fleet under control
+    # then covariate-shifted traffic — zero false alarms, detection
+    # within 3 windows of the shift, and exactly one drift-triggered
+    # retrain through the RUNNING controller's scrape poll.
+    leg("monitoring", bench_monitoring, est_cost_s=90, retries=1)
     leg("mnist", bench_mnist, est_cost_s=60, retries=1)
     leg("resnet", bench_resnet, est_cost_s=150, retries=1)
     # +50 s vs r5: the seq sweep times ~4 candidate block configs per

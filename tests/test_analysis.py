@@ -971,6 +971,61 @@ def test_tpp212_unsupervised_fleet(tmp_path):
             assert "supervisor_interval_s" in f212[0].fix
 
 
+def test_tpp215_unwatched_deploy(tmp_path):
+    """TPP215: a pinned serving_push_url with no ExampleValidator drift/
+    skew thresholds and no monitor_sample_rate fires WARN; arming either
+    watch, an empty/dynamic URL, and a suppression comment stay silent."""
+    from tpu_pipelines.utils.module_loader import load_fn
+
+    mod = tmp_path / "deploys.py"
+    mod.write_text(textwrap.dedent('''
+        def bare_deploy():
+            return {"push_destination": "/srv/m",
+                    "serving_push_url": "http://s:8501/v1/models/m"}
+
+
+        def call_bare_deploy():
+            cfg = dict(serving_push_url="http://s:8501/v1/models/m")
+            return cfg
+
+
+        def deploy_with_validator_watch():
+            return {"serving_push_url": "http://s:8501/v1/models/m",
+                    "skew_linf_threshold": 0.3}
+
+
+        def deploy_with_live_monitor():
+            from tpu_pipelines.serving import ModelServer
+
+            ModelServer("m", "/m", monitor_sample_rate=0.1)
+            return {"serving_push_url": "http://s:8501/v1/models/m"}
+
+
+        def empty_url_is_silent():
+            return {"serving_push_url": ""}
+
+
+        def dynamic_url_is_silent(url):
+            return {"serving_push_url": url}
+
+
+        def suppressed_deploy():
+            return {"serving_push_url": "http://s:8501/v1/models/m"}  # tpp: disable=TPP215
+    '''))
+    for fn, n in (("bare_deploy", 1), ("call_bare_deploy", 1),
+                  ("deploy_with_validator_watch", 0),
+                  ("deploy_with_live_monitor", 0),
+                  ("empty_url_is_silent", 0),
+                  ("dynamic_url_is_silent", 0),
+                  ("suppressed_deploy", 0)):
+        findings = check_callable(load_fn(str(mod), fn), "Pusher")
+        f215 = [f for f in findings if f.rule == "TPP215"]
+        assert len(f215) == n, (fn, findings)
+        if n:
+            assert f215[0].severity == "warn"
+            assert "monitor_sample_rate" in f215[0].fix
+
+
 def test_tpp213_pinned_dp_mode_with_partition(tmp_path):
     """TPP213: param_partition/partition_rules next to a statically pinned
     non-fsdp dp_collective fires WARN; fsdp, auto, None, a dynamic mode,
@@ -1773,6 +1828,18 @@ def ShardGen(ctx):
 
 def create_pipeline():
     gen = ShardGen()
+    return _pipe([gen, Sink(examples=gen.outputs["examples"])])
+''',
+    "TPP215": '''
+@component(outputs={{"examples": "Examples"}}, name="DeployGen")
+def DeployGen(ctx):
+    cfg = {{"push_destination": "/srv/models",
+            "serving_push_url": "http://127.0.0.1:8501/v1/models/taxi"}}
+    return cfg
+
+
+def create_pipeline():
+    gen = DeployGen()
     return _pipe([gen, Sink(examples=gen.outputs["examples"])])
 ''',
 }

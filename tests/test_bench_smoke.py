@@ -27,9 +27,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # The driver tail keeps 2,000 bytes and JSON-parses the LAST line, which
 # is intact as long as it fits the tail whole; cap below that with real
-# headroom.  (1500 until ISSUE 19 — the telemetry headline keys pushed
-# the full-report line to ~1540 B, still 400+ B clear of the tail.)
-MAX_STDOUT_LINE_BYTES = 1600
+# headroom.  (1500 until ISSUE 19, 1600 until ISSUE 20 — the drift-drill
+# headline keys push the full-report line to ~1650 B, still 250+ B clear
+# of the tail.)
+MAX_STDOUT_LINE_BYTES = 1750
 
 
 def _run_bench(extra_env, timeout):
@@ -289,6 +290,31 @@ def test_bench_smoke_emits_compact_stdout_and_full_report():
     assert cont["spans_seen"] == 3
     assert compact["continuous_green"] is True
     assert compact["incremental_work_saved"] == cont["work_saved_ratio"]
+    # Live drift & skew drill (ISSUE 20): the monitored fleet stays quiet
+    # under control traffic drawn from the training distribution, catches
+    # the covariate shift within 3 tumbling windows of it landing, and
+    # the RUNNING controller's scrape poll answers with EXACTLY ONE
+    # out-of-cadence retrain, evidence recorded in the metadata store.
+    # (Sampler overhead is recorded, not gated — a shared-core smoke box
+    # cannot make a fair latency claim; the driver's bench inspects it.)
+    mon = report["monitoring"]["drift_drill"]
+    assert mon["green"] is True, mon
+    assert mon["bootstrap_deploy_ok"] is True
+    assert mon["false_alarms"] == 0
+    assert mon["control_windows"] >= 3
+    assert mon["detect_windows"] is not None
+    assert mon["detect_windows"] <= 3
+    assert mon["drift_triggered_runs"] == 1
+    assert mon["drift_evidence_contexts"] >= 1
+    assert mon["sampled_total"] > 0
+    assert mon["dropped_total"] == 0
+    assert mon["sampler_overhead_pct"] is not None
+    assert compact["drift_green"] is True
+    assert compact["drift_detect_windows"] == mon["detect_windows"]
+    assert compact["drift_false_alarms"] == 0
+    assert (
+        compact["drift_sampler_overhead_pct"] == mon["sampler_overhead_pct"]
+    )
     # t5_decode now carries the flash-decode datapoint: per-cache-length
     # dense-vs-tuned-flash timings, the recorded decode crossover, and
     # what "auto" resolves to at each measured length.
@@ -545,6 +571,7 @@ def test_bench_budget_skips_but_emits():
     assert "serving" in names
     assert "serving_fleet" in names
     assert "generative_serving" in names
+    assert "monitoring" in names
     # No taxi leg ran, so the trace-diff self-report degrades to empty
     # flags (never a crash, never a missing key).
     assert compact["regression_flags"] == []

@@ -1,0 +1,656 @@
+"""Live drift & skew plane (ISSUE 20): traffic sampling, window scoring,
+the drift SLO kind, and the controller retrain loop closure.
+
+Tier-1-safe: CPU-only, stub fleet loaders (test_serving_fleet idiom), no
+HTTP except through monkeypatched urlopen.  The batch/streaming identity
+test is the plane's correctness anchor: a window's accumulator statistics
+over the sampled rows equal ``compute_split_statistics`` over the same
+rows EXACTLY, so every live score is the batch ExampleValidator's math.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from test_serving_fleet import FakeLoaded, _fake_payload
+
+from tpu_pipelines.data.statistics import (
+    SplitStatsAccumulator,
+    compute_split_statistics,
+    save_statistics,
+)
+from tpu_pipelines.observability.drift import (
+    DEFAULT_DRIFT_THRESHOLD,
+    TrafficSampler,
+    batch_to_columns,
+    format_drift_report,
+    parse_drift_scrape,
+)
+from tpu_pipelines.observability.metrics import MetricsRegistry
+from tpu_pipelines.observability.slo import SLOMonitor
+
+pytestmark = pytest.mark.monitoring
+
+
+def _batch(rng, n, loc=0.0, cat=("a", "b")):
+    return {
+        "x": rng.normal(loc, 1.0, n),
+        "cat": np.asarray(
+            [cat[i % len(cat)] for i in range(n)], dtype=object
+        ),
+    }
+
+
+def _sampler(**kw):
+    kw.setdefault("sample_rate", 1.0)
+    kw.setdefault("window_s", 3600.0)
+    kw.setdefault("registry", MetricsRegistry())
+    return TrafficSampler("m", **kw)
+
+
+# ------------------------------------------- streaming == batch identity
+
+
+def test_sampled_window_stats_equal_batch_statistics_exactly():
+    """One offered request: the closed window's statistics are byte-for-
+    byte ``compute_split_statistics`` over the identical rows — the live
+    plane and StatisticsGen share one math."""
+    rng = np.random.default_rng(7)
+    batch = _batch(rng, 256)
+    s = _sampler()
+    assert s.offer("1", batch, rng.normal(size=256)) is True
+    s.drain()
+    wins = s.close_window()
+    assert len(wins) == 1 and wins[0].sampled == 256
+    ref = compute_split_statistics(
+        "serving", pa.table(batch_to_columns(batch))
+    )
+    assert wins[0].statistics.to_json() == ref.to_json()
+
+
+def test_chunked_offers_match_merge_contract():
+    """Multiple offers fold like the accumulator merge contract: exact
+    counts/min/max/missing/top-k (float sums may differ in the last bit
+    across association orders, so those fields are the contract)."""
+    rng = np.random.default_rng(11)
+    chunks = [_batch(rng, n) for n in (40, 90, 30)]
+    s = _sampler()
+    for c in chunks:
+        s.offer("1", c, rng.normal(size=len(c["x"])))
+    s.drain()
+    win = s.close_window()[0]
+    assert win.sampled == 160
+
+    merged = SplitStatsAccumulator("serving")
+    for c in chunks:
+        shard = SplitStatsAccumulator("serving")
+        shard.update(pa.table(batch_to_columns(c)))
+        merged.merge(shard)
+    ref = merged.finalize()
+    got = win.statistics
+    assert got.num_examples == ref.num_examples
+    for name, rf in ref.features.items():
+        gf = got.features[name]
+        assert gf.num_missing == rf.num_missing
+        if rf.numeric:
+            assert gf.numeric.min == rf.numeric.min
+            assert gf.numeric.max == rf.numeric.max
+            assert gf.numeric.num_zeros == rf.numeric.num_zeros
+        if rf.string:
+            assert gf.string.top_values == rf.string.top_values
+
+
+# ------------------------------------------------ critical-path contract
+
+
+def test_deterministic_credit_sampler_hits_exact_rate():
+    reg = MetricsRegistry()
+    s = _sampler(sample_rate=0.25, registry=reg)
+    taken = sum(
+        s.offer("1", {"x": np.ones(2)}, np.ones(2)) for _ in range(100)
+    )
+    assert taken == 25  # no RNG: exactly rate * offers, long-run and here
+    assert reg.get("serving_monitor_sampled_total").labels("m").get() == 25
+
+
+def test_wedged_queue_drops_and_never_blocks():
+    """A dead worker (queue full, nobody draining) costs a counted drop
+    per offer, never a blocked predict."""
+    reg = MetricsRegistry()
+    s = _sampler(queue_max=1, registry=reg)
+    t0 = time.monotonic()
+    results = [
+        s.offer("1", {"x": np.ones(4)}, np.ones(4)) for _ in range(400)
+    ]
+    assert time.monotonic() - t0 < 5.0
+    assert results[0] is True and not any(results[1:])
+    assert (
+        reg.get("serving_monitor_dropped_total").labels("m").get() == 399
+    )
+    assert reg.get("serving_monitor_sampled_total").labels("m").get() == 1
+
+
+# ------------------------------------------------------- window scoring
+
+
+def test_shifted_window_alerts_control_stays_quiet():
+    """Control traffic drawn from the training distribution scores clean
+    (zero false alarms); a covariate-shifted window breaches both the
+    skew comparator (vs the training baseline) and the drift comparator
+    (vs the previous window), publishing gauges + alert counters."""
+    rng = np.random.default_rng(3)
+    base_stats = compute_split_statistics(
+        "train", pa.table(batch_to_columns(_batch(rng, 4000)))
+    )
+    reg = MetricsRegistry()
+    alerts, wins = [], []
+    s = _sampler(
+        registry=reg,
+        baseline_for=lambda v: (base_stats, "mem://baseline"),
+        on_alert=alerts.append,
+        on_window=wins.append,
+    )
+    # Window 1: matched distribution -> no alert of any kind.
+    n = 2000
+    s.offer("1", _batch(rng, n), rng.normal(size=n))
+    s.drain()
+    s.close_window()
+    assert len(wins) == 1
+    assert wins[0].baseline_uri == "mem://baseline"
+    assert {sc.kind.split("_")[0] for sc in wins[0].scores} == {"skew"}
+    assert wins[0].alerts == [] and alerts == []
+    assert (
+        reg.get("serving_drift_alerts_total").labels("m", "skew").get()
+        == 0
+    )
+
+    # Window 2: shifted numerics + collapsed categorical.
+    s.offer("1", _batch(rng, n, loc=5.0, cat=("a",)), rng.normal(5.0, 1.0, n))
+    s.drain()
+    win = s.close_window()[0]
+    kinds = {sc.kind for sc in win.scores if sc.breached}
+    assert {"skew_js", "drift_js"} <= kinds          # x shifted
+    assert {"skew_linf", "drift_linf"} & kinds        # cat collapsed
+    assert win.prediction_scores["mean_shift"] > 3.0
+    assert win.prediction_scores["js"] > 0.5
+    # One edge alert per family, with the evidence payload attached.
+    assert {a["kind"].split("_")[0] for a in alerts} == {"skew", "drift"}
+    assert all(a["slo"] == "drift" for a in alerts)
+    assert alerts[0]["evidence"]["model"] == "m"
+
+    report = parse_drift_scrape(reg.to_prometheus())
+    assert report["alerts_total"] == 2
+    assert report["max_skew"] > DEFAULT_DRIFT_THRESHOLD
+    assert report["max_distance"] >= report["max_skew"]
+    assert report["coverage_ratio"] == 1.0
+    assert any(r.get("stat") == "mean_shift" for r in report["prediction"])
+    text = format_drift_report(report)
+    assert "x" in text and "prediction" in text
+
+
+def test_min_samples_guard_suppresses_thin_window_alerts():
+    """A near-empty window can score arbitrarily badly without paging:
+    scores publish, alerts gate on min_samples."""
+    rng = np.random.default_rng(5)
+    base_stats = compute_split_statistics(
+        "train", pa.table(batch_to_columns(_batch(rng, 2000)))
+    )
+    reg = MetricsRegistry()
+    alerts = []
+    s = _sampler(
+        registry=reg,
+        baseline_for=lambda v: base_stats,   # bare-stats return form
+        min_samples=20,
+        on_alert=alerts.append,
+    )
+    s.offer("1", _batch(rng, 5, loc=50.0, cat=("z",)), np.ones(5))
+    s.drain()
+    win = s.close_window()[0]
+    assert win.sampled == 5
+    assert any(sc.breached for sc in win.scores)      # scored...
+    assert alerts == []                               # ...but no page
+    assert (
+        reg.get("serving_drift_alerts_total").labels("m", "skew").get()
+        == 0
+    )
+
+
+# ----------------------------------------------------- drift SLO kind
+
+
+def test_slo_monitor_drift_kind_edge_triggered_with_min_events():
+    reg = MetricsRegistry()
+    g = reg.gauge(
+        "serving_drift_distance", "", labels=("model", "feature", "kind")
+    )
+    c = reg.counter("serving_monitor_sampled_total", "", labels=("model",))
+    breaches = []
+    mon = SLOMonitor(
+        reg, drift_threshold=0.3, min_events=20,
+        on_breach=breaches.append,
+    )
+    t0 = 1000.0
+    mon.evaluate(now=t0)                  # baseline snapshot
+    # Distance over threshold but too few sampled rows: guarded.
+    g.labels("m", "x", "drift_js").set(0.9)
+    c.labels("m").inc(5)
+    r = mon.evaluate(now=t0 + 30)
+    assert breaches == []
+    assert all(
+        "drift" not in w["burn"] for w in r["windows"].values()
+    )
+    # Enough sampled rows: every fast window burns over the line.
+    c.labels("m").inc(500)
+    mon.evaluate(now=t0 + 60)
+    assert [b["slo"] for b in breaches] == ["drift"]
+    assert breaches[0]["trigger"] == "fast"
+    assert (
+        reg.get("serving_slo_breaches_total").labels("drift").get() == 1
+    )
+    # Edge-triggered: still over, no re-fire.
+    mon.evaluate(now=t0 + 90)
+    assert len(breaches) == 1
+
+
+# ------------------------------------------------------ fleet wiring
+
+
+def _monitored_loader(stats_uri):
+    def load(version_dir):
+        loaded = FakeLoaded(1.0)
+        loaded.training_statistics_uri = stats_uri
+        return loaded
+
+    return load
+
+
+def test_fleet_sampler_attribution_baseline_and_breach_policy(tmp_path):
+    """The fleet-owned sampler: offers ride the version lease, the skew
+    baseline resolves from the payload's training_statistics_uri (no
+    metadata-store walk), health() exposes the plane, and a drift breach
+    is explicitly NOT a rollback (the controller owns the response)."""
+    from tpu_pipelines.serving.fleet import ServingFleet
+
+    rng = np.random.default_rng(13)
+    stats_uri = str(tmp_path / "stats")
+    base_stats = compute_split_statistics(
+        "train", pa.table({"x": rng.normal(size=500)})
+    )
+    save_statistics(stats_uri, {"train": base_stats})
+
+    base = tmp_path / "m"
+    d1 = _fake_payload(base, 1, 1.0)
+    d2 = _fake_payload(base, 2, 2.0)
+    reg = MetricsRegistry()
+    fleet = ServingFleet(
+        "m", str(base), replicas=1, max_versions=2,
+        loader=_monitored_loader(stats_uri),
+        monitor_sample_rate=1.0, monitor_window_s=3600.0,
+        registry=reg,
+    )
+    try:
+        assert fleet.sampler is not None
+        wins = []
+        fleet.sampler.on_window = wins.append
+        fleet.load_version(d1)
+        out = fleet.submit({"x": np.arange(8.0)}, 8)
+        assert out.shape == (8,)
+        deadline = time.monotonic() + 10
+        while (
+            reg.get("serving_monitor_sampled_total").labels("m").get() < 1
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        # Tail of v1, then a swap: the next window splits per version.
+        fleet.load_version(d2)
+        fleet.submit({"x": np.arange(4.0)}, 4)
+        assert fleet.health()["drift"]["sample_rate"] == 1.0
+        assert fleet.on_slo_breach({"slo": "drift"}) is False
+        assert fleet.active_version == "2"       # no rollback happened
+    finally:
+        fleet.close()
+    # close() flushed the final window; both serving versions scored,
+    # each against the baseline stamped on its own payload.
+    assert {w.version for w in wins} == {"1", "2"}
+    for w in wins:
+        assert w.baseline_uri == stats_uri
+        assert any(sc.kind.startswith("skew") for sc in w.scores)
+    assert threading.active_count() >= 1
+    assert not any(
+        "tpp-drift-sampler" in t.name for t in threading.enumerate()
+    )
+
+
+def test_fleet_without_monitor_has_no_sampler(tmp_path):
+    from tpu_pipelines.serving.fleet import ServingFleet
+
+    base = tmp_path / "m"
+    d1 = _fake_payload(base, 1, 1.0)
+    reg = MetricsRegistry()
+    fleet = ServingFleet(
+        "m", str(base), replicas=1, max_versions=2,
+        loader=lambda d: FakeLoaded(1.0), registry=reg,
+    )
+    try:
+        fleet.load_version(d1)
+        assert fleet.sampler is None
+        assert "drift" not in fleet.health()
+        assert "serving_monitor_sampled_total" not in reg.to_prometheus()
+    finally:
+        fleet.close()
+
+
+# ----------------------------------------- controller: loop closure
+
+
+def _write_span(data_dir, span, rows):
+    d = os.path.join(str(data_dir), f"span-{span}", "v-1")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "data.csv"), "w") as f:
+        f.write("x,y\n")
+        for i in range(rows):
+            f.write(f"{i + 100 * span},{(i * 3 + span) % 7}\n")
+    return d
+
+
+def _mini_controller(tmp_path, registry, **cfg_kw):
+    from tpu_pipelines.continuous import (
+        ContinuousConfig,
+        ContinuousController,
+    )
+    from tpu_pipelines.dsl.pipeline import Pipeline
+
+    td = str(tmp_path)
+    pattern = os.path.join(td, "data", "span-{SPAN}", "v-{VERSION}")
+    md = os.path.join(td, "md.sqlite")
+
+    def span_pipeline(span, version):
+        from tpu_pipelines.components import CsvExampleGen, StatisticsGen
+
+        gen = CsvExampleGen(input_path=pattern, span=span)
+        stats = StatisticsGen(
+            examples=gen.outputs["examples"], save_accumulators=True
+        )
+        return Pipeline(
+            "drift-ingest", [gen, stats],
+            pipeline_root=os.path.join(td, "root"),
+            metadata_path=md, node_timeout_s=120,
+        )
+
+    def window_pipeline():
+        from tpu_pipelines.components import RollingWindowResolver
+        from tpu_pipelines.continuous import (
+            SpanWindow,
+            WindowStatisticsMerger,
+        )
+
+        win = RollingWindowResolver(
+            window_spans=3, source_pipeline="drift-ingest",
+            examples_producer="CsvExampleGen",
+            statistics_producer="StatisticsGen",
+        )
+        sw = SpanWindow(
+            examples=win.outputs["examples"]
+        ).with_lint_suppressions("TPP101")
+        merged = WindowStatisticsMerger(
+            statistics=win.outputs["statistics"]
+        ).with_lint_suppressions("TPP101")
+        return Pipeline(
+            "drift-window", [win, sw, merged],
+            pipeline_root=os.path.join(td, "wroot"),
+            metadata_path=md, node_timeout_s=120,
+        )
+
+    cfg = ContinuousConfig(
+        input_pattern=pattern,
+        make_span_pipeline=span_pipeline,
+        make_window_pipeline=window_pipeline,
+        poll_interval_s=0.1,
+        state_dir=os.path.join(td, "state"),
+        registry=registry,
+        **cfg_kw,
+    )
+    return ContinuousController(cfg), md
+
+
+def test_controller_drift_breach_triggers_retrain_with_evidence(tmp_path):
+    """ISSUE 20 loop closure: a drift breach handed to notify_drift marks
+    the window dirty -> one out-of-cadence retrain, counted in
+    continuous_drift_triggered_runs_total, with the breach recorded as a
+    drift_evidence context on the triggered run.  Non-drift breaches are
+    the fleet's business and are ignored."""
+    reg = MetricsRegistry()
+    c, md = _mini_controller(tmp_path, reg)
+    _write_span(tmp_path / "data", 1, 20)
+    it1 = c.run_once()
+    assert it1["spans_processed"] == 1
+    assert "drift_triggered" not in it1
+    counter = reg.get("continuous_drift_triggered_runs_total")
+
+    # Latency breaches belong to the probation-rollback policy.
+    c.notify_drift({"slo": "latency_p99"})
+    idle = c.run_once()
+    assert "drift_triggered" not in idle and counter.get() == 0
+
+    breach = {
+        "slo": "drift", "kind": "drift_js", "feature": "x",
+        "distance": 0.8, "threshold": 0.3,
+    }
+    c.notify_drift(breach)
+    it = c.run_once()
+    assert it["spans_processed"] == 0          # no new data, still ran
+    assert it["drift_triggered"] is True
+    assert it["drift_breaches"] == 1
+    assert counter.get() == 1
+
+    from tpu_pipelines.metadata import open_store
+
+    store = open_store(md)
+    try:
+        evidence = store.get_contexts(type_name="drift_evidence")
+        assert len(evidence) == 1
+        props = evidence[0].properties
+        assert props["triggered_run"] == evidence[0].name
+        assert props["breaches"][0]["kind"] == "drift_js"
+        assert props["breaches"][0]["distance"] == 0.8
+    finally:
+        store.close()
+
+    # Consumed: the next tick is a plain idle tick.
+    again = c.run_once()
+    assert "drift_triggered" not in again and counter.get() == 1
+
+
+def test_controller_scrape_poll_baselines_then_fires(tmp_path, monkeypatch):
+    """Scrape-side intake for a fleet in another process: the first poll
+    only baselines (pre-existing alerts are not this controller's
+    retrains); an alert-counter increase synthesizes one breach."""
+    scrape = {"alerts": 0.0}
+
+    class _Resp:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+        def read(self):
+            return (
+                'serving_drift_alerts_total{kind="skew",model="m"} '
+                f"{scrape['alerts']}\n"
+                'serving_drift_distance{feature="x",kind="skew_js",'
+                'model="m"} 0.82\n'
+                'serving_monitor_sampled_total{model="m"} 400\n'
+            ).encode()
+
+    monkeypatch.setattr(
+        urllib.request, "urlopen", lambda url, timeout=5: _Resp()
+    )
+    reg = MetricsRegistry()
+    c, _ = _mini_controller(
+        tmp_path, reg, serving_url="http://127.0.0.1:9/v1/models/m"
+    )
+    scrape["alerts"] = 2.0
+    assert c._poll_drift() is None            # first scrape: baseline
+    scrape["alerts"] = 3.0
+    breach = c._poll_drift()
+    assert breach is not None
+    assert breach["slo"] == "drift" and breach["source"] == "scrape"
+    assert breach["alerts_delta"] == 1.0
+    assert breach["max_distance"] == 0.82
+    assert breach["max_skew"] == 0.82
+    assert c._poll_drift() is None            # no further increase
+
+
+def test_skew_breach_arms_strict_validation(tmp_path):
+    """A hard skew breach escalates the batch gate: every
+    ExampleValidator in the next window pipeline goes strict, with the
+    skew comparator armed at the controller threshold when the pipeline
+    left it off."""
+    from tpu_pipelines.components import (
+        CsvExampleGen,
+        ExampleValidator,
+        SchemaGen,
+        StatisticsGen,
+    )
+    from tpu_pipelines.dsl.pipeline import Pipeline
+
+    reg = MetricsRegistry()
+    c, _ = _mini_controller(tmp_path, reg, skew_strict_threshold=0.4)
+
+    assert c._breach_skew({"max_skew": 0.9}) == 0.9
+    assert c._breach_skew({"kind": "skew_linf", "distance": 0.7}) == 0.7
+    assert c._breach_skew({"kind": "drift_js", "distance": 0.7}) == 0.0
+
+    gen = CsvExampleGen(input_path=str(tmp_path / "x.csv"))
+    stats = StatisticsGen(examples=gen.outputs["examples"])
+    schema = SchemaGen(statistics=stats.outputs["statistics"])
+    validator = ExampleValidator(
+        statistics=stats.outputs["statistics"],
+        schema=schema.outputs["schema"],
+    )
+    p = Pipeline(
+        "v", [gen, stats, schema, validator],
+        pipeline_root=str(tmp_path / "vr"),
+        metadata_path=str(tmp_path / "v.sqlite"),
+    )
+    c._arm_strict_validation(p)
+    assert validator.exec_properties["fail_on_anomalies"] is True
+    assert validator.exec_properties["skew_linf_threshold"] == 0.4
+
+
+# ------------------------------------------------------------------ CLI
+
+
+_SCRAPE_TEXT = (
+    'serving_drift_alerts_total{kind="skew",model="m"} 1\n'
+    'serving_drift_distance{feature="x",kind="skew_js",model="m"} 0.61\n'
+    'serving_drift_distance{feature="cat",kind="drift_linf",model="m"}'
+    " 0.12\n"
+    'serving_prediction_drift_distance{model="m",stat="mean_shift"}'
+    " 2.5\n"
+    'serving_monitor_sampled_total{model="m"} 640\n'
+    'serving_monitor_dropped_total{model="m"} 3\n'
+    'serving_monitor_windows_total{model="m"} 4\n'
+    'serving_monitor_coverage_ratio{model="m"} 0.25\n'
+)
+
+
+def test_cli_drift_report_json_and_alert_gate(monkeypatch, capsys):
+    from tpu_pipelines.__main__ import main
+
+    class _Resp:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+        def read(self):
+            return _SCRAPE_TEXT.encode()
+
+    urls = []
+
+    def fake_urlopen(url, timeout=10):
+        urls.append(url)
+        return _Resp()
+
+    monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+    url = "http://127.0.0.1:1/v1/models/m"
+
+    assert main(["drift", "--url", url]) == 0
+    out = capsys.readouterr().out
+    assert urls[-1] == "http://127.0.0.1:1/metrics"   # derived endpoint
+    assert "x" in out and "skew_js" in out
+
+    assert main(["drift", "--url", url, "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["alerts_total"] == 1
+    assert report["max_skew"] == 0.61
+    assert report["sampled_total"] == 640
+
+    # Alert gate for CI/cron probes: nonzero alerts exit 3.
+    assert main(["drift", "--url", url, "--fail-on-alert"]) == 3
+    capsys.readouterr()
+
+    def broken(url, timeout=10):
+        raise OSError("connection refused")
+
+    monkeypatch.setattr(urllib.request, "urlopen", broken)
+    assert main(["drift", "--url", url]) == 1
+    capsys.readouterr()
+
+
+# ------------------------------------- baseline lineage on the payload
+
+
+def test_export_stamps_training_stats_and_loader_roundtrip(tmp_path):
+    """export_model records the training statistics/schema URIs on the
+    payload spec; load_exported_model surfaces them on LoadedModel — the
+    serving-side baseline needs no metadata-store walk."""
+    from tpu_pipelines.trainer.export import (
+        export_model,
+        load_exported_model,
+    )
+
+    mod = tmp_path / "toy_model.py"
+    mod.write_text(
+        "import jax.numpy as jnp\n"
+        "def build_model(hp):\n"
+        "    return None\n"
+        "def apply_fn(model, params, batch):\n"
+        "    return jnp.asarray(batch['x'], jnp.float32) * params['w']\n"
+    )
+    payload = str(tmp_path / "serving" / "1")
+    export_model(
+        serving_model_dir=payload,
+        params={"w": np.full((1,), 2.0, np.float32)},
+        module_file=str(mod),
+        training_statistics_uri="/lineage/stats/7",
+        training_schema_uri="/lineage/schema/7",
+    )
+    with open(os.path.join(payload, "model_spec.json")) as f:
+        spec = json.load(f)
+    assert spec["training_statistics_uri"] == "/lineage/stats/7"
+    assert spec["training_schema_uri"] == "/lineage/schema/7"
+    loaded = load_exported_model(payload)
+    assert loaded.training_statistics_uri == "/lineage/stats/7"
+    assert loaded.training_schema_uri == "/lineage/schema/7"
+
+    # Unstamped payloads stay unstamped (spec byte-compat contract).
+    bare = str(tmp_path / "serving" / "2")
+    export_model(
+        serving_model_dir=bare,
+        params={"w": np.full((1,), 1.0, np.float32)},
+        module_file=str(mod),
+    )
+    with open(os.path.join(bare, "model_spec.json")) as f:
+        spec = json.load(f)
+    assert "training_statistics_uri" not in spec
+    assert load_exported_model(bare).training_statistics_uri == ""

@@ -326,7 +326,7 @@ def test_disabled_mode_byte_identical_scrape_and_zero_files(
 
     for var in (
         "TPP_FEDERATION_DIR", "TPP_FED_REPLICA", "TPP_TENANT",
-        "TPP_METRICS_HISTORY",
+        "TPP_METRICS_HISTORY", "TPP_SERVING_MONITOR_SAMPLE",
     ):
         monkeypatch.delenv(var, raising=False)
 
@@ -350,6 +350,12 @@ def test_disabled_mode_byte_identical_scrape_and_zero_files(
         batch_timeout_s=0.002,
     )
     assert server._federated is None
+    # The drift plane keeps the same contract: no sample knob -> no
+    # sampler, no worker thread, none of its metric families registered.
+    assert server._fleet.sampler is None
+    assert not any(
+        "tpp-drift-sampler" in t.name for t in threading.enumerate()
+    )
     port = server.start()
     try:
         scrape = expected = None

@@ -548,6 +548,26 @@ def main(argv=None) -> int:
              "links next to the trace table",
     )
 
+    p_drift = sub.add_parser(
+        "drift",
+        help="live drift & skew report off a serving fleet's /metrics "
+             "scrape (observability/drift.py; docs/OBSERVABILITY.md "
+             "\"Live drift & skew\")",
+    )
+    p_drift.add_argument(
+        "--url", required=True,
+        help="serving base URL (the Pusher push-URL works, e.g. "
+             "http://127.0.0.1:8501/v1/models/taxi — only scheme+host "
+             "are used; /metrics is derived)",
+    )
+    p_drift.add_argument("--json", action="store_true",
+                         help="machine-readable output (one JSON object)")
+    p_drift.add_argument(
+        "--fail-on-alert", action="store_true",
+        help="exit 3 when the fleet has counted any drift/skew alert "
+             "(CI gate parity with `tpp lint`)",
+    )
+
     p_lin = isub.add_parser("lineage", parents=[md_parent],
                             help="provenance chain of an artifact")
     p_lin.add_argument("artifact_id", type=int)
@@ -565,6 +585,8 @@ def main(argv=None) -> int:
         return cmd_trace(args)
     if args.cmd == "continuous":
         return cmd_continuous(args)
+    if args.cmd == "drift":
+        return cmd_drift(args)
     if not args.metadata:
         inspect.error("the following arguments are required: --metadata")
     store = MetadataStore(args.metadata)
@@ -699,6 +721,41 @@ def cmd_continuous(args) -> int:
     status = controller.status()
     print(f"continuous: stopped after {status['iterations']} iteration(s); "
           f"spans seen: {status['spans_seen']}")
+    return 0
+
+
+def cmd_drift(args) -> int:
+    """``drift --url U [--json] [--fail-on-alert]``: scrape a live
+    fleet's /metrics and render the drift/skew report (the same parse
+    the continuous controller's scrape consumer uses)."""
+    import json as _json
+    import urllib.parse
+    import urllib.request
+
+    from tpu_pipelines.analysis import EXIT_GATED
+    from tpu_pipelines.observability.drift import (
+        format_drift_report,
+        parse_drift_scrape,
+    )
+
+    parts = urllib.parse.urlsplit(args.url)
+    url = urllib.parse.urlunsplit(
+        (parts.scheme, parts.netloc, "/metrics", "", "")
+    )
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            text = r.read().decode("utf-8", "replace")
+    except Exception as e:  # noqa: BLE001 — tool error, not a verdict
+        print(f"drift: cannot scrape {url}: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 1
+    report = parse_drift_scrape(text)
+    if args.json:
+        print(_json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(format_drift_report(report))
+    if args.fail_on_alert and report.get("alerts_total", 0) > 0:
+        return EXIT_GATED
     return 0
 
 

@@ -644,6 +644,79 @@ def _check_unsupervised_fleet(
     return out
 
 
+# Keys whose presence anywhere in the module declares a data-watch story
+# for a deployed model: ExampleValidator's batch drift/skew comparators
+# armed, or the serving tier's live monitoring plane sampling the stream
+# (observability/drift.py).  Any one of them silences TPP215.
+_DATA_WATCH_KEYS = (
+    "drift_threshold", "drift_js_threshold",
+    "skew_linf_threshold", "skew_js_threshold",
+    "skew_feature_thresholds", "monitor_sample_rate",
+)
+
+
+def _mentions_key(tree: ast.AST, names) -> bool:
+    """Like :func:`_mentions`, but for CONFIG keys: matches bare names,
+    keyword arguments, and string constants (dict-literal keys and
+    Parameter name strings all count as a mention)."""
+    wanted = set(names)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and node.id in wanted:
+            return True
+        if isinstance(node, ast.keyword) and node.arg in wanted:
+            return True
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and node.value in wanted
+        ):
+            return True
+    return False
+
+
+def _check_unwatched_deploy(
+    src: _Source, node_id: str, fn_label: str
+) -> List[Finding]:
+    """TPP215: a pipeline that deploys to a live fleet with no data
+    watch.
+
+    ``serving_push_url`` hot-reloads every blessed push into a serving
+    fleet — from that moment live traffic is scored by a model whose
+    training distribution nobody is comparing against.  Fires when one
+    call / dict literal pins ``serving_push_url`` to a non-empty string
+    constant and the module mentions neither an ExampleValidator drift/
+    skew threshold nor a ``monitor_sample_rate`` knob.  Env-configured
+    push URLs (TPP_SERVING_PUSH_URL) and watched deployments stay
+    silent."""
+    if _mentions_key(src.tree, _DATA_WATCH_KEYS):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(src.tree):
+        pairs = dict(_const_str_pairs(node))
+        url = pairs.get("serving_push_url")
+        if not (
+            isinstance(url, ast.Constant)
+            and isinstance(url.value, str)
+            and url.value.strip()
+        ):
+            continue
+        f = _finding(
+            src, url, "TPP215", WARN, node_id,
+            f"{fn_label}: serving_push_url deploys into a live fleet but "
+            "the module arms neither ExampleValidator drift/skew "
+            "thresholds nor monitor_sample_rate — a deployed model "
+            "nobody is watching can rot for a full retrain cadence "
+            "before anything notices",
+            "arm ExampleValidator (drift_threshold / "
+            "skew_linf_threshold vs the training baseline) or sample "
+            "the live stream with ModelServer(monitor_sample_rate=...) "
+            '(docs/OBSERVABILITY.md "Live drift & skew")',
+        )
+        if f:
+            out.append(f)
+    return out
+
+
 # Source-level markers that the module shards parameters: the train loop's
 # explicit spec tree or a model's (regex, PartitionSpec) rule list.  Their
 # presence arms TPP213.
@@ -768,6 +841,7 @@ def check_callable(
     out.extend(_check_flash_below_crossover(src, node_id, label))
     out.extend(_check_whole_request_decode(src, node_id, label))
     out.extend(_check_unsupervised_fleet(src, node_id, label))
+    out.extend(_check_unwatched_deploy(src, node_id, label))
     out.extend(_check_mesh_unsharded_input(src, node_id, label))
     out.extend(_check_pinned_dp_with_partition(src, node_id, label))
     return out

@@ -191,6 +191,14 @@ RULES: Dict[str, Dict[str, str]] = {
                  "catalogs are the operator contract; an undocumented "
                  "series is invisible to dashboards and alerts",
     },
+    "TPP215": {
+        "severity": WARN,
+        "title": "pipeline deploys to a live fleet (serving_push_url) "
+                 "with neither ExampleValidator drift/skew thresholds "
+                 "nor a monitor_sample_rate knob — a deployed model "
+                 "nobody is watching can rot for a full retrain cadence "
+                 "before anything notices",
+    },
 }
 
 GRAPH_RULE_PREFIX = "TPP1"

@@ -70,8 +70,14 @@ def notify_serving(push_url: str, timeout: float = 120.0) -> dict:
         "model": "Model",
         "blessing": "ModelBlessing",
         "infra_blessing": "InfraBlessing",
+        # Training-data lineage (ISSUE 20): wire the training run's
+        # statistics/schema and the Pusher stamps their URIs onto the
+        # pushed payload's model_spec.json — the serving fleet's live
+        # drift baseline, resolved with zero metadata-store walks.
+        "statistics": "ExampleStatistics",
+        "schema": "Schema",
     },
-    optional_inputs=("blessing", "infra_blessing"),
+    optional_inputs=("blessing", "infra_blessing", "statistics", "schema"),
     is_sink=True,
     outputs={"pushed_model": "PushedModel"},
     parameters={
@@ -133,6 +139,32 @@ def Pusher(ctx):
     if os.path.exists(staging):
         shutil.rmtree(staging)
     shutil.copytree(model_uri, staging)
+    # Stamp training-data lineage into the STAGING copy, before the atomic
+    # rename — a watcher never sees a half-stamped payload.  The export-time
+    # spec keys (trainer modules calling export_model(training_*_uri=...))
+    # survive when the Pusher has nothing wired.
+    stamped = {}
+    if ctx.inputs.get("statistics"):
+        stamped["training_statistics_uri"] = ctx.input("statistics").uri
+    if ctx.inputs.get("schema"):
+        stamped["training_schema_uri"] = ctx.input("schema").uri
+    if stamped:
+        from tpu_pipelines.trainer.export import SPEC_FILE
+
+        spec_path = os.path.join(staging, SPEC_FILE)
+        try:
+            with open(spec_path) as f:
+                spec = json.load(f)
+            spec.update(stamped)
+            with open(spec_path, "w") as f:
+                json.dump(spec, f, indent=2, sort_keys=True, default=str)
+            pushed_art.properties.update(stamped)
+        except (OSError, ValueError) as e:
+            # A payload without a readable spec isn't loadable by the
+            # fleet anyway; surface the miss, don't fail the push.
+            log.warning(
+                "could not stamp training lineage onto %s: %s", spec_path, e
+            )
     final = os.path.join(dest, str(version))
     os.rename(staging, final)  # atomic within a filesystem
 

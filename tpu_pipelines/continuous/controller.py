@@ -88,6 +88,19 @@ class ContinuousConfig:
     lint: Optional[str] = None
     # Metrics registry for the controller gauges (None = process default).
     registry: Any = None
+    # Live drift plane (observability/drift.py): when True, a drift
+    # breach — handed in via :meth:`ContinuousController.notify_drift`
+    # (the sampler's on_alert / SLO monitor's on_breach target) or read
+    # off the serving /metrics scrape between ticks — marks the window
+    # dirty and triggers an out-of-cadence retrain
+    # (``continuous_drift_triggered_runs_total``).
+    drift_retrain: bool = True
+    # A training/serving SKEW breach at/above this distance arms strict
+    # ExampleValidator on the next window run (fail_on_anomalies=True,
+    # and the batch skew comparator armed at this threshold when the
+    # pipeline left it off) — the live plane escalating the batch gate.
+    # 0 disables the escalation.
+    skew_strict_threshold: float = 0.0
 
 
 class ContinuousController:
@@ -116,6 +129,15 @@ class ContinuousController:
         # next retrain's telemetry is compared against (ring-durable
         # telemetry is what makes the comparison survive restarts).
         self._last_window_telemetry: Optional[Dict[str, Any]] = None
+        # Drift-breach intake (observability/drift.py): callbacks land in
+        # _drift_pending under the lock; consumed breaches move to
+        # _drift_evidence until a window run succeeds and records them —
+        # a failed retrain keeps the evidence armed for the retry tick.
+        self._drift_lock = threading.Lock()
+        self._drift_pending: List[Dict[str, Any]] = []
+        self._drift_evidence: List[Dict[str, Any]] = []
+        self._last_drift_alerts: Optional[float] = None
+        self._skew_strict = False
         self._init_metrics(cfg.registry)
 
     # ------------------------------------------------------------- metrics
@@ -161,6 +183,12 @@ class ContinuousController:
             "Controller loop iterations, by activity.",
             labels=("activity",),
         )
+        self._c_drift_runs = registry.counter(
+            "continuous_drift_triggered_runs_total",
+            "Out-of-cadence window retrains triggered by a live drift/"
+            "skew breach (observability/drift.py), evidence recorded on "
+            "the run's drift_evidence context.",
+        )
 
     # ---------------------------------------------------------------- lint
 
@@ -187,6 +215,126 @@ class ContinuousController:
         for f in findings:
             log.warning("lint: %s", f.format())
         self._linted.add(pipeline.name)
+
+    # --------------------------------------------------------------- drift
+
+    def notify_drift(self, breach: Dict[str, Any]) -> None:
+        """Drift-breach intake — the callback target for a co-located
+        ``TrafficSampler(on_alert=...)`` or ``SLOMonitor(on_breach=...)``.
+        Thread-safe; non-drift breaches (latency/error SLOs are the
+        fleet's probation-rollback business) are ignored.  Consumed on
+        the next tick: the window goes dirty and the retrain counts in
+        ``continuous_drift_triggered_runs_total``."""
+        if breach.get("slo") != "drift":
+            return
+        with self._drift_lock:
+            self._drift_pending.append(dict(breach))
+
+    def _metrics_url(self) -> str:
+        parts = urllib.parse.urlsplit(self.cfg.serving_url)
+        return urllib.parse.urlunsplit(
+            (parts.scheme, parts.netloc, "/metrics", "", "")
+        )
+
+    def _poll_drift(self) -> Optional[Dict[str, Any]]:
+        """Scrape-side breach detection for a fleet in another process:
+        an increase in ``serving_drift_alerts_total`` since the last tick
+        synthesizes one breach (the first scrape only baselines — alerts
+        predating this controller are not its retrains to run)."""
+        if not self.cfg.serving_url:
+            return None
+        from tpu_pipelines.observability.drift import parse_drift_scrape
+
+        try:
+            with urllib.request.urlopen(
+                self._metrics_url(), timeout=5
+            ) as r:
+                text = r.read().decode("utf-8", "replace")
+        except Exception as e:  # noqa: BLE001 — serving briefly unreachable
+            log.debug("drift metrics poll failed: %s", e)
+            return None
+        report = parse_drift_scrape(text)
+        alerts = float(report.get("alerts_total") or 0.0)
+        prev, self._last_drift_alerts = self._last_drift_alerts, alerts
+        if prev is None or alerts <= prev:
+            return None
+        return {
+            "slo": "drift",
+            "source": "scrape",
+            "alerts_delta": alerts - prev,
+            "max_distance": report.get("max_distance", 0.0),
+            "max_skew": report.get("max_skew", 0.0),
+        }
+
+    @staticmethod
+    def _breach_skew(breach: Dict[str, Any]) -> float:
+        """The training/serving-skew distance a breach carries (0 for a
+        pure window-over-window drift breach)."""
+        if "max_skew" in breach:
+            return float(breach.get("max_skew") or 0.0)
+        if str(breach.get("kind", "")).startswith("skew"):
+            return float(breach.get("distance") or 0.0)
+        return 0.0
+
+    def _take_drift(self) -> List[Dict[str, Any]]:
+        with self._drift_lock:
+            breaches, self._drift_pending = self._drift_pending, []
+        scraped = self._poll_drift()
+        if scraped is not None:
+            breaches.append(scraped)
+        return breaches
+
+    def _arm_strict_validation(self, pipeline: Pipeline) -> None:
+        """Skew escalation: force every ExampleValidator in the window
+        pipeline strict (fail_on_anomalies), arming the batch skew
+        comparator at the controller's threshold when the pipeline left
+        both skew knobs off — the next deploy re-earns its blessing
+        against the baseline the live plane saw it violate."""
+        for comp in pipeline.components:
+            if type(comp).__name__ != "ExampleValidator":
+                continue
+            comp.exec_properties["fail_on_anomalies"] = True
+            if not (
+                comp.exec_properties.get("skew_linf_threshold")
+                or comp.exec_properties.get("skew_js_threshold")
+            ):
+                comp.exec_properties["skew_linf_threshold"] = (
+                    self.cfg.skew_strict_threshold
+                )
+            log.warning(
+                "continuous: strict validation armed on %s (live skew "
+                "breach >= %.3f)", comp.id, self.cfg.skew_strict_threshold,
+            )
+
+    def _record_drift_evidence(
+        self, run_id: str, breaches: List[Dict[str, Any]]
+    ) -> None:
+        """Attach the live windows' snapshot scores to the triggered run
+        in the shared metadata store: a ``drift_evidence`` context named
+        after the run id, next to its pipeline_run context — the audit
+        trail answering WHY an out-of-cadence retrain happened."""
+        if self._metadata_path is None:
+            return
+        from tpu_pipelines.metadata import open_store
+        from tpu_pipelines.metadata.types import Context
+
+        try:
+            store = open_store(self._metadata_path)
+            try:
+                store.put_context(Context(
+                    type_name="drift_evidence",
+                    name=run_id,
+                    properties={
+                        "triggered_run": run_id,
+                        "breaches": breaches,
+                    },
+                ))
+            finally:
+                store.close()
+        except Exception as e:  # noqa: BLE001 — evidence is best-effort
+            log.warning(
+                "could not record drift evidence for %s: %s", run_id, e
+            )
 
     # ------------------------------------------------------------ run loop
 
@@ -236,8 +384,29 @@ class ContinuousController:
                 self._window_dirty = True
         self._g_seen.set(len(self.watcher.seen_spans()))
 
+        # Live drift plane: a breach (callback or scrape delta) marks the
+        # window dirty exactly like a fresh span would — the retrain is
+        # the same window pipeline, just out of cadence.
+        if self.cfg.drift_retrain and not stop.is_set():
+            fresh = self._take_drift()
+            if fresh:
+                self._drift_evidence.extend(fresh)
+                self._window_dirty = True
+                if self.cfg.skew_strict_threshold > 0 and any(
+                    self._breach_skew(b) >= self.cfg.skew_strict_threshold
+                    for b in fresh
+                ):
+                    self._skew_strict = True
+                log.warning(
+                    "continuous: %d drift breach(es) consumed -> "
+                    "out-of-cadence retrain armed%s",
+                    len(fresh),
+                    " (strict validation)" if self._skew_strict else "",
+                )
+
         deployed: Optional[Dict[str, Any]] = None
         window_size = 0
+        drift_recorded = 0
         telemetry: Optional[Dict[str, Any]] = None
         telemetry_flags: List[str] = []
         if (
@@ -246,9 +415,19 @@ class ContinuousController:
             and self.watcher.seen_spans()
         ):
             window_pipeline = self.cfg.make_window_pipeline()
+            if self._skew_strict:
+                self._arm_strict_validation(window_pipeline)
             result = self._run_pipeline(window_pipeline, kind="window")
             if result is not None and result.succeeded:
                 self._window_dirty = False
+                if self._drift_evidence:
+                    self._c_drift_runs.inc()
+                    self._record_drift_evidence(
+                        result.run_id, self._drift_evidence
+                    )
+                    drift_recorded = len(self._drift_evidence)
+                    self._drift_evidence = []
+                    self._skew_strict = False
                 statuses.extend(
                     nr.status for nr in result.nodes.values()
                 )
@@ -297,6 +476,9 @@ class ContinuousController:
             summary["train_telemetry"] = telemetry
             if telemetry_flags:
                 summary["train_telemetry_regressions"] = telemetry_flags
+        if drift_recorded:
+            summary["drift_triggered"] = True
+            summary["drift_breaches"] = drift_recorded
         if deployed is not None:
             self._c_deploys.inc()
             deployed["deploy_latency_s"] = summary["wall_s"]

@@ -33,6 +33,12 @@ publishes into; nothing new is instrumented):
   compiles_after_warm ``serving_decode_compiles_after_warm_total`` delta
                       (budget ZERO: any post-warm XLA compile inside a
                       window is a breach — the warm() contract broke)
+  drift               max ``serving_drift_distance`` reading inside the
+                      window vs ``drift_threshold`` (observability/
+                      drift.py's live plane; only when ``drift_threshold
+                      > 0`` AND the window sampled ``min_events`` rows —
+                      the sampler's own min-samples guard, re-applied
+                      per burn window)
   ==================  ==================================================
 
 Zero footprint when unwired: the monitor only exists when explicitly
@@ -115,6 +121,7 @@ class SLOMonitor:
         latency_target: float = 0.99,
         availability_target: float = 0.999,
         max_shed_ratio: float = 0.05,
+        drift_threshold: float = 0.0,
         windows_s: Sequence[float] = DEFAULT_WINDOWS_S,
         fast_windows_s: Sequence[float] = DEFAULT_FAST_WINDOWS_S,
         fast_threshold: float = DEFAULT_FAST_THRESHOLD,
@@ -128,6 +135,7 @@ class SLOMonitor:
         self.latency_target = float(latency_target)
         self.availability_target = float(availability_target)
         self.max_shed_ratio = float(max_shed_ratio)
+        self.drift_threshold = max(0.0, float(drift_threshold))
         self.windows_s = tuple(sorted(float(w) for w in windows_s))
         self.fast_windows_s = tuple(sorted(float(w) for w in fast_windows_s))
         self.fast_threshold = float(fast_threshold)
@@ -210,12 +218,24 @@ class SLOMonitor:
             int(v)
             for v in series("serving_decode_spec_accept_total").values()
         )
+        # Live drift plane (observability/drift.py): the burn input is
+        # the worst per-feature distance gauge, paired with the sampled
+        # counter so the min-events guard applies to SAMPLED rows.
+        drift_vals = [
+            float(v) for v in series("serving_drift_distance").values()
+        ]
+        monitor_sampled = sum(
+            int(v)
+            for v in series("serving_monitor_sampled_total").values()
+        )
         return {
             "lat_total": lat_total, "lat_bad": lat_bad,  # tpp: disable=TPP214 (dict keys)
             "req_total": req_total, "err_5xx": err_5xx,  # tpp: disable=TPP214 (dict keys)
             "shed": shed, "compiles": compiles,
             "prefix_hits": prefix_hits, "prefix_misses": prefix_misses,
             "spec_proposed": spec_proposed, "spec_accepted": spec_accepted,
+            "drift_distance": max(drift_vals) if drift_vals else 0.0,
+            "monitor_sampled": monitor_sampled,
         }
 
     # ------------------------------------------------------------ evaluate
@@ -244,6 +264,18 @@ class SLOMonitor:
         if base is None:
             base, span = cur, 0.0
         return {k: cur[k] - base.get(k, 0) for k in cur}, span
+
+    def _window_max(
+        self, now: float, window_s: float, cur: Dict[str, Any], key: str
+    ) -> float:
+        """Largest reading of a GAUGE key across the window (deltas are
+        meaningless for level signals like the drift distance — a spike
+        that decays before evaluation must still count)."""
+        worst = float(cur.get(key, 0.0))
+        for ts, snap in self._snaps:
+            if ts >= now - window_s:
+                worst = max(worst, float(snap.get(key, 0.0)))
+        return worst
 
     def evaluate(self, now: Optional[float] = None) -> Dict[str, Any]:
         """One evaluation pass: collect, compute every (window, slo)
@@ -277,6 +309,22 @@ class SLOMonitor:
                     float(delta["compiles"]) * self.fast_threshold
                     if delta["compiles"] > 0 else 0.0
                 )
+                # Drift: a level signal, scaled so distance == threshold
+                # lands exactly on the page line (the budget-zero idiom
+                # above, but proportional — a 2x-threshold excursion
+                # burns twice as hot).  Gated on sampled rows so a
+                # near-empty window can't page.
+                if (
+                    self.drift_threshold > 0
+                    and delta["monitor_sampled"] >= self.min_events
+                ):
+                    dmax = self._window_max(
+                        now, window, cur, "drift_distance"
+                    )
+                    rates["drift"] = (
+                        (dmax / self.drift_threshold) * self.fast_threshold
+                        if dmax >= self.drift_threshold else 0.0
+                    )
                 result["windows"][window] = {
                     "span_s": round(span, 3), "delta": delta,
                     "burn": rates,

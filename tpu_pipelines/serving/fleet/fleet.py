@@ -76,6 +76,8 @@ class ServingFleet:
         supervisor_queue_age_s: float = 0.0,
         supervisor_breaker_failures: int = 3,
         supervisor_breaker_open_s: float = 0.0,
+        monitor_sample_rate: float = 0.0,
+        monitor_window_s: float = 0.0,
         registry=None,
         loader: Optional[Callable[[str], Any]] = None,
     ):
@@ -233,6 +235,36 @@ class ServingFleet:
                 )
                 self.pool.on_failover = self._m_failovers.inc
             self.supervisor.start()
+        # Live drift & skew plane (ISSUE 20), opt-in via
+        # monitor_sample_rate: OFF (the default) constructs no sampler —
+        # no thread, no queue, none of the serving_monitor_* /
+        # serving_drift_* families registered, zero bytes added to the
+        # predict path — the disabled fleet is byte-identical to the
+        # unmonitored one (the same contract the supervisor keeps above).
+        self.sampler = None
+        if monitor_sample_rate > 0:
+            from tpu_pipelines.observability.drift import (
+                DEFAULT_WINDOW_S,
+                TrafficSampler,
+            )
+            from tpu_pipelines.observability.metrics_history import (
+                MetricsHistory,
+            )
+
+            self.sampler = TrafficSampler(
+                model_name,
+                sample_rate=monitor_sample_rate,
+                window_s=(
+                    monitor_window_s if monitor_window_s > 0
+                    else DEFAULT_WINDOW_S
+                ),
+                registry=registry,
+                baseline_for=self._drift_baseline,
+                # None unless TPP_METRICS_HISTORY is on: the drift plane
+                # inherits the history ring's zero-footprint contract.
+                history=MetricsHistory.from_env(base_dir),
+            )
+            self.sampler.start()
 
     @property
     def generative(self) -> bool:
@@ -252,7 +284,14 @@ class ServingFleet:
             # the thread-local note surfaces the leased version onto the
             # model.step span (one global int read when tracing is off).
             request_trace.note("version", version)
-            return np.asarray(self._predict_callable(loaded)(batch))
+            result = np.asarray(self._predict_callable(loaded)(batch))
+            if self.sampler is not None:
+                # Rate-gated, non-blocking handoff to the drift sampler
+                # thread: a full queue drops the sample (counted), never
+                # the predict.  Runs while the lease still pins `version`
+                # so the sample is attributed to the version that served.
+                self.sampler.offer(version, batch, result)
+            return result
 
     def submit(
         self,
@@ -500,6 +539,30 @@ class ServingFleet:
         )
         return ""
 
+    # ---------------------------------------------------------- drift plane
+
+    def _drift_baseline(self, version: str):
+        """Training-time statistics baseline for one resident version.
+
+        The payload spec carries ``training_statistics_uri`` (stamped at
+        export or Pusher time — the no-store-walk lineage contract), so
+        the skew baseline is one JSON read per version, cached by the
+        sampler.  Returns ``(SplitStatistics, uri)`` or None when the
+        payload has no lineage (drift-vs-previous-window still runs)."""
+        loaded = self.versions.loaded_for(version)
+        uri = str(getattr(loaded, "training_statistics_uri", "") or "")
+        if not uri:
+            return None
+        from tpu_pipelines.data.statistics import load_statistics
+
+        stats = load_statistics(uri)
+        baseline = stats.get("train")
+        if baseline is None and stats:
+            baseline = stats[sorted(stats)[0]]
+        if baseline is None:
+            return None
+        return baseline, uri
+
     # -------------------------------------------------- SLO auto-rollback
 
     def on_slo_breach(self, breach: Dict[str, Any]) -> bool:
@@ -515,6 +578,12 @@ class ServingFleet:
         False when no recent swap, probation expired, the prior version
         is gone, or a rollback already ran (idempotent under the
         monitor's edge-triggered breaches AND a racing double-fire)."""
+        if breach.get("slo") == "drift":
+            # A drift breach is a property of the DATA, not of the swap:
+            # rolling back the model would not un-shift the traffic.  The
+            # continuous controller owns the response (retrain), so the
+            # probation policy explicitly declines it.
+            return False
         with self._rollback_lock:
             swap = self.versions.last_swap()
             if swap is None or self.swap_probation_s <= 0:
@@ -599,9 +668,13 @@ class ServingFleet:
             health["replica_states"] = {
                 r.name: self.supervisor.state(r) for r in self.pool.replicas
             }
+        if self.sampler is not None:
+            health["drift"] = self.sampler.summary()
         return health
 
     def close(self, timeout_s: float = 5.0) -> None:
+        if self.sampler is not None:
+            self.sampler.stop()
         if self.supervisor is not None:
             self.supervisor.stop()
         self.pool.close(timeout_s=timeout_s)

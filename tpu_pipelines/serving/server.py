@@ -96,6 +96,13 @@ ENV_SUPERVISOR_QUEUE_AGE_S = "TPP_SERVING_SUPERVISOR_QUEUE_AGE_S"
 ENV_REQUEST_TRACE = request_trace.ENV_REQUEST_TRACE
 ENV_REQUEST_TRACE_DIR = request_trace.ENV_REQUEST_TRACE_DIR
 ENV_SLO_MONITOR = "TPP_SLO_MONITOR"
+# Live drift & skew plane (ISSUE 20, observability/drift.py): fraction
+# of admitted predicts sampled into tumbling stats windows scored
+# against the training baseline (0 < rate <= 1; unset/0 = no sampler
+# thread, no serving_monitor_*/serving_drift_* families, byte-identical
+# /metrics), and the window length in seconds (0 = 60 s default).
+ENV_MONITOR_SAMPLE = "TPP_SERVING_MONITOR_SAMPLE"
+ENV_MONITOR_WINDOW = "TPP_SERVING_MONITOR_WINDOW_S"
 
 
 def _env_number(name: str, default: float) -> float:
@@ -168,6 +175,8 @@ class ModelServer:
         swap_probation_s: float = -1.0,
         supervisor_interval_s: float = -1.0,
         supervisor_queue_age_s: float = -1.0,
+        monitor_sample_rate: float = -1.0,
+        monitor_window_s: float = -1.0,
     ):
         self.model_name = model_name
         self.base_dir = base_dir
@@ -203,8 +212,14 @@ class ModelServer:
             supervisor_queue_age_s = _env_number(
                 ENV_SUPERVISOR_QUEUE_AGE_S, 0.0
             )
+        if monitor_sample_rate < 0:
+            monitor_sample_rate = _env_number(ENV_MONITOR_SAMPLE, 0.0)
+        if monitor_window_s < 0:
+            monitor_window_s = _env_number(ENV_MONITOR_WINDOW, 0.0)
         self.supervisor_interval_s = max(0.0, supervisor_interval_s)
         self.supervisor_queue_age_s = max(0.0, supervisor_queue_age_s)
+        self.monitor_sample_rate = max(0.0, monitor_sample_rate)
+        self.monitor_window_s = max(0.0, monitor_window_s)
         self.replicas = max(1, replicas)
         self.max_versions = max(1, max_versions)
         self.slo_p99_ms = max(0.0, slo_p99_ms)
@@ -313,6 +328,10 @@ class ModelServer:
             self.replicas > 1
             or self.max_versions > 1
             or self.model_type == "generative"
+            # The drift sampler hooks the fleet's leased predict path, so
+            # asking for live monitoring promotes a single-server config
+            # to a one-replica fleet (identical request semantics).
+            or self.monitor_sample_rate > 0
         ):
             # Generative serving is a FLEET model type even at one
             # replica: the continuous-batch engine, per-version drain and
@@ -338,8 +357,16 @@ class ModelServer:
                 swap_probation_s=swap_probation_s,
                 supervisor_interval_s=self.supervisor_interval_s,
                 supervisor_queue_age_s=self.supervisor_queue_age_s,
+                monitor_sample_rate=self.monitor_sample_rate,
+                monitor_window_s=self.monitor_window_s,
                 registry=self.metrics,
             )
+            if self._fleet.sampler is not None:
+                # Drift alerts land in the same trace stream request
+                # spans use (a drift/alert instant next to the slo
+                # burn_alert ones); no tracer configured = module-level
+                # no-op instants, nothing extra recorded.
+                self._fleet.sampler.tracer = self.request_tracer
             if self._slo_interval_s > 0:
                 # SLO burn-rate monitor (observability/slo.py), wired to
                 # the fleet's default breach policy: a breach inside the
@@ -348,9 +375,17 @@ class ModelServer:
                 # only exist when someone asked for the monitor).
                 from tpu_pipelines.observability.slo import SLOMonitor
 
+                drift_threshold = 0.0
+                if self.monitor_sample_rate > 0:
+                    from tpu_pipelines.observability.drift import (
+                        DEFAULT_DRIFT_THRESHOLD,
+                    )
+
+                    drift_threshold = DEFAULT_DRIFT_THRESHOLD
                 self.slo_monitor = SLOMonitor(
                     self.metrics,
                     slo_p99_s=self.slo_p99_ms / 1e3,
+                    drift_threshold=drift_threshold,
                     on_breach=self._fleet.on_slo_breach,
                     tracer=self.request_tracer,
                 )

@@ -48,6 +48,8 @@ def export_model(
     transform_graph_uri: str = "",
     extra_spec: Optional[Dict[str, Any]] = None,
     serving_dtype: Optional[str] = None,
+    training_statistics_uri: str = "",
+    training_schema_uri: str = "",
 ) -> str:
     """Write a self-contained model payload; returns the dir.
 
@@ -91,6 +93,15 @@ def export_model(
             "params_bytes": qz.params_nbytes(params),  # tpp: disable=TPP214 (payload key)
             **(extra_spec or {}),
         }
+        # Training-data lineage (ISSUE 20): the statistics/schema URIs the
+        # deployed fleet scores live traffic against — recorded on the
+        # payload itself so serving never walks the metadata store.  Only
+        # written when provided, so pre-existing payload specs stay
+        # byte-identical.
+        if training_statistics_uri:
+            spec["training_statistics_uri"] = training_statistics_uri
+        if training_schema_uri:
+            spec["training_schema_uri"] = training_schema_uri
         with open(os.path.join(serving_model_dir, SPEC_FILE), "w") as f:
             json.dump(spec, f, indent=2, sort_keys=True, default=str)
     return serving_model_dir
@@ -200,6 +211,13 @@ class LoadedModel:
     # publishes both per resident version.
     dtype: str = "float32"
     params_bytes: int = 0
+    # Training-data lineage stamped on the payload spec at export or
+    # Pusher time (ISSUE 20): the ExampleStatistics payload the model
+    # trained against ("" = unstamped) and its schema.  The fleet's
+    # TrafficSampler resolves its drift baseline from these — no
+    # metadata-store walk at serving time.
+    training_statistics_uri: str = ""
+    training_schema_uri: str = ""
     # Payload directory this model was loaded from ("" for hand-built
     # instances) — the AOT executable cache keys on its content hash.
     uri: str = ""
@@ -511,6 +529,8 @@ def load_exported_model(uri: str) -> LoadedModel:
         # Resident bytes of the tree actually held in memory (after the
         # bf16 load cast / with int8 + scales), not the on-disk figure.
         params_bytes=qz.params_nbytes(params),
+        training_statistics_uri=str(spec.get("training_statistics_uri") or ""),
+        training_schema_uri=str(spec.get("training_schema_uri") or ""),
         uri=os.path.abspath(uri),
         aot=aot,
     )
